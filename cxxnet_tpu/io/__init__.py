@@ -1,0 +1,4 @@
+"""Data pipeline: chained iterators feeding NCHW host batches."""
+
+from .data import (DataBatch, DataInst, IIterator, ThreadBufferIterator,
+                   create_iterator)

@@ -1,0 +1,154 @@
+"""Data pipeline core: DataBatch, iterator interface, and the chain factory.
+
+Chained-iterator architecture preserved from the reference
+(``src/io/data.h:19-181``, factory ``src/io/data.cpp:23-74``): sources
+(``mnist`` | ``imgbin`` | ``img``) are wrapped by augment+batch stages and
+optional ``threadbuffer`` / ``membuffer`` prefetch/cache stages, all
+assembled from the ordered config pairs of one ``data = .. iter = .. end``
+section.  Batches carry NCHW numpy arrays (the host-side layout contract);
+the net transposes to NHWC on device.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.thread_buffer import ThreadBuffer
+
+ConfigEntry = Tuple[str, str]
+
+
+class DataBatch:
+    """One minibatch (``src/io/data.h:83-181``)."""
+
+    __slots__ = ('data', 'label', 'inst_index', 'num_batch_padd', 'extra_data')
+
+    def __init__(self, data: np.ndarray, label: np.ndarray,
+                 inst_index: Optional[np.ndarray] = None,
+                 num_batch_padd: int = 0,
+                 extra_data: Optional[List[np.ndarray]] = None):
+        self.data = data                    # (b, c, y, x) float32
+        self.label = label                  # (b, label_width) float32
+        self.inst_index = inst_index        # (b,) uint32 or None
+        self.num_batch_padd = num_batch_padd
+        self.extra_data = extra_data or []
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+
+class DataInst:
+    """One instance (``src/io/data.h:41-57``)."""
+
+    __slots__ = ('index', 'data', 'label', 'extra_data')
+
+    def __init__(self, index: int, data: np.ndarray, label: np.ndarray,
+                 extra_data: Optional[List[np.ndarray]] = None):
+        self.index = index
+        self.data = data                    # (c, y, x)
+        self.label = label                  # (label_width,)
+        self.extra_data = extra_data or []
+
+
+class IIterator:
+    """Reference iterator protocol: SetParam*, Init, then per-epoch
+    BeforeFirst/Next/Value — exposed pythonically as ``__iter__``."""
+
+    def set_param(self, name: str, val: str) -> None:
+        pass
+
+    def init(self) -> None:
+        pass
+
+    def __iter__(self) -> Iterator:
+        raise NotImplementedError
+
+
+class ThreadBufferIterator(IIterator):
+    """Batch-level prefetch (``iter_batch_proc-inl.hpp:136-224``)."""
+
+    def __init__(self, base: IIterator, buffer_size: int = 2):
+        self.base = base
+        self._buf = ThreadBuffer(lambda: iter(self.base), buffer_size)
+
+    def set_param(self, name, val):
+        self.base.set_param(name, val)
+
+    def init(self):
+        self.base.init()
+
+    def __iter__(self):
+        return iter(self._buf)
+
+
+class DenseBufferIterator(IIterator):
+    """Cache the first ``max_nbatch`` batches in RAM and loop over them
+    (``iter_mem_buffer-inl.hpp:16-75``)."""
+
+    def __init__(self, base: IIterator):
+        self.base = base
+        self.max_nbatch = 0
+        self._cache: Optional[List[DataBatch]] = None
+
+    def set_param(self, name, val):
+        if name == 'max_nbatch':
+            self.max_nbatch = int(val)
+        self.base.set_param(name, val)
+
+    def init(self):
+        self.base.init()
+
+    def __iter__(self):
+        if self._cache is None:
+            cache = []
+            for batch in self.base:
+                cache.append(batch)
+                if self.max_nbatch and len(cache) >= self.max_nbatch:
+                    break
+            self._cache = cache
+        return iter(self._cache)
+
+
+def create_iterator(cfg: List[ConfigEntry]) -> IIterator:
+    """Assemble an iterator chain from one config section
+    (``src/io/data.cpp:23-74``)."""
+    from .iter_batch import BatchAdaptIterator
+    from .iter_mnist import MNISTIterator
+
+    it: Optional[IIterator] = None
+    for name, val in cfg:
+        if name == 'iter':
+            if val == 'mnist':
+                assert it is None, 'mnist cannot chain over another iterator'
+                it = MNISTIterator()
+            elif val in ('imgbin', 'imgbinx', 'img'):
+                assert it is None, f'{val} cannot chain over another iterator'
+                from .iter_augment import AugmentIterator
+                if val == 'img':
+                    from .iter_img import ImageIterator
+                    src = ImageIterator()
+                else:
+                    from .iter_imbin import ImageBinIterator
+                    src = ImageBinIterator()
+                it = BatchAdaptIterator(AugmentIterator(src))
+            elif val == 'threadbuffer':
+                assert it is not None, 'must specify input of threadbuffer'
+                it = ThreadBufferIterator(it)
+            elif val == 'membuffer':
+                assert it is not None, 'must specify input of membuffer'
+                it = DenseBufferIterator(it)
+            elif val == 'attachtxt':
+                from .iter_attach import AttachTxtIterator
+                assert it is not None, 'must specify input of attachtxt'
+                it = AttachTxtIterator(it)
+            elif val == 'end':
+                break
+            else:
+                raise ValueError(f'unknown iterator type {val}')
+        elif it is not None:
+            it.set_param(name, val)
+    assert it is not None, 'must specify iterator by iter=itername'
+    return it

@@ -1,0 +1,280 @@
+"""Per-instance augmentation (``src/io/iter_augment_proc-inl.hpp:21-246`` +
+the affine pipeline of ``src/io/image_augmenter-inl.hpp:13-204``).
+
+Stages, in reference order:
+
+1. optional affine warp (rotation from ``max_rotate_angle``/``rotate``/
+   ``rotate_list``, shear, scale, aspect ratio) — only active when those
+   params are set (``NeedProcess``); scipy affine_transform replaces
+   cv::warpAffine, constant fill ``fill_value`` (default 255),
+2. crop to ``input_shape`` — random (``rand_crop``) or center, with
+   deterministic overrides ``crop_y_start``/``crop_x_start``,
+3. mirror — random (``rand_mirror``) or forced (``mirror=1``),
+4. mean subtraction — per-channel ``mean_value`` or a mean *image* file
+   (``image_mean``), built over one pass of the dataset and cached to disk
+   on first use exactly like the reference,
+5. random contrast/illumination, then ``scale``/``divideby``.
+
+For flat inputs (``input_shape`` c==1,y==1) only scaling applies.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+import numpy as np
+
+from .data import DataInst, IIterator
+
+
+class ImageAugmenter:
+    """Affine warp stage (rotation/shear/scale/aspect)."""
+
+    def __init__(self):
+        self.max_rotate_angle = 0.0
+        self.max_aspect_ratio = 0.0
+        self.max_shear_ratio = 0.0
+        self.min_crop_size = -1
+        self.max_crop_size = -1
+        self.rotate = -1.0
+        self.rotate_list = []
+        self.max_random_scale = 1.0
+        self.min_random_scale = 1.0
+        self.min_img_size = 0.0
+        self.max_img_size = 1e10
+        self.fill_value = 255
+
+    def set_param(self, name, val):
+        if name == 'max_rotate_angle':
+            self.max_rotate_angle = float(val)
+        if name == 'max_shear_ratio':
+            self.max_shear_ratio = float(val)
+        if name == 'max_aspect_ratio':
+            self.max_aspect_ratio = float(val)
+        if name == 'min_crop_size':
+            self.min_crop_size = int(val)
+        if name == 'max_crop_size':
+            self.max_crop_size = int(val)
+        if name == 'min_random_scale':
+            self.min_random_scale = float(val)
+        if name == 'max_random_scale':
+            self.max_random_scale = float(val)
+        if name == 'min_img_size':
+            self.min_img_size = float(val)
+        if name == 'max_img_size':
+            self.max_img_size = float(val)
+        if name == 'fill_value':
+            self.fill_value = int(val)
+        if name == 'rotate':
+            self.rotate = float(val)
+        if name == 'rotate_list':
+            self.rotate_list = [int(t) for t in val.split(',') if t]
+
+    def need_process(self) -> bool:
+        if (self.max_rotate_angle > 0 or self.max_shear_ratio > 0
+                or self.rotate > 0 or self.rotate_list):
+            return True
+        if self.min_crop_size > 0 and self.max_crop_size > 0:
+            return True
+        return False
+
+    def process(self, data: np.ndarray, rng: np.random.RandomState,
+                out_y: int, out_x: int) -> np.ndarray:
+        """data: (c, h, w) → warped image, still larger than (out_y, out_x)
+        when possible (the caller crops)."""
+        if not self.need_process():
+            return data
+        from scipy import ndimage
+        c, rows, cols = data.shape
+        s = rng.rand() * self.max_shear_ratio * 2 - self.max_shear_ratio
+        if self.max_rotate_angle > 0:
+            angle = rng.randint(0, int(self.max_rotate_angle * 2) + 1) \
+                - self.max_rotate_angle
+        else:
+            angle = 0
+        if self.rotate > 0:
+            angle = self.rotate
+        if self.rotate_list:
+            angle = self.rotate_list[rng.randint(0, len(self.rotate_list))]
+        a = np.cos(angle / 180.0 * np.pi)
+        b = np.sin(angle / 180.0 * np.pi)
+        scale = rng.rand() * (self.max_random_scale
+                              - self.min_random_scale) + self.min_random_scale
+        ratio = rng.rand() * self.max_aspect_ratio * 2 \
+            - self.max_aspect_ratio + 1
+        hs = 2 * scale / (1 + ratio)
+        ws = ratio * hs
+        new_w = int(max(self.min_img_size,
+                        min(self.max_img_size, scale * cols)))
+        new_h = int(max(self.min_img_size,
+                        min(self.max_img_size, scale * rows)))
+        # forward matrix (reference image_augmenter:97-104), mapping
+        # (x=col, y=row) source → destination
+        M = np.array([[hs * a - s * b * ws, hs * b + s * a * ws],
+                      [-b * ws, a * ws]], dtype=np.float64)
+        tx = (new_w - (M[0, 0] * cols + M[0, 1] * rows)) / 2
+        ty = (new_h - (M[1, 0] * cols + M[1, 1] * rows)) / 2
+        # scipy works on (row, col) with inverse mapping
+        Mrc = np.array([[M[1, 1], M[1, 0]], [M[0, 1], M[0, 0]]])
+        inv = np.linalg.inv(Mrc)
+        offset = -inv @ np.array([ty, tx])
+        out = np.empty((c, new_h, new_w), np.float32)
+        for ch in range(c):
+            out[ch] = ndimage.affine_transform(
+                data[ch], inv, offset=offset, output_shape=(new_h, new_w),
+                order=3, mode='constant', cval=self.fill_value)
+        return out
+
+
+class AugmentIterator(IIterator):
+    def __init__(self, base: IIterator):
+        self.base = base
+        self.shape = (0, 0, 0)      # (c, y, x)
+        self.rand_crop = 0
+        self.rand_mirror = 0
+        self.mirror = 0
+        self.crop_y_start = -1
+        self.crop_x_start = -1
+        self.scale = 1.0
+        self.silent = 0
+        self.name_meanimg = ''
+        self.mean_vals = None       # per-channel values (ch order 0,1,2)
+        self.max_random_contrast = 0.0
+        self.max_random_illumination = 0.0
+        self.seed_data = 0
+        self.aug = ImageAugmenter()
+        self._meanimg = None
+
+    def set_param(self, name, val):
+        self.base.set_param(name, val)
+        self.aug.set_param(name, val)
+        if name == 'input_shape':
+            self.shape = tuple(int(t) for t in val.split(','))
+        if name == 'seed_data':
+            self.seed_data = int(val)
+        if name == 'rand_crop':
+            self.rand_crop = int(val)
+        if name == 'silent':
+            self.silent = int(val)
+        if name == 'divideby':
+            self.scale = 1.0 / float(val)
+        if name == 'scale':
+            self.scale = float(val)
+        if name == 'image_mean':
+            self.name_meanimg = val
+        if name == 'crop_y_start':
+            self.crop_y_start = int(val)
+        if name == 'crop_x_start':
+            self.crop_x_start = int(val)
+        if name == 'rand_mirror':
+            self.rand_mirror = int(val)
+        if name == 'mirror':
+            self.mirror = int(val)
+        if name == 'max_random_contrast':
+            self.max_random_contrast = float(val)
+        if name == 'max_random_illumination':
+            self.max_random_illumination = float(val)
+        if name == 'mean_value':
+            self.mean_vals = np.asarray(
+                [float(t) for t in val.split(',')], np.float32)
+
+    def init(self):
+        self.base.init()
+        if self.name_meanimg:
+            if os.path.exists(self.name_meanimg):
+                if self.silent == 0:
+                    print(f'loading mean image from {self.name_meanimg}')
+                self._meanimg = _load_mean(self.name_meanimg)
+            else:
+                self._create_mean_img()
+
+    def _raw_iter(self):
+        """Instances after affine + crop + mirror, before mean/scale —
+        used for mean-image computation."""
+        rng = np.random.RandomState(self.seed_data)
+        c, ty, tx = self.shape
+        for inst in self.base:
+            data = self.aug.process(inst.data, rng, ty, tx)
+            if ty == 1 and c == 1:
+                yield inst, data          # flat input: no crop
+                continue
+            _, h, w = data.shape
+            assert h >= ty and w >= tx, \
+                'Data size must be bigger than the input size to net.'
+            yy, xx = h - ty, w - tx
+            if self.rand_crop != 0 and (yy != 0 or xx != 0):
+                yy = rng.randint(0, yy + 1)
+                xx = rng.randint(0, xx + 1)
+            else:
+                yy //= 2
+                xx //= 2
+            if h != ty and self.crop_y_start != -1:
+                yy = self.crop_y_start
+            if w != tx and self.crop_x_start != -1:
+                xx = self.crop_x_start
+            crop = data[:, yy:yy + ty, xx:xx + tx]
+            if (self.rand_mirror != 0 and rng.rand() < 0.5) or self.mirror == 1:
+                crop = crop[:, :, ::-1]
+            yield inst, crop
+
+    def __iter__(self):
+        rng = np.random.RandomState(self.seed_data + 91)
+        c, ty, tx = self.shape
+        for inst, crop in self._raw_iter():
+            if ty == 1 and c == 1:
+                yield DataInst(inst.index, crop * self.scale, inst.label,
+                               inst.extra_data)
+                continue
+            contrast = 1.0
+            illum = 0.0
+            if self.max_random_contrast > 0:
+                contrast = rng.rand() * self.max_random_contrast * 2 \
+                    - self.max_random_contrast + 1
+            if self.max_random_illumination > 0:
+                illum = rng.rand() * self.max_random_illumination * 2 \
+                    - self.max_random_illumination
+            out = crop.astype(np.float32)
+            if self.mean_vals is not None:
+                out = out - self.mean_vals[:, None, None]
+            elif self._meanimg is not None:
+                if self._meanimg.shape == out.shape:
+                    out = out - self._meanimg
+            out = (out * contrast + illum) * self.scale
+            yield DataInst(inst.index, out, inst.label, inst.extra_data)
+
+    def _create_mean_img(self):
+        if self.silent == 0:
+            print(f'cannot find {self.name_meanimg}: create mean image, '
+                  f'this will take some time...')
+        start = time.time()
+        mean = None
+        cnt = 0
+        for _, crop in self._raw_iter():
+            mean = crop.astype(np.float64) if mean is None else mean + crop
+            cnt += 1
+            if cnt % 1000 == 0 and self.silent == 0:
+                print(f'[{cnt:8d}] images processed, '
+                      f'{int(time.time() - start)} sec elapsed')
+        assert cnt > 0, 'input iterator failed.'
+        self._meanimg = (mean / cnt).astype(np.float32)
+        _save_mean(self.name_meanimg, self._meanimg)
+        if self.silent == 0:
+            print(f'save mean image to {self.name_meanimg}..')
+
+
+def _save_mean(path: str, img: np.ndarray) -> None:
+    """(ndim, shape, float32 data) — mshadow SaveBinary convention."""
+    with open(path, 'wb') as f:
+        f.write(struct.pack('<I', img.ndim))
+        f.write(struct.pack(f'<{img.ndim}I', *img.shape))
+        f.write(np.ascontiguousarray(img, np.float32).tobytes())
+
+
+def _load_mean(path: str) -> np.ndarray:
+    with open(path, 'rb') as f:
+        (ndim,) = struct.unpack('<I', f.read(4))
+        shape = struct.unpack(f'<{ndim}I', f.read(4 * ndim))
+        data = np.frombuffer(f.read(), np.float32)
+    return data[:int(np.prod(shape))].reshape(shape).copy()

@@ -1,0 +1,79 @@
+"""Instance→batch adapter (``src/io/iter_batch_proc-inl.hpp:16-133``).
+
+Collects ``DataInst`` from an instance iterator into fixed-size batches.
+``round_batch=1``: when the epoch ends mid-batch, wrap around to the first
+instances of the *next* epoch pass and report ``num_batch_padd`` (the count
+of wrapped/padding instances) so evaluation can exclude them — same contract
+as the reference.  ``test_skipread=1`` re-serves one cached batch to bound
+maximum pipeline throughput (used by the ``test_io`` harness).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .data import DataBatch, IIterator
+
+
+class BatchAdaptIterator(IIterator):
+    def __init__(self, base):
+        self.base = base               # instance iterator
+        self.batch_size = 0
+        self.round_batch = 0
+        self.test_skipread = 0
+        self.label_width = 1
+        self._cached: DataBatch | None = None
+
+    def set_param(self, name, val):
+        if name == 'batch_size':
+            self.batch_size = int(val)
+        if name == 'round_batch':
+            self.round_batch = int(val)
+        if name == 'test_skipread':
+            self.test_skipread = int(val)
+        if name == 'label_width':
+            self.label_width = int(val)
+        self.base.set_param(name, val)
+
+    def init(self):
+        self.base.init()
+
+    def _make_batch(self, insts):
+        bs = len(insts)
+        data = np.stack([i.data for i in insts]).astype(np.float32)
+        label = np.stack([np.atleast_1d(i.label) for i in insts]).astype(np.float32)
+        index = np.asarray([i.index for i in insts], dtype=np.uint32)
+        return data, label, index
+
+    def __iter__(self):
+        assert self.batch_size > 0, 'batch: batch_size must be set'
+        if self.test_skipread and self._cached is not None:
+            while True:   # bounded by consumer; used only by test_io harness
+                yield self._cached
+        bs = self.batch_size
+        buf = []
+        for inst in self.base:
+            buf.append(inst)
+            if len(buf) == bs:
+                data, label, index = self._make_batch(buf)
+                batch = DataBatch(data, label, index)
+                if self.test_skipread and self._cached is None:
+                    self._cached = batch
+                yield batch
+                buf = []
+        if buf and self.round_batch:
+            # wrap with the first instances of a fresh epoch pass, like the
+            # reference's BeforeFirst-and-continue (iter_batch_proc:84-101)
+            npadd = bs - len(buf)
+            wrap = []
+            while len(wrap) < npadd:
+                took = False
+                for inst in self.base:
+                    wrap.append(inst)
+                    took = True
+                    if len(wrap) == npadd:
+                        break
+                if not took:
+                    raise RuntimeError('round_batch: source is empty')
+            data, label, index = self._make_batch(buf + wrap)
+            yield DataBatch(data, label, index, num_batch_padd=npadd)

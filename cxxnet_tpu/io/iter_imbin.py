@@ -1,0 +1,124 @@
+"""Binary-page image source (``src/io/iter_thread_imbin-inl.hpp:16-283``).
+
+Reads the reference's packed image format: a ``.bin`` stream of 64MB
+``BinaryPage``s whose objects are encoded (JPEG/PNG) image blobs, paired
+record-for-record with a ``.lst`` file carrying ``index \\t labels...``.
+Features preserved:
+
+* multi-part datasets via ``image_conf_prefix`` printf-style pattern +
+  ``image_conf_ids = a-b`` (iter_thread_imbin:225-278),
+* distributed worker sharding: parts (or pages, for a single file) are
+  round-robin split across workers by ``dist_num_worker`` /
+  ``dist_worker_rank`` (``PS_RANK`` env respected, :189-220),
+* page-level shuffle (``shuffle=1``).
+
+Decode uses PIL; the page read-ahead runs behind a ThreadBuffer when the
+config wraps this source in ``iter = threadbuffer``.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as np
+
+from ..utils.io_stream import BinaryPage
+from .data import DataInst, IIterator
+from .iter_img import parse_lst_line
+
+
+class ImageBinIterator(IIterator):
+    def __init__(self):
+        self.path_imglist = ''
+        self.path_imgbin = ''
+        self.label_width = 1
+        self.silent = 0
+        self.shuffle = 0
+        self.seed_data = 0
+        self.conf_prefix = ''
+        self.conf_ids = ''
+        self.dist_num_worker = 1
+        self.dist_worker_rank = 0
+        self._lists = []
+        self._bins = []
+
+    def set_param(self, name, val):
+        if name in ('image_list', 'path_imglist'):
+            self.path_imglist = val
+        if name in ('image_bin', 'path_imgbin'):
+            self.path_imgbin = val
+        if name == 'label_width':
+            self.label_width = int(val)
+        if name == 'silent':
+            self.silent = int(val)
+        if name == 'shuffle':
+            self.shuffle = int(val)
+        if name == 'seed_data':
+            self.seed_data = int(val)
+        if name == 'image_conf_prefix':
+            self.conf_prefix = val
+        if name == 'image_conf_ids':
+            self.conf_ids = val
+        if name == 'dist_num_worker':
+            self.dist_num_worker = int(val)
+        if name == 'dist_worker_rank':
+            self.dist_worker_rank = int(val)
+
+    def init(self):
+        rank = int(os.environ.get('PS_RANK', self.dist_worker_rank))
+        nworker = self.dist_num_worker
+        if self.conf_prefix:
+            a, _, b = self.conf_ids.partition('-')
+            ids = list(range(int(a), int(b or a) + 1))
+            # shard whole parts across workers (iter_thread_imbin:196-213)
+            ids = ids[rank::nworker] if nworker > 1 else ids
+            self._lists = [self.conf_prefix % i + '.lst' for i in ids]
+            self._bins = [self.conf_prefix % i + '.bin' for i in ids]
+        else:
+            assert self.path_imglist and self.path_imgbin, \
+                'imgbin: must set image_list and image_bin'
+            self._lists = [self.path_imglist]
+            self._bins = [self.path_imgbin]
+        self._single_shard = (nworker > 1 and not self.conf_prefix,
+                              rank, nworker)
+        if self.silent == 0:
+            print(f'ImageBinIterator: {len(self._bins)} part(s), '
+                  f'worker {rank}/{nworker}')
+
+    def _iter_pages(self, bin_path):
+        with open(bin_path, 'rb') as f:
+            while True:
+                page = BinaryPage()
+                if not page.load(f):
+                    return
+                yield page
+
+    def __iter__(self):
+        from PIL import Image
+        sharded, rank, nworker = self._single_shard
+        order = list(range(len(self._bins)))
+        rng = np.random.RandomState(self.seed_data) if self.shuffle else None
+        if rng is not None:
+            rng.shuffle(order)
+        for part in order:
+            with open(self._lists[part]) as f:
+                lines = (parse_lst_line(l) for l in f if l.strip())
+                lines = iter(list(lines))
+            page_idx = 0
+            for page in self._iter_pages(self._bins[part]):
+                take = (not sharded) or (page_idx % nworker == rank)
+                for blob in page:
+                    try:
+                        index, labels, _ = next(lines)
+                    except StopIteration:
+                        raise RuntimeError(
+                            'imgbin: .lst shorter than .bin contents')
+                    if not take:
+                        continue
+                    with Image.open(io.BytesIO(blob)) as im:
+                        arr = np.asarray(im.convert('RGB'), np.float32)
+                    yield DataInst(index, np.transpose(arr, (2, 0, 1)),
+                                   labels[:self.label_width]
+                                   if self.label_width else labels)
+                page_idx += 1

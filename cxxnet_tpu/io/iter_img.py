@@ -1,0 +1,67 @@
+"""Image-list source iterator (``src/io/iter_img-inl.hpp:16-135``).
+
+Reads a ``.lst`` file (``index \\t label[ \\t label...] \\t filename``) and
+decodes one image per instance (PIL replaces OpenCV), yielding ``(3, h, w)``
+float32 pixel data in 0-255 range, channels in the tensor order the
+reference produces, with labels of ``label_width`` columns.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .data import DataInst, IIterator
+
+
+def load_image_chw(path: str) -> np.ndarray:
+    from PIL import Image
+    with Image.open(path) as im:
+        arr = np.asarray(im.convert('RGB'), dtype=np.float32)
+    return np.transpose(arr, (2, 0, 1))          # (3, h, w)
+
+
+def parse_lst_line(line: str):
+    toks = line.strip().split('\t')
+    if len(toks) < 3:
+        toks = line.strip().split()
+    index = int(float(toks[0]))
+    labels = np.asarray([float(t) for t in toks[1:-1]], dtype=np.float32)
+    fname = toks[-1]
+    return index, labels, fname
+
+
+class ImageIterator(IIterator):
+    def __init__(self):
+        self.path_imglist = ''
+        self.image_root = ''
+        self.label_width = 1
+        self.silent = 0
+        self._lines = []
+
+    def set_param(self, name, val):
+        if name in ('image_list', 'path_imglist'):
+            self.path_imglist = val
+        if name in ('image_root', 'path_imgdir'):
+            self.image_root = val
+        if name == 'label_width':
+            self.label_width = int(val)
+        if name == 'silent':
+            self.silent = int(val)
+
+    def init(self):
+        assert self.path_imglist, 'img iterator: must set image_list'
+        with open(self.path_imglist) as f:
+            self._lines = [parse_lst_line(l) for l in f if l.strip()]
+        if self.silent == 0:
+            print(f'ImageIterator: {len(self._lines)} images in '
+                  f'{self.path_imglist}')
+
+    def __iter__(self):
+        for index, labels, fname in self._lines:
+            path = os.path.join(self.image_root, fname) \
+                if self.image_root else fname
+            yield DataInst(index, load_image_chw(path),
+                           labels[:self.label_width]
+                           if self.label_width else labels)

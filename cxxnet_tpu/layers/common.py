@@ -1,0 +1,452 @@
+"""Dense / elementwise / structural layers.
+
+Functional JAX redesigns of the reference layers (citations per class).
+Backward passes are derived by ``jax.grad`` through these forwards; the
+pairtest harness checks them against hand-written NumPy gradients.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from .base import (ForwardContext, Layer, NodeSpec, Params, as_mat,
+                   kBias, kChConcat, kConcat, kDropout, kFixConnect, kFlatten,
+                   kFullConnect, kInsanity, kMaxout, kPRelu,
+                   kRectifiedLinear, kSigmoid, kSoftplus, kSplit, kTanh,
+                   kXelu, register_layer)
+
+
+@register_layer
+class FullConnectLayer(Layer):
+    """Dense layer (``src/layer/fullc_layer-inl.hpp:101-130``).
+
+    ``out = in @ W + bias``.  Weight is stored ``(nin, nhidden)`` so the
+    forward matmul hits the MXU without a transpose; the reference's
+    ``(nhidden, nin)`` layout is restored only when writing checkpoints.
+    """
+
+    type_name = 'fullc'
+    type_id = kFullConnect
+    param_fields = ('wmat', 'bias')
+
+    def infer_shapes(self, in_specs: List[NodeSpec]) -> List[NodeSpec]:
+        assert len(in_specs) == 1, 'fullc: only supports 1-1 connection'
+        if self.param.num_hidden <= 0:
+            raise ValueError('fullc: must set nhidden correctly')
+        self.param.num_input_node = in_specs[0].flat_size
+        return [NodeSpec(1, 1, self.param.num_hidden)]
+
+    def init_params(self, rng, in_specs, dtype=jnp.float32) -> Params:
+        nin = in_specs[0].flat_size
+        nh = self.param.num_hidden
+        p = {'wmat': self.param.rand_init_weight(rng, (nin, nh), nin, nh, dtype)}
+        if self.param.no_bias == 0:
+            p['bias'] = jnp.full((nh,), self.param.init_bias, dtype)
+        return p
+
+    def forward(self, params, inputs, ctx):
+        x = as_mat(inputs[0])
+        out = x @ params['wmat']
+        if self.param.no_bias == 0:
+            out = out + params['bias']
+        return [out]
+
+
+class _ActivationLayer(Layer):
+    """Elementwise activation (``src/layer/activation_layer-inl.hpp:22-39``)."""
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, ctx):
+        return [self._act(inputs[0])]
+
+    def _act(self, x):
+        raise NotImplementedError
+
+
+@register_layer
+class ReluLayer(_ActivationLayer):
+    type_name = 'relu'
+    type_id = kRectifiedLinear
+
+    def _act(self, x):
+        return jnp.maximum(x, 0.0)
+
+
+@register_layer
+class SigmoidLayer(_ActivationLayer):
+    type_name = 'sigmoid'
+    type_id = kSigmoid
+
+    def _act(self, x):
+        return jax.nn.sigmoid(x)
+
+
+@register_layer
+class TanhLayer(_ActivationLayer):
+    type_name = 'tanh'
+    type_id = kTanh
+
+    def _act(self, x):
+        return jnp.tanh(x)
+
+
+@register_layer
+class SoftplusLayer(_ActivationLayer):
+    """softplus has a type id in the reference (layer.h:290) but no factory
+    case — configuring it there aborts.  We support it."""
+
+    type_name = 'softplus'
+    type_id = kSoftplus
+
+    def _act(self, x):
+        return jax.nn.softplus(x)
+
+
+@register_layer
+class FlattenLayer(Layer):
+    """Reshape to ``(batch, c*y*x)`` (``src/layer/flatten_layer-inl.hpp``).
+
+    Flattening follows the reference's NCHW element order (see ``as_mat``)
+    so fullc weights and extracted features keep reference column meaning.
+    """
+
+    type_name = 'flatten'
+    type_id = kFlatten
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1
+        return [NodeSpec(1, 1, in_specs[0].flat_size)]
+
+    def forward(self, params, inputs, ctx):
+        return [as_mat(inputs[0])]
+
+
+@register_layer
+class DropoutLayer(Layer):
+    """Inverted dropout, self-loop (``src/layer/dropout_layer-inl.hpp``):
+    train-time mask ``Bernoulli(1-p)/(1-p)``; eval is identity."""
+
+    type_name = 'dropout'
+    type_id = kDropout
+
+    def __init__(self, name=''):
+        super().__init__(name)
+        self.threshold = 0.0
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == 'threshold':
+            self.threshold = float(val)
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1
+        if not (0.0 <= self.threshold < 1.0):
+            raise ValueError('DropoutLayer: invalid dropout threshold')
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0]
+        if not ctx.is_train or self.threshold == 0.0:
+            return [x]
+        pkeep = 1.0 - self.threshold
+        mask = jax.random.uniform(ctx.layer_rng(), x.shape, x.dtype) < pkeep
+        return [x * mask.astype(x.dtype) / pkeep]
+
+
+@register_layer
+class BiasLayer(Layer):
+    """Self-loop learnable bias on a matrix node
+    (``src/layer/bias_layer-inl.hpp``)."""
+
+    type_name = 'bias'
+    type_id = kBias
+    param_fields = ('bias',)
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1
+        if not in_specs[0].is_mat:
+            raise ValueError('BiasLayer only works for flattened nodes')
+        self.param.num_input_node = in_specs[0].x
+        return [in_specs[0]]
+
+    def init_params(self, rng, in_specs, dtype=jnp.float32):
+        return {'bias': jnp.full((in_specs[0].x,), self.param.init_bias, dtype)}
+
+    def forward(self, params, inputs, ctx):
+        return [inputs[0] + params['bias']]
+
+
+@register_layer
+class XeluLayer(Layer):
+    """Leaky relu variant ``x > 0 ? x : x / b`` (``src/layer/xelu_layer-inl.hpp``,
+    op at ``src/layer/op.h``: divide, not multiply)."""
+
+    type_name = 'xelu'
+    type_id = kXelu
+
+    def __init__(self, name=''):
+        super().__init__(name)
+        self.b = 5.0
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == 'b':
+            self.b = float(val)
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0]
+        return [jnp.where(x > 0, x, x / self.b)]
+
+
+@register_layer
+class InsanityLayer(Layer):
+    """Randomized leaky relu (RReLU) with slope annealing
+    (``src/layer/insanity_layer-inl.hpp``): train slope denominator
+    ~ U[lb, ub]; eval uses the midpoint.  The reference's per-call
+    ``calm_start/calm_end`` annealing mutates bounds each forward; here the
+    anneal step is derived from ``ctx.round`` so the jitted step stays pure.
+    """
+
+    type_name = 'insanity'
+    type_id = kInsanity
+
+    def __init__(self, name=''):
+        super().__init__(name)
+        self.lb = 5.0
+        self.ub = 10.0
+        self.calm_start = 0
+        self.calm_end = 0
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == 'lb':
+            self.lb = float(val)
+        if name == 'ub':
+            self.ub = float(val)
+        if name == 'calm_start':
+            self.calm_start = int(val)
+        if name == 'calm_end':
+            self.calm_end = int(val)
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1
+        return [in_specs[0]]
+
+    def _bounds(self, step):
+        """Anneal bounds toward the midpoint; ``step`` may be a traced
+        jit value, so use jnp ops."""
+        lb, ub = jnp.asarray(self.lb), jnp.asarray(self.ub)
+        if self.calm_end > self.calm_start:
+            delta = (self.ub - (self.ub + self.lb) / 2.0) \
+                / (self.calm_end - self.calm_start)
+            s = jnp.clip(jnp.asarray(step) - self.calm_start, 0,
+                         self.calm_end - self.calm_start)
+            ub = ub - delta * s
+            lb = lb + delta * s
+        return lb, ub
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0]
+        lb, ub = self._bounds(ctx.round)
+        if ctx.is_train:
+            u = jax.random.uniform(ctx.layer_rng(), x.shape, x.dtype)
+            mask = u * (ub - lb) + lb
+            return [jnp.where(x > 0, x, x / mask)]
+        mid = (lb + ub) / 2.0
+        return [jnp.where(x > 0, x, x / mid)]
+
+
+@register_layer
+class PReluLayer(Layer):
+    """Learnable per-channel slope with optional train-time noise
+    (``src/layer/prelu_layer-inl.hpp``).  Slope mask is clipped to [0,1];
+    negative side multiplies by slope (mxelu)."""
+
+    type_name = 'prelu'
+    type_id = kPRelu
+    param_fields = ('bias',)   # reference visits the slope under tag 'bias'
+
+    def __init__(self, name=''):
+        super().__init__(name)
+        self.init_slope = 0.25
+        self.init_random = 0
+        self.random = 0.0
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == 'init_slope':
+            self.init_slope = float(val)
+        if name == 'random_slope':
+            self.init_random = int(val)
+        if name == 'random':
+            self.random = float(val)
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1
+        s = in_specs[0]
+        self._channels = s.x if s.is_mat else s.c
+        return [s]
+
+    def init_params(self, rng, in_specs, dtype=jnp.float32):
+        if self.init_random == 0:
+            slope = jnp.full((self._channels,), self.init_slope, dtype)
+        else:
+            slope = jax.random.uniform(rng, (self._channels,), dtype) * self.init_slope
+        return {'bias': slope}
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0]
+        slope = params['bias']  # broadcasts over trailing channel axis in
+        # both layouts: (b, len) matrices and (b, y, x, c) NHWC images
+        mask = jnp.broadcast_to(slope, x.shape)
+        if ctx.is_train and self.random > 0:
+            u = jax.random.uniform(ctx.layer_rng(), x.shape, x.dtype)
+            mask = mask * (1 + u * self.random * 2.0 - self.random)
+        mask = jnp.clip(mask, 0.0, 1.0)
+        return [jnp.where(x > 0, x, x * mask)]
+
+
+@register_layer
+class SplitLayer(Layer):
+    """1→n fan-out copy (``src/layer/split_layer-inl.hpp``); gradients sum."""
+
+    type_name = 'split'
+    type_id = kSplit
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1
+        self._n_out = getattr(self, '_n_out', 2)
+        return [in_specs[0] for _ in range(self._n_out)]
+
+    def set_num_outputs(self, n: int):
+        self._n_out = n
+
+    def forward(self, params, inputs, ctx):
+        return [inputs[0] for _ in range(self._n_out)]
+
+
+class _ConcatBase(Layer):
+    """2-4 input concat (``src/layer/concat_layer-inl.hpp``)."""
+
+    def infer_shapes(self, in_specs):
+        if not 2 <= len(in_specs) <= 4:
+            raise ValueError(f'{self.type_name}: supports 2-4 inputs')
+        c, y, x = in_specs[0].c, in_specs[0].y, in_specs[0].x
+        if self.type_id == kConcat:       # concat along x (reference dim 3)
+            for s in in_specs[1:]:
+                if (s.c, s.y) != (c, y):
+                    raise ValueError('concat: non-x dims must match')
+            x = sum(s.x for s in in_specs)
+        else:                             # ch_concat along channel (dim 1)
+            for s in in_specs[1:]:
+                if (s.y, s.x) != (y, x):
+                    raise ValueError('ch_concat: non-channel dims must match')
+            c = sum(s.c for s in in_specs)
+        return [NodeSpec(c, y, x)]
+
+    def forward(self, params, inputs, ctx):
+        if self.type_id == kConcat:
+            axis = 1 if inputs[0].ndim == 2 else 2   # x axis in NHWC
+        else:
+            axis = 3                                  # channel axis in NHWC
+        return [jnp.concatenate(inputs, axis=axis)]
+
+
+@register_layer
+class ConcatLayer(_ConcatBase):
+    type_name = 'concat'
+    type_id = kConcat
+
+
+@register_layer
+class ChConcatLayer(_ConcatBase):
+    type_name = 'ch_concat'
+    type_id = kChConcat
+
+
+@register_layer
+class MaxoutLayer(Layer):
+    """Maxout over channel groups.  The reference declares ``kMaxout``
+    (layer.h:304) but has no factory case, so any config selecting it died;
+    we implement the standard formulation: channels are reduced by a factor
+    of ``ngroup`` via max over consecutive groups."""
+
+    type_name = 'maxout'
+    type_id = kMaxout
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1
+        s = in_specs[0]
+        k = self.param.num_group
+        if k <= 1:
+            raise ValueError('maxout: set ngroup > 1')
+        if s.is_mat:
+            if s.x % k:
+                raise ValueError('maxout: input width must divide ngroup')
+            return [NodeSpec(1, 1, s.x // k)]
+        if s.c % k:
+            raise ValueError('maxout: channels must divide ngroup')
+        return [NodeSpec(s.c // k, s.y, s.x)]
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0]
+        k = self.param.num_group
+        shape = x.shape[:-1] + (x.shape[-1] // k, k)
+        return [jnp.max(x.reshape(shape), axis=-1)]
+
+
+@register_layer
+class FixConnectLayer(Layer):
+    """Fixed (non-learned) sparse projection loaded from a text file
+    (``src/layer/fixconn_layer-inl.hpp:42-57``).  File format:
+    ``nrow ncol nnz`` then ``row col value`` triples; weight is
+    ``(nhidden, nin)`` applied as ``out = in @ W.T``.  The matrix is a
+    constant baked into the jitted graph, not a trainable parameter."""
+
+    type_name = 'fixconn'
+    type_id = kFixConnect
+
+    def __init__(self, name=''):
+        super().__init__(name)
+        self.fname_weight = 'NULL'
+        self._wmat = None
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == 'fixconn_weight':
+            self.fname_weight = val
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1
+        if not in_specs[0].is_mat:
+            raise ValueError('FixConnLayer: input must be a matrix')
+        if self.param.num_hidden <= 0:
+            raise ValueError('FixConnLayer: must set nhidden correctly')
+        if self.fname_weight == 'NULL':
+            raise ValueError('FixConnLayer: must specify fixconn_weight')
+        import numpy as np
+        nin = in_specs[0].x
+        w = np.zeros((self.param.num_hidden, nin), dtype=np.float32)
+        with open(self.fname_weight) as f:
+            toks = f.read().split()
+        nrow, ncol, nnz = int(toks[0]), int(toks[1]), int(toks[2])
+        if (nrow, ncol) != w.shape:
+            raise ValueError('FixConnLayer: weight shape mismatch')
+        for i in range(nnz):
+            r, c, v = toks[3 + 3 * i:6 + 3 * i]
+            w[int(r), int(c)] = float(v)
+        self._wmat = jnp.asarray(w)
+        return [NodeSpec(1, 1, self.param.num_hidden)]
+
+    def forward(self, params, inputs, ctx):
+        return [as_mat(inputs[0]) @ self._wmat.T]

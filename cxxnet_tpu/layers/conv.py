@@ -1,0 +1,73 @@
+"""Convolution layer.
+
+TPU-native replacement for the reference's im2col-GEMM convolution
+(``src/layer/convolution_layer-inl.hpp:70-155``) and its cuDNN override
+(``cudnn_convolution_layer-inl.hpp``): forward and both backward passes
+lower to ``lax.conv_general_dilated`` in NHWC/HWIO layout, which XLA tiles
+directly onto the MXU — no explicit column buffer, so the reference's
+``temp_col_max`` chunking knob is accepted but has no effect on memory.
+
+Grouped convolution (``ngroup``) maps to ``feature_group_count``.
+Output spatial size matches the reference exactly:
+``(in + 2*pad - k) / stride + 1`` (floor).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+from jax import lax
+
+from .base import Layer, NodeSpec, kConv, register_layer
+
+
+@register_layer
+class ConvolutionLayer(Layer):
+    type_name = 'conv'
+    type_id = kConv
+    param_fields = ('wmat', 'bias')
+
+    def infer_shapes(self, in_specs: List[NodeSpec]) -> List[NodeSpec]:
+        assert len(in_specs) == 1, 'conv: only supports 1-1 connection'
+        p = self.param
+        s = in_specs[0]
+        if p.num_channel <= 0:
+            raise ValueError('conv: must set nchannel correctly')
+        if p.kernel_height <= 0 or p.kernel_width <= 0:
+            raise ValueError('conv: must set kernel_size correctly')
+        if s.c % p.num_group or p.num_channel % p.num_group:
+            raise ValueError('conv: channels must be divisible by ngroup')
+        p.num_input_channel = s.c
+        oy = (s.y + 2 * p.pad_y - p.kernel_height) // p.stride + 1
+        ox = (s.x + 2 * p.pad_x - p.kernel_width) // p.stride + 1
+        if oy <= 0 or ox <= 0:
+            raise ValueError('conv: kernel larger than padded input')
+        return [NodeSpec(p.num_channel, oy, ox)]
+
+    def init_params(self, rng, in_specs, dtype=jnp.float32):
+        p = self.param
+        cin_g = in_specs[0].c // p.num_group
+        # HWIO layout for lax.conv; fan numbers match the reference's
+        # (ngroup, nch/g, nin/g*kh*kw) weight: in = nin/g*kh*kw, out = nch/g
+        shape = (p.kernel_height, p.kernel_width, cin_g, p.num_channel)
+        in_num = cin_g * p.kernel_height * p.kernel_width
+        out_num = p.num_channel // p.num_group
+        out = {'wmat': p.rand_init_weight(rng, shape, in_num, out_num, dtype)}
+        if p.no_bias == 0:
+            out['bias'] = jnp.full((p.num_channel,), p.init_bias, dtype)
+        return out
+
+    def forward(self, params, inputs, ctx):
+        p = self.param
+        x = inputs[0]  # (b, y, x, c)
+        out = lax.conv_general_dilated(
+            x, params['wmat'],
+            window_strides=(p.stride, p.stride),
+            padding=((p.pad_y, p.pad_y), (p.pad_x, p.pad_x)),
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
+            feature_group_count=p.num_group,
+            preferred_element_type=jnp.float32)
+        if p.no_bias == 0:
+            out = out + params['bias']
+        return [out.astype(x.dtype)]

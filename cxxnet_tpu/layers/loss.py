@@ -1,0 +1,109 @@
+"""Loss layers: softmax, l2_loss, multi_logistic.
+
+The reference loss layers are self-loop layers that overwrite the node with
+the forward transform, then overwrite it again with the hand-set gradient on
+a CPU roundtrip (``src/layer/loss/loss_layer_base-inl.hpp:87-96``).  Here the
+forward transform stays for metrics/prediction, and each layer contributes a
+scalar loss whose ``jax.grad`` equals the reference's hand-set gradient —
+entirely on device, no D2H:
+
+* softmax  (``loss/softmax_layer-inl.hpp``): grad p - onehot(y)  ⇔ CE loss
+* l2_loss  (``loss/l2_loss_layer-inl.hpp``): grad pred - label  ⇔ 0.5*SSE
+* multi_logistic (``loss/multi_logistic_layer-inl.hpp``): grad p - y ⇔ BCE
+
+All are scaled by ``grad_scale / (batch_size * update_period)``
+(loss_layer_base:61-63) — note ``batch_size`` is the *global* batch size.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import (Layer, NodeSpec, as_mat, kL2Loss, kMultiLogistic,
+                   kSoftmax, register_layer)
+
+
+class LossLayerBase(Layer):
+    """Self-loop loss layer (``loss_layer_base-inl.hpp:14-63``)."""
+
+    def __init__(self, name=''):
+        super().__init__(name)
+        self.target = 'label'
+        self.grad_scale = 1.0
+        self.batch_size = 1
+        self.update_period = 1
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == 'target':
+            self.target = val
+        if name == 'grad_scale':
+            self.grad_scale = float(val)
+        if name == 'batch_size':
+            self.batch_size = int(val)
+        if name == 'update_period':
+            self.update_period = int(val)
+
+    @property
+    def is_loss(self):
+        return True
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1, 'LossLayer: only supports 1-1 connection'
+        return [in_specs[0]]
+
+    @property
+    def scale(self) -> float:
+        return self.grad_scale / (self.batch_size * self.update_period)
+
+    def loss(self, params, inputs, labels, ctx, mask=None):
+        """Scalar loss.  labels: (batch, label_width) for this layer's
+        target field; mask: optional (batch,) 0/1 instance weights for
+        padded tail batches."""
+        per_inst = self._per_instance_loss(as_mat(inputs[0]), labels)
+        if mask is not None:
+            per_inst = per_inst * mask
+        return jnp.sum(per_inst) * self.scale
+
+    def _per_instance_loss(self, x, labels):
+        raise NotImplementedError
+
+
+@register_layer
+class SoftmaxLayer(LossLayerBase):
+    type_name = 'softmax'
+    type_id = kSoftmax
+
+    def forward(self, params, inputs, ctx):
+        return [jax.nn.softmax(as_mat(inputs[0]), axis=-1)]
+
+    def _per_instance_loss(self, x, labels):
+        logp = jax.nn.log_softmax(x, axis=-1)
+        idx = labels[:, 0].astype(jnp.int32)
+        return -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+
+
+@register_layer
+class L2LossLayer(LossLayerBase):
+    type_name = 'l2_loss'
+    type_id = kL2Loss
+
+    def forward(self, params, inputs, ctx):
+        return [inputs[0]]
+
+    def _per_instance_loss(self, x, labels):
+        return 0.5 * jnp.sum((x - labels) ** 2, axis=-1)
+
+
+@register_layer
+class MultiLogisticLayer(LossLayerBase):
+    type_name = 'multi_logistic'
+    type_id = kMultiLogistic
+
+    def forward(self, params, inputs, ctx):
+        return [jax.nn.sigmoid(as_mat(inputs[0]))]
+
+    def _per_instance_loss(self, x, labels):
+        # sum of binary cross-entropies with logits x; d/dx = sigmoid(x)-y
+        return jnp.sum(jnp.logaddexp(0.0, x) - x * labels, axis=-1)

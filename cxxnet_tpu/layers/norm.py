@@ -1,0 +1,101 @@
+"""Normalization layers: LRN and BatchNorm.
+
+LRN (``src/layer/lrn_layer-inl.hpp:46-57``): cross-channel response
+normalization, ``out = x * (knorm + alpha/n * sum_{window} x^2)^(-beta)``
+with a centered channel window of ``local_size``.
+
+BatchNorm (``src/layer/batch_norm_layer-inl.hpp``): per-channel (conv) or
+per-feature (fc).  The reference keeps **no running averages — evaluation
+also normalizes with current-minibatch statistics** (doc/layer.md:258); we
+reproduce that exactly (a parity quirk worth revisiting).  eps default 1e-10;
+learnable slope is visited under the 'wmat' tag, bias under 'bias'.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import Layer, NodeSpec, kBatchNorm, kLRN, register_layer
+
+
+@register_layer
+class LRNLayer(Layer):
+    type_name = 'lrn'
+    type_id = kLRN
+
+    def __init__(self, name=''):
+        super().__init__(name)
+        self.knorm = 1.0
+        self.nsize = 3
+        self.alpha = 0.001
+        self.beta = 0.75
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == 'local_size':
+            self.nsize = int(val)
+        if name == 'alpha':
+            self.alpha = float(val)
+        if name == 'beta':
+            self.beta = float(val)
+        if name == 'knorm':
+            self.knorm = float(val)
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1, 'lrn: only supports 1-1 connection'
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0]  # (b, y, x, c)
+        n = self.nsize
+        half_lo = (n - 1) // 2
+        half_hi = n - 1 - half_lo
+        sq = x * x
+        # cross-channel window sum via cumulative sum along the channel axis
+        c = x.shape[-1]
+        pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half_lo + 1, half_hi)])
+        cums = jnp.cumsum(pad, axis=-1)
+        window = (cums[..., n:n + c] - cums[..., 0:c])
+        norm = window * (self.alpha / n) + self.knorm
+        return [x * jnp.power(norm, -self.beta)]
+
+
+@register_layer
+class BatchNormLayer(Layer):
+    type_name = 'batch_norm'
+    type_id = kBatchNorm
+    param_fields = ('wmat', 'bias')   # slope under 'wmat', bias under 'bias'
+
+    def __init__(self, name=''):
+        super().__init__(name)
+        self.init_slope = 1.0
+        self.init_bias = 0.0
+        self.eps = 1e-10
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == 'init_slope':
+            self.init_slope = float(val)
+        if name == 'init_bias':
+            self.init_bias = float(val)
+        if name == 'eps':
+            self.eps = float(val)
+
+    def infer_shapes(self, in_specs):
+        assert len(in_specs) == 1, 'batch_norm: only supports 1-1 connection'
+        s = in_specs[0]
+        self._channels = s.x if s.is_mat else s.c
+        return [s]
+
+    def init_params(self, rng, in_specs, dtype=jnp.float32):
+        return {'wmat': jnp.full((self._channels,), self.init_slope, dtype),
+                'bias': jnp.full((self._channels,), self.init_bias, dtype)}
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0]
+        axes = tuple(range(x.ndim - 1))   # all but trailing channel/feature
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean((x - mean) ** 2, axis=axes)
+        # batch statistics at train AND eval — the reference quirk
+        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        return [xhat * params['wmat'] + params['bias']]

@@ -1,0 +1,75 @@
+"""PairTest — in-graph differential testing layer.
+
+Rebuilds the reference's ``pairtest-A-B`` harness
+(``src/layer/pairtest_layer-inl.hpp:75-199``): a master and a slave
+implementation of the same layer type run side by side on identical inputs
+and shared weights; outputs are compared with relative tolerance 1e-5 and
+mismatches reported (here via ``jax.debug.print`` from inside the jitted
+graph).  Per-side overrides use the reference's ``master:``/``slave:``
+param prefixes (pairtest:127-136).  The graph output is the master's.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import ForwardContext, Layer, create_layer, layer_type_name
+
+
+class PairTestLayer(Layer):
+    type_name = 'pairtest'
+
+    def __init__(self, master_type: int, slave_type: int, name=''):
+        super().__init__(name)
+        self.type_id = 1024 * master_type + slave_type
+        self.master = create_layer(master_type, name=name)
+        self.slave = create_layer(slave_type, name=name)
+        self.tol = 1e-5
+        self.type_name = (f'pairtest-{layer_type_name(master_type)}'
+                          f'-{layer_type_name(slave_type)}')
+
+    @property
+    def param_fields(self):
+        return self.master.param_fields
+
+    def set_param(self, name, val):
+        if name.startswith('master:'):
+            self.master.set_param(name[len('master:'):], val)
+        elif name.startswith('slave:'):
+            self.slave.set_param(name[len('slave:'):], val)
+        else:
+            self.master.set_param(name, val)
+            self.slave.set_param(name, val)
+            if name == 'pairtest_tol':
+                self.tol = float(val)
+
+    def infer_shapes(self, in_specs):
+        out_m = self.master.infer_shapes(in_specs)
+        out_s = self.slave.infer_shapes(list(in_specs))
+        for a, b in zip(out_m, out_s):
+            if a != b:
+                raise ValueError(
+                    f'{self.type_name}: master/slave output shapes differ: '
+                    f'{a} vs {b}')
+        return out_m
+
+    def init_params(self, rng, in_specs, dtype=jnp.float32):
+        # weights are shared: the slave reuses the master's params
+        # (reference syncs them at init, pairtest:137-141)
+        return self.master.init_params(rng, in_specs, dtype)
+
+    def forward(self, params, inputs, ctx: ForwardContext):
+        out_m = self.master.forward(params, inputs, ctx)
+        out_s = self.slave.forward(params, inputs, ctx)
+        tol = self.tol
+        lname = self.type_name
+        for i, (a, b) in enumerate(zip(out_m, out_s)):
+            err = jnp.max(jnp.abs(a - b) / (jnp.abs(a) + jnp.abs(b) + 1e-6))
+            jax.lax.cond(
+                err > tol,
+                lambda e: jax.debug.print(
+                    'PairTest MISMATCH {l} out[{i}]: rel-err {e}',
+                    l=lname, i=i, e=e),
+                lambda e: None, err)
+        return out_m

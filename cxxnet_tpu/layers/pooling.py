@@ -1,0 +1,146 @@
+"""Pooling layers (max / sum / avg / relu_max / insanity_max).
+
+TPU-native replacement for ``src/layer/pooling_layer-inl.hpp`` (mshadow
+``pool<Reducer>`` expressions) via ``lax.reduce_window``.  Semantics kept
+from the reference:
+
+* output size is the "ceil" formula
+  ``min(in - k + stride - 1, in - 1) / stride + 1`` (pooling_layer:103-105),
+  with edge windows clamped to the input;
+* ``avg_pooling`` divides by the *full* window size ``kh*kw`` even for
+  clamped edge windows (pooling_layer:47-49);
+* ``relu_max_pooling`` fuses a relu before pooling (layer_impl-inl.hpp:55);
+* ``insanity_max_pooling`` jitters each source pixel to a random clamped
+  neighbor before max pooling at train time, exact pooling at eval
+  (``insanity_pooling_layer-inl.hpp:64-99,245-258``).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import (Layer, NodeSpec, kAvgPooling, kInsanityPooling,
+                   kMaxPooling, kReluMaxPooling, kSumPooling, register_layer)
+
+
+def pool_out_dim(in_dim: int, k: int, stride: int) -> int:
+    return min(in_dim - k + stride - 1, in_dim - 1) // stride + 1
+
+
+def _reduce_pool(x, ky, kx, stride, mode):
+    """x: (b, y, x, c) → pooled with clamped edge windows."""
+    oy = pool_out_dim(x.shape[1], ky, stride)
+    ox = pool_out_dim(x.shape[2], kx, stride)
+    pad_y = max((oy - 1) * stride + ky - x.shape[1], 0)
+    pad_x = max((ox - 1) * stride + kx - x.shape[2], 0)
+    if mode == 'max':
+        init, op = -jnp.inf, lax.max
+    else:
+        init, op = 0.0, lax.add
+    out = lax.reduce_window(
+        x, jnp.asarray(init, x.dtype), op,
+        window_dimensions=(1, ky, kx, 1),
+        window_strides=(1, stride, stride, 1),
+        padding=((0, 0), (0, pad_y), (0, pad_x), (0, 0)))
+    return out
+
+
+class _PoolingBase(Layer):
+    mode = 'max'
+    pre_relu = False
+
+    def infer_shapes(self, in_specs: List[NodeSpec]) -> List[NodeSpec]:
+        assert len(in_specs) == 1, 'pooling: only supports 1-1 connection'
+        p, s = self.param, in_specs[0]
+        if p.kernel_height <= 0 or p.kernel_width <= 0:
+            raise ValueError('pooling: must set kernel_size correctly')
+        if p.kernel_width > s.x or p.kernel_height > s.y:
+            raise ValueError('pooling: kernel size exceeds input')
+        return [NodeSpec(s.c,
+                         pool_out_dim(s.y, p.kernel_height, p.stride),
+                         pool_out_dim(s.x, p.kernel_width, p.stride))]
+
+    def forward(self, params, inputs, ctx):
+        p = self.param
+        x = inputs[0]
+        if self.pre_relu:
+            x = jnp.maximum(x, 0.0)
+        out = _reduce_pool(x, p.kernel_height, p.kernel_width, p.stride,
+                           self.mode)
+        if self.mode == 'avg':
+            out = out * (1.0 / (p.kernel_height * p.kernel_width))
+        return [out]
+
+
+@register_layer
+class MaxPoolingLayer(_PoolingBase):
+    type_name = 'max_pooling'
+    type_id = kMaxPooling
+    mode = 'max'
+
+
+@register_layer
+class SumPoolingLayer(_PoolingBase):
+    type_name = 'sum_pooling'
+    type_id = kSumPooling
+    mode = 'sum'
+
+
+@register_layer
+class AvgPoolingLayer(_PoolingBase):
+    type_name = 'avg_pooling'
+    type_id = kAvgPooling
+    mode = 'avg'
+
+
+@register_layer
+class ReluMaxPoolingLayer(_PoolingBase):
+    type_name = 'relu_max_pooling'
+    type_id = kReluMaxPooling
+    mode = 'max'
+    pre_relu = True
+
+
+@register_layer
+class InsanityPoolingLayer(_PoolingBase):
+    """Stochastic-jitter max pooling.  Because the reference's jitter target
+    depends only on the source coordinate (not the window), jitter-then-pool
+    over a pre-gathered image is exactly equivalent to its per-window-read
+    formulation — and it vectorizes as five shifted copies + select."""
+
+    type_name = 'insanity_max_pooling'
+    type_id = kInsanityPooling
+    mode = 'max'
+
+    def __init__(self, name=''):
+        super().__init__(name)
+        self.p_keep = 1.0
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == 'keep':
+            self.p_keep = float(val)
+
+    def forward(self, params, inputs, ctx):
+        p = self.param
+        x = inputs[0]
+        if ctx.is_train and self.p_keep < 1.0:
+            u = jax.random.uniform(ctx.layer_rng(), x.shape, x.dtype)
+            delta = (1.0 - self.p_keep) / 4.0
+            # clamped single-pixel shifts along y then x
+            up = jnp.concatenate([x[:, :1], x[:, :-1]], axis=1)
+            down = jnp.concatenate([x[:, 1:], x[:, -1:]], axis=1)
+            left = jnp.concatenate([x[:, :, :1], x[:, :, :-1]], axis=2)
+            right = jnp.concatenate([x[:, :, 1:], x[:, :, -1:]], axis=2)
+            x = jnp.select(
+                [u < self.p_keep,
+                 u < self.p_keep + delta,
+                 u < self.p_keep + 2 * delta,
+                 u < self.p_keep + 3 * delta],
+                [x, up, down, left], default=right)
+        out = _reduce_pool(x, p.kernel_height, p.kernel_width, p.stride, 'max')
+        return [out]

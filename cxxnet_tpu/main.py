@@ -1,0 +1,278 @@
+"""Config-file-driven task CLI.
+
+Equivalent of the reference driver (``src/cxxnet_main.cpp:16-478``)::
+
+    python -m cxxnet_tpu.main config.conf [k=v ...]
+
+Tasks (``task=``): ``train`` (default), ``finetune``, ``pred``, ``extract``.
+Counter/checkpoint choreography preserved: model files are
+``model_dir/%04d.model`` with an int ``net_type`` prefix; ``continue=1``
+scans forward from ``start_counter`` to resume from the newest checkpoint
+(``cxxnet_main.cpp:135-157``); eval output goes to **stderr** as
+``[round]\\tname-metric:value``; ``test_io=1`` runs the loop without compute.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from .io.data import create_iterator
+from .nnet.trainer import NetTrainer
+from .utils.config import apply_cli_overrides, parse_config_file
+
+ConfigEntry = Tuple[str, str]
+
+
+class LearnTask:
+    def __init__(self):
+        self.task = 'train'
+        self.net_type = 0
+        self.reset_net_type = -1
+        self.print_step = 100
+        self.continue_training = 0
+        self.save_period = 1
+        self.start_counter = 0
+        self.name_model_in = 'NULL'
+        self.name_model_dir = 'models'
+        self.num_round = 10
+        self.max_round = 2147483647
+        self.silent = 0
+        self.device = 'tpu'
+        self.test_io = 0
+        self.extract_node_name = ''
+        self.name_pred = 'pred.txt'
+        self.output_format = 1
+        self.cfg: List[ConfigEntry] = []
+        self.net_trainer: Optional[NetTrainer] = None
+        self.itr_train = None
+        self.itr_evals = []
+        self.eval_names = []
+        self.itr_pred = None
+
+    def set_param(self, name: str, val: str) -> None:
+        if val == 'default':
+            return
+        simple = {
+            'net_type': ('net_type', int), 'reset_net_type': ('reset_net_type', int),
+            'print_step': ('print_step', int), 'continue': ('continue_training', int),
+            'save_model': ('save_period', int), 'start_counter': ('start_counter', int),
+            'model_in': ('name_model_in', str), 'model_dir': ('name_model_dir', str),
+            'num_round': ('num_round', int), 'max_round': ('max_round', int),
+            'silent': ('silent', int), 'task': ('task', str), 'dev': ('device', str),
+            'test_io': ('test_io', int), 'extract_node_name': ('extract_node_name', str),
+        }
+        if name in simple:
+            attr, typ = simple[name]
+            setattr(self, attr, typ(val))
+        if name == 'output_format':
+            self.output_format = 1 if val == 'txt' else 0
+        self.cfg.append((name, val))
+
+    # --- setup ------------------------------------------------------------
+    def _create_net(self) -> NetTrainer:
+        if self.reset_net_type != -1:
+            self.net_type = self.reset_net_type
+        return NetTrainer(self.cfg)
+
+    def _model_path(self, counter: int) -> str:
+        return os.path.join(self.name_model_dir, f'{counter:04d}.model')
+
+    def _sync_latest_model(self) -> bool:
+        s = self.start_counter
+        last = None
+        while os.path.exists(self._model_path(s)):
+            last = self._model_path(s)
+            s += 1
+        if last is None:
+            return False
+        with open(last, 'rb') as f:
+            self.net_type = int.from_bytes(f.read(4), 'little', signed=True)
+            self.net_trainer = self._create_net()
+            self.net_trainer.load_model(f)
+        self.start_counter = s
+        return True
+
+    def _load_model(self) -> None:
+        base = os.path.basename(self.name_model_in)
+        stem = base.split('.')[0]
+        if stem.isdigit():
+            self.start_counter = int(stem)
+        with open(self.name_model_in, 'rb') as f:
+            self.net_type = int.from_bytes(f.read(4), 'little', signed=True)
+            self.net_trainer = self._create_net()
+            self.net_trainer.load_model(f)
+        self.start_counter += 1
+
+    def _copy_model(self) -> None:
+        self.net_trainer = self._create_net()
+        with open(self.name_model_in, 'rb') as f:
+            f.read(4)
+            self.net_trainer.copy_model_from(f)
+
+    def _save_model(self) -> None:
+        path = self._model_path(self.start_counter)
+        self.start_counter += 1
+        if self.save_period == 0 or self.start_counter % self.save_period != 0:
+            return
+        os.makedirs(self.name_model_dir, exist_ok=True)
+        with open(path, 'wb') as f:
+            f.write(int(self.net_type).to_bytes(4, 'little', signed=True))
+            self.net_trainer.save_model(f)
+
+    def _create_iterators(self) -> None:
+        flag = 0
+        evname = ''
+        itcfg: List[ConfigEntry] = []
+        defcfg: List[ConfigEntry] = []
+        for name, val in self.cfg:
+            if name == 'data':
+                flag = 1
+                continue
+            if name == 'eval':
+                evname = val
+                flag = 2
+                continue
+            if name == 'pred':
+                flag = 3
+                self.name_pred = val
+                continue
+            if name == 'iter' and val == 'end':
+                assert flag != 0, 'wrong configuration file'
+                if flag == 1 and self.task != 'pred':
+                    assert self.itr_train is None, 'can only have one data'
+                    self.itr_train = create_iterator(itcfg)
+                if flag == 2 and self.task != 'pred':
+                    self.itr_evals.append(create_iterator(itcfg))
+                    self.eval_names.append(evname)
+                if flag == 3 and self.task in ('pred', 'extract'):
+                    assert self.itr_pred is None, 'only one pred section'
+                    self.itr_pred = create_iterator(itcfg)
+                flag = 0
+                itcfg = []
+                continue
+            if flag == 0:
+                defcfg.append((name, val))
+            else:
+                itcfg.append((name, val))
+        for it in ([self.itr_train] if self.itr_train else []) + \
+                ([self.itr_pred] if self.itr_pred else []) + self.itr_evals:
+            for name, val in defcfg:
+                it.set_param(name, val)
+            it.init()
+
+    def init(self) -> None:
+        if self.task == 'train' and self.continue_training:
+            if not self._sync_latest_model():
+                raise RuntimeError(
+                    'Init: cannot find models to continue training; '
+                    'specify model_in instead')
+            print(f'Init: Continue training from round {self.start_counter}')
+            self._create_iterators()
+            return
+        self.continue_training = 0
+        if self.name_model_in == 'NULL':
+            assert self.task == 'train', 'must specify model_in if not training'
+            self.net_trainer = self._create_net()
+            self.net_trainer.init_model()
+        elif self.task == 'finetune':
+            self._copy_model()
+        else:
+            self._load_model()
+        self._create_iterators()
+
+    # --- tasks ------------------------------------------------------------
+    def task_train(self) -> None:
+        start = time.time()
+        if self.continue_training == 0 and self.name_model_in == 'NULL':
+            self._save_model()
+        else:
+            for it, name in zip(self.itr_evals, self.eval_names):
+                sys.stderr.write(self.net_trainer.evaluate(it, name))
+            sys.stderr.write('\n')
+            sys.stderr.flush()
+        if self.itr_train is None:
+            return
+        if self.test_io:
+            print('start I/O test')
+        cc = self.max_round
+        while self.start_counter <= self.num_round and cc > 0:
+            cc -= 1
+            if not self.silent:
+                print(f'update round {self.start_counter - 1}', flush=True)
+            sample_counter = 0
+            self.net_trainer.start_round(self.start_counter)
+            for batch in self.itr_train:
+                if self.test_io == 0:
+                    self.net_trainer.update(batch)
+                sample_counter += 1
+                if sample_counter % self.print_step == 0 and not self.silent:
+                    elapsed = int(time.time() - start)
+                    print(f'round {self.start_counter - 1:8d}:'
+                          f'[{sample_counter:8d}] {elapsed} sec elapsed',
+                          flush=True)
+            if self.test_io == 0:
+                sys.stderr.write(f'[{self.start_counter}]')
+                if not self.itr_evals:
+                    sys.stderr.write(self.net_trainer.evaluate(None, 'train'))
+                for it, name in zip(self.itr_evals, self.eval_names):
+                    sys.stderr.write(self.net_trainer.evaluate(it, name))
+                sys.stderr.write('\n')
+                sys.stderr.flush()
+            self._save_model()
+        if not self.silent:
+            print(f'\nupdating end, {int(time.time() - start)} sec in all')
+
+    def task_predict(self) -> None:
+        assert self.itr_pred is not None, 'must specify a pred iterator'
+        print('start predicting...')
+        with open(self.name_pred, 'w') as fo:
+            for batch in self.itr_pred:
+                pred = self.net_trainer.predict(batch)
+                for v in pred:
+                    fo.write(f'{v:g}\n')
+        print(f'finished prediction, write into {self.name_pred}')
+
+    def task_extract(self) -> None:
+        assert self.itr_pred is not None, 'must specify a pred iterator'
+        node = self.extract_node_name or 'top[-1]'
+        print(f'start extracting feature from {node}...')
+        import numpy as np
+        feats = []
+        for batch in self.itr_pred:
+            feats.append(self.net_trainer.extract_feature(batch, node))
+        out = np.concatenate(feats, axis=0)
+        if self.output_format == 1:
+            np.savetxt(self.name_pred, out.reshape(out.shape[0], -1), '%g')
+        else:
+            out.astype('<f4').tofile(self.name_pred)
+        print(f'finished extract, write into {self.name_pred}')
+
+    def run(self, argv: List[str]) -> int:
+        if not argv:
+            print('Usage: <config> [k=v ...]')
+            return 0
+        cfg = parse_config_file(argv[0])
+        cfg = apply_cli_overrides(cfg, argv[1:])
+        for name, val in cfg:
+            self.set_param(name, val)
+        self.init()
+        if not self.silent:
+            print('initializing end, start working')
+        if self.task in ('train', 'finetune'):
+            self.task_train()
+        elif self.task == 'pred':
+            self.task_predict()
+        elif self.task == 'extract':
+            self.task_extract()
+        return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return LearnTask().run(argv if argv is not None else sys.argv[1:])
+
+
+if __name__ == '__main__':
+    sys.exit(main())

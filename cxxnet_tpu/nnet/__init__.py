@@ -1,0 +1,5 @@
+"""Trainer core: NetConfig grammar, graph executor, trainer, checkpoints."""
+
+from .net import LabelInfo, Net
+from .net_config import NetConfig
+from .trainer import NetTrainer

@@ -1,0 +1,168 @@
+"""Model-blob serialization (checkpoint weight payload).
+
+Mirrors the reference layout (``SaveModel`` per layer: LayerParam struct +
+weight tensors, ``fullc_layer-inl.hpp:46-60``): the blob is the
+concatenation of every non-shared layer's record, in layer order.  Weight
+layouts on disk follow the reference conventions so tooling stays
+interoperable:
+
+* fullc ``wmat``: ``(nhidden, nin)`` (in-memory we keep ``(nin, nhidden)``),
+* conv ``wmat``: ``(ngroup, nch/g, nin/g * kh * kw)`` im2col layout
+  (in-memory HWIO),
+* 1-D ``bias``/slope tensors unchanged.
+
+Tensors are stored self-describing as (uint32 ndim, uint32 shape[ndim],
+float32 data), matching mshadow's shape+data ``SaveBinary`` convention.
+The LayerParam struct (328 bytes: 18 fields + 64 reserved ints,
+``layer/param.h:15-76``) is written for layers that save it in the
+reference (fullc, conv, bias, fixconn); batch_norm/prelu save tensors only.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..layers import base as lbase
+
+_LAYER_PARAM = struct.Struct('<ifif f iiiiiiiii iiii 64i')
+assert _LAYER_PARAM.size == 328
+
+
+def _pack_layer_param(p: lbase.LayerParam) -> bytes:
+    return _LAYER_PARAM.pack(
+        p.num_hidden, p.init_sigma, p.init_sparse, p.init_uniform,
+        p.init_bias, p.num_channel, p.random_type, p.num_group,
+        p.kernel_height, p.kernel_width, p.stride, p.pad_y, p.pad_x,
+        p.no_bias, p.temp_col_max, p.silent, p.num_input_channel,
+        p.num_input_node, *([0] * 64))
+
+
+def _write_tensor(out: bytearray, arr: np.ndarray) -> None:
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    out += struct.pack('<I', arr.ndim)
+    out += struct.pack(f'<{arr.ndim}I', *arr.shape)
+    out += arr.tobytes()
+
+
+def _read_tensor(buf: bytes, pos: int):
+    (ndim,) = struct.unpack_from('<I', buf, pos)
+    pos += 4
+    shape = struct.unpack_from(f'<{ndim}I', buf, pos)
+    pos += 4 * ndim
+    n = int(np.prod(shape)) if ndim else 1
+    arr = np.frombuffer(buf, np.float32, count=n, offset=pos).reshape(shape)
+    pos += 4 * n
+    return arr.copy(), pos
+
+
+# layers whose reference SaveModel begins with the LayerParam struct
+_SAVES_PARAM_STRUCT = {lbase.kFullConnect, lbase.kConv, lbase.kBias,
+                       lbase.kFixConnect}
+
+
+def layer_fields(type_id: int):
+    """Field save order per layer type (reference SaveModel order)."""
+    if type_id in (lbase.kFullConnect, lbase.kConv, lbase.kBatchNorm):
+        return ('wmat', 'bias')
+    if type_id in (lbase.kPRelu, lbase.kBias):
+        return ('bias',)
+    return ()
+
+
+def to_disk_layout(type_id: int, field: str, arr: np.ndarray,
+                   num_group: int) -> np.ndarray:
+    if type_id == lbase.kFullConnect and field == 'wmat':
+        return arr.T                                  # (nin,nh) → (nh,nin)
+    if type_id == lbase.kConv and field == 'wmat':
+        kh, kw, cin_g, cout = arr.shape
+        g = num_group
+        # HWIO → (g, cout/g, cin_g, kh, kw) → (g, cout/g, cin_g*kh*kw)
+        a = arr.transpose(3, 2, 0, 1).reshape(g, cout // g, cin_g, kh, kw)
+        return a.reshape(g, cout // g, cin_g * kh * kw)
+    return arr
+
+
+def from_disk_layout(type_id: int, field: str, arr: np.ndarray,
+                     layer) -> np.ndarray:
+    if type_id == lbase.kFullConnect and field == 'wmat':
+        return arr.T
+    if type_id == lbase.kConv and field == 'wmat':
+        g, cout_g, flat = arr.shape
+        p = layer.param
+        cin_g = flat // (p.kernel_height * p.kernel_width)
+        a = arr.reshape(g, cout_g, cin_g, p.kernel_height, p.kernel_width)
+        return a.transpose(3, 4, 2, 0, 1).reshape(
+            p.kernel_height, p.kernel_width, cin_g, g * cout_g)
+    return arr
+
+
+def params_to_blob(net, params) -> bytes:
+    out = bytearray()
+    host = {k: {f: np.asarray(v) for f, v in d.items()}
+            for k, d in params.items()}
+    for i, info in enumerate(net.cfg.layers):
+        if net.layer_primary[i] != i or info.type == lbase.kSharedLayer:
+            continue
+        layer = net.layers[i]
+        fields = layer_fields(info.type)
+        if not fields:
+            continue
+        if info.type in _SAVES_PARAM_STRUCT:
+            out += _pack_layer_param(layer.param)
+        lp = host.get(str(i), {})
+        for f in fields:
+            if f not in lp:   # e.g. no_bias fullc still saves a bias slot
+                n = layer.param.num_channel or max(layer.param.num_hidden, 1)
+                arr = np.zeros((n,), np.float32)
+            else:
+                arr = to_disk_layout(info.type, f, lp[f],
+                                     layer.param.num_group)
+            _write_tensor(out, arr)
+    return bytes(out)
+
+
+def blob_to_raw(cfg_layers, blob: bytes) -> Dict[str, Dict[str, np.ndarray]]:
+    """Parse a blob into disk-layout arrays keyed by layer index/field."""
+    params: Dict[str, Dict[str, np.ndarray]] = {}
+    pos = 0
+    for i, info in enumerate(cfg_layers):
+        if info.type == lbase.kSharedLayer:
+            continue
+        fields = layer_fields(info.type)
+        if not fields:
+            continue
+        if info.type in _SAVES_PARAM_STRUCT:
+            pos += _LAYER_PARAM.size
+        rec = {}
+        for f in fields:
+            arr, pos = _read_tensor(blob, pos)
+            rec[f] = arr
+        params[str(i)] = rec
+    return params
+
+
+def record_to_memory(layer, type_id: int,
+                     rec: Dict[str, np.ndarray]) -> Dict:
+    """Disk-layout record → in-memory param dict for a built layer."""
+    out = {}
+    for f, arr in rec.items():
+        if f == 'bias' and layer.param.no_bias and \
+                type_id in (lbase.kFullConnect, lbase.kConv):
+            continue   # slot present on disk but unused in memory
+        out[f] = jnp.asarray(from_disk_layout(type_id, f, arr, layer))
+    return out
+
+
+def blob_to_params(net, blob: bytes):
+    raw = blob_to_raw(net.cfg.layers, blob)
+    params = {}
+    for i, info in enumerate(net.cfg.layers):
+        key = str(i)
+        if key not in raw:
+            continue
+        params[key] = record_to_memory(net.layers[i], info.type, raw[key])
+    return params

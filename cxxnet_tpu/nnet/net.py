@@ -1,0 +1,203 @@
+"""Functional neural-net graph executor.
+
+The TPU-native replacement for the reference's per-device mutable replica
+(``src/nnet/neural_net-inl.hpp:22-250``): where the reference allocates node
+tensors and sweeps Forward/Backprop over connections in place, this builds a
+**pure function** of ``(params, batch, labels, rng)`` that XLA compiles into
+one fused program.  Backward comes from ``jax.grad`` of the summed loss —
+per-layer hand-written gradients are unnecessary because every loss layer's
+scalar is constructed so its autodiff gradient equals the reference's
+hand-set one (see layers/loss.py).
+
+Layout: activations are NHWC; the input node accepts NCHW host batches
+(the reference/data-pipeline layout) and transposes once on device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..layers import ForwardContext, NodeSpec, create_layer
+from ..layers.base import kSharedLayer, Layer
+from ..layers.common import SplitLayer
+from ..layers.loss import LossLayerBase
+from .net_config import NetConfig
+
+Params = Dict[str, Dict[str, jax.Array]]
+
+
+class LabelInfo:
+    """Named label-field views over the raw label matrix
+    (``layer/layer.h:77-121`` + slicing at ``nnet_impl-inl.hpp:271-285``)."""
+
+    def __init__(self, label_mat, name_map: Dict[str, int],
+                 ranges: List[tuple]):
+        self._mat = label_mat
+        self._name_map = name_map
+        self._ranges = ranges
+
+    def field(self, name: str):
+        if name not in self._name_map:
+            raise KeyError(f'unknown label target = {name}')
+        a, b = self._ranges[self._name_map[name]]
+        return self._mat[:, a:b]
+
+
+class Net:
+    """A compiled-graph view of a NetConfig."""
+
+    def __init__(self, cfg: NetConfig):
+        self.cfg = cfg
+        self.layers: List[Layer] = []
+        self.layer_primary: List[int] = []   # index of the params owner
+        # instantiate layers; shared entries alias the primary layer object
+        # (neural_net-inl.hpp:216-250)
+        for i, info in enumerate(cfg.layers):
+            if info.type == kSharedLayer:
+                primary = cfg.layers[info.primary_layer_index]
+                layer = self.layers[info.primary_layer_index]
+                if not layer.allow_sharing():
+                    raise ValueError(
+                        f'layer {primary.name} does not allow sharing')
+                self.layers.append(layer)
+                self.layer_primary.append(info.primary_layer_index)
+            else:
+                self.layers.append(create_layer(info.type, name=info.name))
+                self.layer_primary.append(i)
+        # configure: global defaults first, then layer-scoped pairs
+        # (neural_net-inl.hpp:252-264)
+        for i, layer in enumerate(self.layers):
+            if self.layer_primary[i] != i:
+                continue
+            for name, val in cfg.defcfg:
+                layer.set_param(name, val)
+            for name, val in cfg.layercfg[i]:
+                layer.set_param(name, val)
+        # split layers need their fan-out before shape inference
+        for i, info in enumerate(cfg.layers):
+            if isinstance(self.layers[i], SplitLayer):
+                self.layers[i].set_num_outputs(len(info.nindex_out))
+        self._infer_shapes()
+
+    # --- shape inference --------------------------------------------------
+    def _infer_shapes(self) -> None:
+        cfg = self.cfg
+        specs: List[Optional[NodeSpec]] = [None] * cfg.num_nodes
+        c, y, x = cfg.input_shape
+        if c * y * x == 0:
+            raise ValueError('must set input_shape before building the net')
+        specs[0] = NodeSpec(c, y, x)
+        # extra data nodes in_1..in_k
+        for k in range(cfg.extra_data_num):
+            ec, ey, ex = cfg.extra_shape[3 * k:3 * k + 3]
+            specs[1 + k] = NodeSpec(ec, ey, ex)
+        for i, info in enumerate(cfg.layers):
+            ins = []
+            for j in info.nindex_in:
+                if specs[j] is None:
+                    raise ValueError(
+                        f'layer {i} consumes node {j} before it is produced')
+                ins.append(specs[j])
+            outs = self.layers[i].infer_shapes(ins)
+            if len(outs) != len(info.nindex_out):
+                raise ValueError(
+                    f'layer {i} ({self.layers[i].type_name}): produced '
+                    f'{len(outs)} outputs, expected {len(info.nindex_out)}')
+            for j, spec in zip(info.nindex_out, outs):
+                if specs[j] is not None and j not in info.nindex_in:
+                    if specs[j] != spec:
+                        raise ValueError(f'node {j} shape conflict')
+                specs[j] = spec
+        self.node_specs = specs
+
+    # --- params -----------------------------------------------------------
+    def init_params(self, rng: jax.Array, dtype=jnp.float32) -> Params:
+        params: Params = {}
+        cfg = self.cfg
+        for i, info in enumerate(cfg.layers):
+            if self.layer_primary[i] != i:
+                continue
+            ins = [self.node_specs[j] for j in info.nindex_in]
+            p = self.layers[i].init_params(jax.random.fold_in(rng, i), ins,
+                                           dtype)
+            if p:
+                params[str(i)] = p
+        return params
+
+    def _layer_params(self, params: Params, i: int):
+        return params.get(str(self.layer_primary[i]), {})
+
+    # --- forward / loss ---------------------------------------------------
+    def _input_to_device_layout(self, batch):
+        """Host batches arrive NCHW (c,y,x per instance); convert to the
+        on-device layout (NHWC images, flat matrices)."""
+        spec = self.node_specs[0]
+        if batch.ndim == 2:
+            return batch
+        if batch.ndim == 4:
+            if spec.is_mat:
+                return batch.reshape(batch.shape[0], -1)
+            return jnp.transpose(batch, (0, 2, 3, 1))
+        raise ValueError(f'bad input batch rank {batch.ndim}')
+
+    def forward(self, params: Params, batch, ctx: ForwardContext,
+                labels: Optional[LabelInfo] = None, loss_mask=None,
+                extra_data=None):
+        """Run the graph.  Returns (node_values, total_loss).
+
+        ``node_values[j]`` holds every node's final value (post loss-layer
+        transforms, like the reference's in-place nodes).  ``total_loss`` is
+        the sum of loss-layer scalars (0.0 if the graph has none or labels
+        were not supplied).  ``extra_data`` feeds nodes ``in_1..in_k`` when
+        ``extra_data_num`` is configured (NCHW host layout, like the input).
+        """
+        cfg = self.cfg
+        values: List[Optional[jax.Array]] = [None] * cfg.num_nodes
+        values[0] = self._input_to_device_layout(batch)
+        if cfg.extra_data_num:
+            if extra_data is None or len(extra_data) < cfg.extra_data_num:
+                raise ValueError(
+                    f'net requires {cfg.extra_data_num} extra_data inputs '
+                    f'(batch.extra_data) but got '
+                    f'{0 if extra_data is None else len(extra_data)}')
+            for k in range(cfg.extra_data_num):
+                ex = extra_data[k]
+                spec = self.node_specs[1 + k]
+                if ex.ndim == 4 and not spec.is_mat:
+                    ex = jnp.transpose(ex, (0, 2, 3, 1))
+                elif ex.ndim > 2 and spec.is_mat:
+                    ex = ex.reshape(ex.shape[0], -1)
+                values[1 + k] = ex
+        total_loss = jnp.asarray(0.0, jnp.float32)
+        for i, info in enumerate(cfg.layers):
+            layer = self.layers[i]
+            lctx = ForwardContext(is_train=ctx.is_train, rng=ctx.rng,
+                                  layer_index=i, round=ctx.round,
+                                  max_round=ctx.max_round)
+            lp = self._layer_params(params, i)
+            ins = [values[j] for j in info.nindex_in]
+            if isinstance(layer, LossLayerBase) and labels is not None:
+                total_loss = total_loss + layer.loss(
+                    lp, ins, labels.field(layer.target), lctx, loss_mask)
+            outs = layer.forward(lp, ins, lctx)
+            for j, v in zip(info.nindex_out, outs):
+                values[j] = v
+        return values, total_loss
+
+    def node_index(self, name: str) -> int:
+        """Resolve a node by name or ``top[-k]`` syntax
+        (``nnet_impl-inl.hpp:200-223``)."""
+        if name.startswith('top[-') and name.endswith(']'):
+            k = int(name[5:-1])
+            return self.cfg.layers[-k].nindex_out[-1] if k > 0 else -1
+        if name in self.cfg.node_name_map:
+            return self.cfg.node_name_map[name]
+        raise ValueError(f'unknown node name {name}')
+
+    def make_label_info(self, label_mat) -> LabelInfo:
+        return LabelInfo(label_mat, self.cfg.label_name_map,
+                         self.cfg.label_range)

@@ -1,0 +1,284 @@
+"""Network-architecture configuration: the ``netconfig`` grammar + binary format.
+
+Parses the reference's layer-DAG config language
+(``src/nnet/nnet_config.h:207-386``):
+
+* ``layer[0->1] = conv:name`` — explicit node indices/names, comma lists for
+  multi-input/-output layers,
+* ``layer[+1] = relu`` — one new node after the current top node;
+  ``layer[+1:tag]`` names it; ``layer[+0]`` is a self-loop,
+* ``layer[...] = share[tag]`` — weight sharing with a previously named layer,
+* pairs following a ``layer[...]`` line configure that layer; pairs outside
+  ``netconfig=start/end`` are global defaults replayed into every layer,
+* ``label_vec[a,b) = name`` maps label columns to named fields,
+* ``input_shape = c,y,x`` fixes the input node geometry.
+
+The binary ``SaveNet/LoadNet`` layout (``nnet_config.h:126-191``) is kept
+byte-compatible: NetParam struct (with 31 reserved ints), node-name strings,
+and per-layer (type, primary_layer_index, name, nindex_in, nindex_out).
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+from dataclasses import dataclass, field
+from typing import BinaryIO, Dict, List, Tuple
+
+import numpy as np
+
+from ..layers.base import get_layer_type, kSharedLayer
+from ..utils import io_stream
+
+ConfigEntry = Tuple[str, str]
+
+# NetParam: int num_nodes, num_layers; uint32 input_shape[3]; int init_end,
+# extra_data_num; int reserved[31]  (nnet_config.h:28-50)
+_NET_PARAM = struct.Struct('<ii3Iii' + '31i')
+
+
+@dataclass
+class LayerEntry:
+    """One layer's structural record (LayerInfo, nnet_config.h:52-83)."""
+
+    type: int = 0
+    primary_layer_index: int = -1
+    name: str = ''
+    nindex_in: List[int] = field(default_factory=list)
+    nindex_out: List[int] = field(default_factory=list)
+
+    def struct_eq(self, other: 'LayerEntry') -> bool:
+        return (self.type == other.type
+                and self.primary_layer_index == other.primary_layer_index
+                and self.name == other.name
+                and self.nindex_in == other.nindex_in
+                and self.nindex_out == other.nindex_out)
+
+
+class NetConfig:
+    """Records network structure + per-layer and global configuration."""
+
+    def __init__(self):
+        self.num_nodes = 0
+        self.num_layers = 0
+        self.input_shape = (0, 0, 0)        # (c, y, x)
+        self.init_end = 0
+        self.extra_data_num = 0
+        self.extra_shape: List[int] = []
+        self.layers: List[LayerEntry] = []
+        self.node_names: List[str] = []
+        # training-only state (not serialized)
+        self.node_name_map: Dict[str, int] = {}
+        self.layer_name_map: Dict[str, int] = {}
+        self.updater_type = 'sgd'
+        self.sync_type = 'simple'
+        self.label_name_map: Dict[str, int] = {'label': 0}
+        self.label_range: List[Tuple[int, int]] = [(0, 1)]
+        self.defcfg: List[ConfigEntry] = []
+        self.layercfg: List[List[ConfigEntry]] = []
+
+    # --- global params ----------------------------------------------------
+    def _set_global_param(self, name: str, val: str) -> None:
+        if name == 'updater':
+            self.updater_type = val
+        if name == 'sync':
+            self.sync_type = val
+        m = re.match(r'label_vec\[(\d+),(\d+)\)$', name)
+        if m:
+            self.label_range.append((int(m.group(1)), int(m.group(2))))
+            self.label_name_map[val] = len(self.label_range) - 1
+
+    # --- the layer[...] grammar ------------------------------------------
+    def _get_node_index(self, name: str, alloc_unknown: bool) -> int:
+        if name in self.node_name_map:
+            return self.node_name_map[name]
+        if not alloc_unknown:
+            raise ValueError(
+                f'ConfigError: undefined node name {name}; input of a layer '
+                f'must be the output of an earlier layer')
+        idx = len(self.node_names)
+        self.node_name_map[name] = idx
+        self.node_names.append(name)
+        return idx
+
+    def _get_layer_info(self, name: str, val: str, top_node: int,
+                        cfg_layer_index: int) -> LayerEntry:
+        inf = LayerEntry()
+        m_plus = re.match(r'layer\[\+(\d+)(?::([^\]]+))?\]$', name)
+        m_arrow = re.match(r'layer\[([^-\]]+)->([^\]]+)\]$', name)
+        if m_plus:
+            if top_node < 0:
+                raise ValueError(
+                    'ConfigError: layer[+1] used but the previous layer has '
+                    'more than one output; use layer[in->out] instead')
+            inc = int(m_plus.group(1))
+            inf.nindex_in.append(top_node)
+            if m_plus.group(2) is not None and inc == 1:
+                inf.nindex_out.append(
+                    self._get_node_index(m_plus.group(2), True))
+            elif inc == 0:
+                inf.nindex_out.append(top_node)
+            else:
+                inf.nindex_out.append(
+                    self._get_node_index(f'!node-after-{top_node}', True))
+        elif m_arrow:
+            for tok in m_arrow.group(1).split(','):
+                inf.nindex_in.append(self._get_node_index(tok, False))
+            for tok in m_arrow.group(2).split(','):
+                inf.nindex_out.append(self._get_node_index(tok, True))
+        else:
+            raise ValueError(f'ConfigError: invalid layer format {name}')
+
+        ltype, _, tag = val.partition(':')
+        layer_name = tag
+        inf.type = get_layer_type(ltype)
+        if inf.type == kSharedLayer:
+            m_share = re.search(r'\[([^\]]+)\]', ltype)
+            if not m_share:
+                raise ValueError(
+                    'ConfigError: shared layer must specify tag to share with')
+            share_tag = m_share.group(1)
+            if share_tag not in self.layer_name_map:
+                raise ValueError(
+                    f'ConfigError: shared layer tag {share_tag} not defined')
+            inf.primary_layer_index = self.layer_name_map[share_tag]
+        elif layer_name:
+            if layer_name in self.layer_name_map:
+                if self.layer_name_map[layer_name] != cfg_layer_index:
+                    raise ValueError(
+                        'ConfigError: layer name in configuration does not '
+                        'match the name stored in model')
+            else:
+                self.layer_name_map[layer_name] = cfg_layer_index
+            inf.name = layer_name
+        return inf
+
+    # --- configure (replay of ordered pairs) ------------------------------
+    def configure(self, cfg: List[ConfigEntry]) -> None:
+        """Replay ordered (name, val) pairs (``Configure``,
+        nnet_config.h:207-289).  May be called again on a loaded model, in
+        which case the structure must match."""
+        self.defcfg = []
+        self.layercfg = [[] for _ in self.layers] if self.init_end else []
+        if not self.node_names and not self.node_name_map:
+            self.node_names.append('in')
+            self.node_name_map['in'] = 0
+        self.node_name_map['0'] = 0
+        netcfg_mode = 0
+        cfg_top_node = 0
+        cfg_layer_index = 0
+        for name, val in cfg:
+            if name == 'extra_data_num':
+                num = int(val)
+                for i in range(num):
+                    nm = f'in_{i + 1}'
+                    if nm not in self.node_name_map:
+                        self.node_names.append(nm)
+                        self.node_name_map[nm] = i + 1
+                self.extra_data_num = num
+            if name.startswith('extra_data_shape['):
+                x, y, z = (int(t) for t in val.split(','))
+                self.extra_shape += [x, y, z]
+            if self.init_end == 0 and name == 'input_shape':
+                c, y, x = (int(t) for t in val.split(','))
+                self.input_shape = (c, y, x)
+            if netcfg_mode != 2:
+                self._set_global_param(name, val)
+            if name == 'netconfig' and val == 'start':
+                netcfg_mode = 1
+            if name == 'netconfig' and val == 'end':
+                netcfg_mode = 0
+            if name.startswith('layer['):
+                info = self._get_layer_info(name, val, cfg_top_node,
+                                            cfg_layer_index)
+                netcfg_mode = 2
+                if self.init_end == 0:
+                    assert len(self.layers) == cfg_layer_index
+                    self.layers.append(info)
+                    self.layercfg.append([])
+                else:
+                    if cfg_layer_index >= len(self.layers):
+                        raise ValueError('config layer index exceeds bound')
+                    if not info.struct_eq(self.layers[cfg_layer_index]):
+                        raise ValueError(
+                            'config does not match existing network structure')
+                cfg_top_node = (info.nindex_out[0]
+                                if len(info.nindex_out) == 1 else -1)
+                cfg_layer_index += 1
+                continue
+            if netcfg_mode == 2:
+                if self.layers[cfg_layer_index - 1].type == kSharedLayer:
+                    raise ValueError(
+                        'do not set parameters in a shared layer; set them '
+                        'in the primary layer')
+                self.layercfg[cfg_layer_index - 1].append((name, val))
+            else:
+                self.defcfg.append((name, val))
+        if self.init_end == 0:
+            self._init_net()
+
+    def _init_net(self) -> None:
+        self.num_layers = len(self.layers)
+        n = 0
+        for info in self.layers:
+            for j in info.nindex_in + info.nindex_out:
+                n = max(n, j + 1)
+        self.num_nodes = n
+        assert self.num_nodes == len(self.node_names), \
+            'num_nodes inconsistent with node_names'
+        self.init_end = 1
+
+    def get_layer_index(self, name: str) -> int:
+        if name not in self.layer_name_map:
+            raise ValueError(f'unknown layer name {name}')
+        return self.layer_name_map[name]
+
+    # --- binary format (checkpoint interop) -------------------------------
+    def save_net(self, f: BinaryIO) -> None:
+        f.write(_NET_PARAM.pack(self.num_nodes, self.num_layers,
+                                self.input_shape[0], self.input_shape[1],
+                                self.input_shape[2], self.init_end,
+                                self.extra_data_num, *([0] * 31)))
+        if self.extra_data_num != 0:
+            io_stream.write_vector(f, np.asarray(self.extra_shape, np.int32))
+        assert self.num_layers == len(self.layers)
+        assert self.num_nodes == len(self.node_names)
+        for nm in self.node_names:
+            io_stream.write_string(f, nm)
+        for info in self.layers:
+            f.write(struct.pack('<ii', info.type, info.primary_layer_index))
+            io_stream.write_string(f, info.name)
+            io_stream.write_vector(f, np.asarray(info.nindex_in, np.int32))
+            io_stream.write_vector(f, np.asarray(info.nindex_out, np.int32))
+
+    def load_net(self, f: BinaryIO) -> None:
+        raw = f.read(_NET_PARAM.size)
+        if len(raw) < _NET_PARAM.size:
+            raise EOFError('NetConfig: invalid model file')
+        vals = _NET_PARAM.unpack(raw)
+        self.num_nodes, self.num_layers = vals[0], vals[1]
+        self.input_shape = (vals[2], vals[3], vals[4])
+        self.init_end, self.extra_data_num = vals[5], vals[6]
+        if self.extra_data_num != 0:
+            self.extra_shape = list(io_stream.read_vector(f, np.int32))
+        self.node_names = [io_stream.read_string(f).decode('utf-8')
+                           for _ in range(self.num_nodes)]
+        self.node_name_map = {nm: i for i, nm in enumerate(self.node_names)}
+        self.layers = []
+        self.layer_name_map = {}
+        for i in range(self.num_layers):
+            t, pli = struct.unpack('<ii', f.read(8))
+            nm = io_stream.read_string(f).decode('utf-8')
+            nin = [int(v) for v in io_stream.read_vector(f, np.int32)]
+            nout = [int(v) for v in io_stream.read_vector(f, np.int32)]
+            entry = LayerEntry(t, pli, nm, nin, nout)
+            if t == kSharedLayer:
+                if nm:
+                    raise ValueError('SharedLayer must not have a name')
+            elif nm:
+                if nm in self.layer_name_map:
+                    raise ValueError(f'duplicated layer name: {nm}')
+                self.layer_name_map[nm] = i
+            self.layers.append(entry)
+        self.layercfg = [[] for _ in self.layers]
+        self.defcfg = []

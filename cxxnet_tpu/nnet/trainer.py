@@ -1,0 +1,353 @@
+"""The trainer: jitted train/eval steps over a device mesh.
+
+TPU-native replacement for ``CXXNetThreadTrainer``
+(``src/nnet/nnet_impl-inl.hpp:16-455``).  Where the reference runs one
+pthread + model replica per GPU and syncs gradients through mshadow-ps
+Push/PullReq, here a single jitted train step is partitioned over a
+``jax.sharding.Mesh``: the batch is sharded along the ``data`` axis,
+parameters are replicated, and XLA inserts the ICI all-reduce for the
+gradients (the WFBP comm/compute overlap of ``async_updater-inl.hpp`` is
+subsumed by XLA's latency-hiding scheduler).  The optimizer runs on-device
+inside the same program — the TPU analogue of ``update_on_server``.
+
+Reference semantics preserved:
+* ``update_period`` — gradients accumulate across k minibatches; the
+  optimizer applies on the k-th (``nnet_impl:149-150,181-184``),
+* ``epoch_counter`` counts optimizer updates and drives LR schedules, and is
+  saved in checkpoints,
+* metrics: ``metric = error`` / ``metric[label,node] = logloss`` config
+  forms; train metrics from forward outputs when ``eval_train=1``; eval
+  excludes ``num_batch_padd`` padded instances,
+* model file layout (``SaveModel``, nnet_impl:82-87): NetConfig +
+  epoch_counter (int64) + length-prefixed blob of per-layer weights.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import re
+import struct
+from functools import partial
+from typing import BinaryIO, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..layers import ForwardContext
+from ..layers.loss import LossLayerBase
+from ..updater import (apply_updates, create_updater_hyper, init_opt_state)
+from ..utils.metric import MetricSet
+from . import checkpoint
+from .net import Net
+from .net_config import NetConfig
+
+ConfigEntry = Tuple[str, str]
+
+
+def parse_devices(val: str) -> List[int]:
+    """Parse ``dev = tpu:0-3`` / ``dev = gpu:0,2`` / ``dev = cpu``
+    (``nnet_impl-inl.hpp:31-55``).  Device ordinals index ``jax.devices()``;
+    the device *kind* prefix is advisory (everything runs on the JAX default
+    backend)."""
+    if ':' not in val:
+        return []
+    devs = val.split(':', 1)[1]
+    m = re.match(r'^(\d+)-(\d+)$', devs)
+    if m:
+        return list(range(int(m.group(1)), int(m.group(2)) + 1))
+    return [int(t) for t in devs.split(',') if t]
+
+
+class NetTrainer:
+    """Config-driven trainer (INetTrainer surface, ``nnet/nnet.h:18-92``)."""
+
+    def __init__(self, cfg: Optional[List[ConfigEntry]] = None):
+        self.batch_size = 100
+        self.update_period = 1
+        self.sample_counter = 0
+        self.eval_train = 1
+        self.epoch_counter = 0
+        self.seed = 0
+        self.round = 0
+        self.max_round = 1
+        self.devices: List[int] = []
+        self.metric = MetricSet()
+        self.train_metric = MetricSet()
+        self.eval_nodes: List[Tuple[str, int]] = []
+        self.cfg: List[ConfigEntry] = []
+        self.net_cfg = NetConfig()
+        self.net: Optional[Net] = None
+        self.params = None
+        self.opt_state = None
+        self.grad_acc = None
+        self._mesh: Optional[Mesh] = None
+        self._train_step_fn = None
+        self._forward_fn = None
+        if cfg:
+            for name, val in cfg:
+                self.set_param(name, val)
+
+    # --- configuration ----------------------------------------------------
+    def set_param(self, name: str, val: str) -> None:
+        if name == 'dev':
+            self.devices = parse_devices(val)
+        if name == 'batch_size':
+            self.batch_size = int(val)
+        if name == 'update_period':
+            self.update_period = int(val)
+        if name == 'eval_train':
+            self.eval_train = int(val)
+        if name == 'seed':
+            self.seed = int(val)
+        if name == 'max_round':
+            self.max_round = int(val)
+        if name == 'metric' or name.startswith('metric['):
+            # forms: metric / metric[field] / metric[field,node]; the node
+            # part may itself contain brackets (top[-1]), so split on the
+            # first comma and strip the outermost brackets only
+            if name == 'metric':
+                field, node = 'label', ''
+            else:
+                inner = name[len('metric['):].rstrip(']')
+                field, _, node = inner.partition(',')
+            self.metric.add_metric(val, field)
+            self.train_metric.add_metric(val, field)
+            self.eval_nodes.append((node, 0 if node else -1))
+        self.cfg.append((name, val))
+
+    # --- construction -----------------------------------------------------
+    def _build_mesh(self) -> Mesh:
+        all_devs = jax.devices()
+        if self.devices:
+            picked = [all_devs[i % len(all_devs)] for i in self.devices]
+            # de-dup while preserving order (e.g. dev=tpu:0-3 on 1 chip)
+            seen, devs = set(), []
+            for d in picked:
+                if d.id not in seen:
+                    seen.add(d.id)
+                    devs.append(d)
+        else:
+            devs = [all_devs[0]]
+        return Mesh(np.asarray(devs), ('data',))
+
+    def _resolve_eval_nodes(self) -> List[int]:
+        out = []
+        last = self.net.cfg.layers[-1].nindex_out[-1]
+        for name, _ in self.eval_nodes:
+            out.append(last if name == '' else self.net.node_index(name))
+        return out
+
+    def init_net(self) -> None:
+        """Build Net + updater hypers from the accumulated config."""
+        self.net_cfg.configure(self.cfg)
+        self.net = Net(self.net_cfg)
+        self._mesh = self._build_mesh()
+        self._eval_node_ids = self._resolve_eval_nodes()
+        # per-weight tag-scoped hyperparameters
+        self.hypers: Dict[str, Dict[str, object]] = {}
+        for i, layer in enumerate(self.net.layers):
+            if self.net.layer_primary[i] != i:
+                continue
+            fields = layer.param_fields
+            if not fields:
+                continue
+            self.hypers[str(i)] = {
+                tag: create_updater_hyper(self.net_cfg.updater_type, tag,
+                                          self.net_cfg.defcfg,
+                                          self.net_cfg.layercfg[i])
+                for tag in fields}
+        self._rng = jax.random.PRNGKey(self.seed)
+        self._compile_steps()
+
+    def init_model(self) -> None:
+        self.init_net()
+        self.params = self.net.init_params(jax.random.fold_in(self._rng, 0xC0FFEE))
+        self._post_params_init()
+
+    def _post_params_init(self) -> None:
+        self.params = self._replicate(self.params)
+        self.opt_state = self._replicate(
+            init_opt_state(self.net_cfg.updater_type, self.params))
+        self.grad_acc = self._replicate(
+            jax.tree.map(jnp.zeros_like, self.params))
+
+    def _replicate(self, tree):
+        sharding = NamedSharding(self._mesh, P())
+        return jax.device_put(tree, sharding)
+
+    def _shard_batch(self, data: np.ndarray):
+        sharding = NamedSharding(self._mesh, P('data'))
+        return jax.device_put(jnp.asarray(data), sharding)
+
+    # --- jitted steps -----------------------------------------------------
+    def _compile_steps(self) -> None:
+        net = self.net
+        eval_ids = self._eval_node_ids
+        updater_type = self.net_cfg.updater_type
+        hypers = self.hypers
+
+        def loss_fn(params, data, label, extra, rng, rnd):
+            ctx = ForwardContext(is_train=True, rng=rng, round=rnd,
+                                 max_round=self.max_round)
+            values, loss = net.forward(params, data, ctx,
+                                       labels=net.make_label_info(label),
+                                       extra_data=extra)
+            return loss, [values[i] for i in eval_ids]
+
+        @partial(jax.jit, static_argnames=('do_update',), donate_argnums=(0, 1, 2))
+        def train_step(params, opt_state, grad_acc, data, label, extra, rng,
+                       epoch, rnd, do_update):
+            (loss, evals), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, data, label, extra, rng, rnd)
+            grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+            if do_update:
+                params, opt_state = apply_updates(
+                    updater_type, hypers, params, grad_acc, opt_state, epoch)
+                grad_acc = jax.tree.map(jnp.zeros_like, grad_acc)
+            return params, opt_state, grad_acc, loss, evals
+
+        @jax.jit
+        def forward_step(params, data, extra, rnd):
+            ctx = ForwardContext(is_train=False, rng=None, round=rnd,
+                                 max_round=self.max_round)
+            values, _ = net.forward(params, data, ctx, extra_data=extra)
+            return values
+
+        self._train_step_fn = train_step
+        self._forward_fn = forward_step
+
+    # --- training ---------------------------------------------------------
+    def start_round(self, round_: int) -> None:
+        self.round = round_
+
+    def update(self, batch) -> None:
+        """One minibatch through forward/backward/(maybe) update —
+        the reference hot loop (``nnet_impl:141-185``)."""
+        do_update = (self.sample_counter + 1) % self.update_period == 0
+        rng = jax.random.fold_in(self._rng, 1 + self.sample_counter * 131 +
+                                 self.round)
+        data = self._shard_batch(batch.data)
+        label = self._shard_batch(batch.label)
+        extra = tuple(self._shard_batch(e) for e in batch.extra_data)
+        (self.params, self.opt_state, self.grad_acc, loss, evals) = \
+            self._train_step_fn(self.params, self.opt_state, self.grad_acc,
+                                data, label, extra, rng,
+                                self.epoch_counter, self.round,
+                                do_update=do_update)
+        if self.eval_train and len(self.train_metric):
+            label_info = _HostLabelInfo(np.asarray(batch.label),
+                                        self.net_cfg.label_name_map,
+                                        self.net_cfg.label_range)
+            n = batch.batch_size - batch.num_batch_padd
+            self.train_metric.add_eval(
+                [np.asarray(e)[:n] for e in evals], label_info.slice(n))
+        if do_update:
+            self.epoch_counter += 1
+        self.sample_counter += 1
+
+    # --- evaluation / prediction ------------------------------------------
+    def _forward_nodes(self, batch, node_ids: List[int]) -> List[np.ndarray]:
+        extra = tuple(self._shard_batch(e) for e in batch.extra_data)
+        values = self._forward_fn(self.params, self._shard_batch(batch.data),
+                                  extra, self.round)
+        return [np.asarray(values[i]) for i in node_ids]
+
+    def evaluate(self, data_iter, name: str) -> str:
+        """Run metrics over an iterator; returns the reference's stderr
+        format ``\\tname-metric:value``.  Like the reference
+        (``nnet_impl:224-245``), the pending train metrics are prepended
+        (and cleared) when ``eval_train`` is set; ``data_iter=None``
+        returns just the train part."""
+        ret = ''
+        if self.eval_train and len(self.train_metric):
+            ret += self.train_metric.print('train')
+            self.train_metric.clear()
+        if data_iter is None:
+            return ret
+        self.metric.clear()
+        for batch in data_iter:
+            outs = self._forward_nodes(batch, self._eval_node_ids)
+            n = batch.batch_size - batch.num_batch_padd
+            label_info = _HostLabelInfo(np.asarray(batch.label),
+                                        self.net_cfg.label_name_map,
+                                        self.net_cfg.label_range)
+            self.metric.add_eval([o[:n] for o in outs], label_info.slice(n))
+        return ret + self.metric.print(name)
+
+    def predict(self, batch) -> np.ndarray:
+        """Argmax of the final node per instance (``TransformPred``,
+        nnet_impl:286-298)."""
+        last = self.net.cfg.layers[-1].nindex_out[-1]
+        out = self._forward_nodes(batch, [last])[0]
+        n = batch.batch_size - batch.num_batch_padd
+        out = out[:n]
+        if out.ndim > 1 and out.shape[1] != 1:
+            return np.argmax(out, axis=1).astype(np.float32)
+        return out.reshape(-1).astype(np.float32)
+
+    def extract_feature(self, batch, node_name: str) -> np.ndarray:
+        nid = self.net.node_index(node_name)
+        out = self._forward_nodes(batch, [nid])[0]
+        n = batch.batch_size - batch.num_batch_padd
+        return out[:n]
+
+    # --- checkpointing ----------------------------------------------------
+    def save_model(self, fo: BinaryIO) -> None:
+        self.net_cfg.save_net(fo)
+        fo.write(struct.pack('<q', self.epoch_counter))
+        blob = checkpoint.params_to_blob(self.net, self.params)
+        fo.write(struct.pack('<Q', len(blob)))
+        fo.write(blob)
+
+    def load_model(self, fi: BinaryIO) -> None:
+        self.net_cfg = NetConfig()
+        self.net_cfg.load_net(fi)
+        (self.epoch_counter,) = struct.unpack('<q', fi.read(8))
+        (blob_len,) = struct.unpack('<Q', fi.read(8))
+        blob = fi.read(blob_len)
+        # init_net reconfigures the loaded structure (validating it against
+        # the config) and rebuilds net/mesh/hypers/compiled steps
+        self.init_net()
+        self.params = checkpoint.blob_to_params(self.net, blob)
+        self._post_params_init()
+
+    def copy_model_from(self, fi: BinaryIO) -> None:
+        """Finetune: name-matched layer copy + epoch reset
+        (``nnet_impl:101-134``)."""
+        self.init_model()
+        old_cfg = NetConfig()
+        old_cfg.load_net(fi)
+        fi.read(8)  # old epoch_counter, discarded (reset to 0)
+        (blob_len,) = struct.unpack('<Q', fi.read(8))
+        blob = fi.read(blob_len)
+        self.epoch_counter = 0
+        old_raw = checkpoint.blob_to_raw(old_cfg.layers, blob)
+        params = jax.device_get(self.params)
+        for i, old_info in enumerate(old_cfg.layers):
+            if not old_info.name or str(i) not in old_raw:
+                continue
+            for j, new_info in enumerate(self.net_cfg.layers):
+                if new_info.name == old_info.name:
+                    print(f'Copying layer {old_info.name}')
+                    params[str(j)] = checkpoint.record_to_memory(
+                        self.net.layers[j], new_info.type, old_raw[str(i)])
+        self.params = params
+        self._post_params_init()
+
+
+class _HostLabelInfo:
+    """Host-side label field view used by metrics."""
+
+    def __init__(self, mat: np.ndarray, name_map, ranges):
+        self._mat = mat
+        self._name_map = name_map
+        self._ranges = ranges
+
+    def slice(self, n: int) -> '_HostLabelInfo':
+        return _HostLabelInfo(self._mat[:n], self._name_map, self._ranges)
+
+    def field(self, name: str) -> np.ndarray:
+        a, b = self._ranges[self._name_map[name]]
+        return self._mat[:, a:b]

@@ -1,0 +1,4 @@
+"""Optimizers (updaters) — SGD / NAG / Adam with reference semantics."""
+
+from .updaters import (UpdaterHyper, create_updater_hyper, init_opt_state,
+                       apply_updates)

@@ -1,0 +1,218 @@
+"""Updaters: per-weight optimizers + LR/momentum schedules.
+
+Reference semantics preserved (``src/updater/``):
+
+* ``UpdaterHyper`` mirrors ``UpdaterParam`` (param.h:13-133): lr schedules
+  ``constant/expdecay/polydecay/factor`` driven by the *minibatch* counter
+  (the reference's ``epoch``), ``lr_minimum`` floor, ``start_epoch`` gate,
+  momentum saturation schedule, and **tag-scoped overrides** — ``wmat:lr``
+  applies only to updaters whose tag is ``wmat`` (prefix-stripped exactly
+  like param.h:100-105).
+* SGD (sgd_updater-inl.hpp:73-84): ``m = mom*m - lr*(clip(g) + wd*w);
+  w += m`` — the clip functor also zeroes NaN gradients, and is only applied
+  when ``clip_gradient != 0``.
+* NAG (nag_updater-inl.hpp:58-66): ``w += (1+mom)*m_new - mom*m_old``.
+* Adam (adam_updater-inl.hpp:73-82): ``decay1/decay2`` are ``1-beta``;
+  bias-corrected lr; **reference applies wd as ``grad -= wd*w``** — we keep
+  that exactly for parity (use wd=0 with adam, as the reference examples do).
+
+The whole update is a pure pytree function applied inside the jitted train
+step, so the optimizer runs sharded on-device (the TPU equivalent of
+``update_on_server``: there is no server).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class UpdaterHyper:
+    tag: str = ''
+    base_lr: float = 0.01
+    wd: float = 0.0
+    momentum: float = 0.9
+    lr_schedule: int = 0
+    momentum_schedule: int = 0
+    lr_step: int = 1
+    lr_gamma: float = 0.5
+    lr_alpha: float = 0.5
+    lr_factor: float = 0.1
+    lr_minimum: float = 0.00001
+    start_epoch: int = 0
+    base_momentum: float = 0.5
+    final_momentum: float = 0.90
+    saturation_epoch: int = 0
+    clip_gradient: float = 0.0
+    # adam
+    decay1: float = 0.1
+    decay2: float = 0.001
+
+    def set_param(self, name: str, val: str) -> None:
+        # tag-scoped override: 'wmat:lr' reaches only tag=='wmat'
+        if self.tag and name.startswith(self.tag + ':'):
+            name = name[len(self.tag) + 1:]
+        if name in ('lr', 'eta'):
+            self.base_lr = float(val)
+        if name == 'wd':
+            self.wd = float(val)
+        if name == 'momentum':
+            self.momentum = float(val)
+        if name == 'momentum_schedule':
+            self.momentum_schedule = int(val)
+        if name == 'clip_gradient':
+            self.clip_gradient = float(val)
+        if name == 'final_momentum':
+            self.final_momentum = float(val)
+        if name == 'base_momentum':
+            self.base_momentum = float(val)
+        if name == 'saturation_epoch':
+            self.saturation_epoch = int(val)
+        if name == 'beta1':
+            self.decay1 = float(val)
+        if name == 'beta2':
+            self.decay2 = float(val)
+        if name.startswith('lr:') or name.startswith('eta:'):
+            sub = name.split(':', 1)[1]
+            if sub == 'schedule':
+                table = {'constant': 0, 'expdecay': 1, 'polydecay': 2,
+                         'factor': 3}
+                if val in table:
+                    self.lr_schedule = table[val]
+            if sub == 'gamma':
+                self.lr_gamma = float(val)
+            if sub == 'alpha':
+                self.lr_alpha = float(val)
+            if sub == 'step':
+                self.lr_step = int(val)
+            if sub == 'factor':
+                self.lr_factor = float(val)
+            if sub == 'minimum_lr':
+                self.lr_minimum = float(val)
+            if sub == 'start_epoch':
+                self.start_epoch = int(val)
+
+    def schedule(self, epoch):
+        """(lr, momentum) at minibatch counter ``epoch``; traceable so the
+        schedule advances inside jit (``ScheduleEpoch``, param.h:76-94)."""
+        e = jnp.asarray(epoch, jnp.float32)
+        if self.lr_schedule == 0:
+            lr = jnp.asarray(self.base_lr, jnp.float32)
+        elif self.lr_schedule == 1:
+            lr = self.base_lr * jnp.power(self.lr_gamma, e / self.lr_step)
+        elif self.lr_schedule == 2:
+            lr = self.base_lr * jnp.power(
+                1.0 + jnp.floor(e / self.lr_step) * self.lr_gamma,
+                -self.lr_alpha)
+        elif self.lr_schedule == 3:
+            lr = self.base_lr * jnp.power(self.lr_factor,
+                                          jnp.floor(e / self.lr_step))
+        else:
+            raise ValueError('unknown lr schedule type')
+        mom = jnp.asarray(self.momentum, jnp.float32)
+        if self.momentum_schedule and self.saturation_epoch:
+            mom = mom + ((self.final_momentum - self.base_momentum)
+                         / self.saturation_epoch * e + self.base_momentum)
+        # the reference caps momentum at final_momentum unconditionally
+        # (param.h:88) — preserved
+        mom = jnp.minimum(mom, self.final_momentum)
+        lr = jnp.maximum(lr, self.lr_minimum)
+        if self.start_epoch > 0:
+            lr = jnp.where(e < self.start_epoch, self.base_lr, lr)
+        return lr, mom
+
+
+def create_updater_hyper(updater_type: str, tag: str, defcfg, layercfg
+                         ) -> UpdaterHyper:
+    """Build per-weight hyperparameters by replaying global then layer
+    config (``neural_net-inl.hpp:186-196``)."""
+    if updater_type not in ('sgd', 'nag', 'adam'):
+        raise ValueError(f'unknown updater type {updater_type}')
+    h = UpdaterHyper(tag=tag)
+    for name, val in defcfg:
+        h.set_param(name, val)
+    for name, val in layercfg:
+        h.set_param(name, val)
+    return h
+
+
+def _clip(g, c):
+    """Clip to [-c, c] and zero NaNs (``sgd_updater-inl.hpp:15-22``)."""
+    g = jnp.where(jnp.isnan(g), 0.0, g)
+    return jnp.clip(g, -c, c)
+
+
+def init_opt_state(updater_type: str, params):
+    """Zero-initialized optimizer slots, one pytree per param leaf."""
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    if updater_type in ('sgd', 'nag'):
+        return {'m': zeros}
+    if updater_type == 'adam':
+        return {'m1': zeros, 'm2': jax.tree.map(jnp.zeros_like, params)}
+    raise ValueError(f'unknown updater type {updater_type}')
+
+
+def _sgd_leaf(w, g, m, lr, mom, h: UpdaterHyper):
+    if h.clip_gradient != 0.0:
+        g = _clip(g, h.clip_gradient)
+    m_new = mom * m - lr * (g + h.wd * w)
+    return w + m_new, m_new
+
+
+def _nag_leaf(w, g, m, lr, mom, h: UpdaterHyper):
+    m_new = mom * m - lr * (g + h.wd * w)
+    w_new = w + (1 + mom) * m_new - mom * m
+    return w_new, m_new
+
+
+def _adam_leaf(w, g, m1, m2, epoch, h: UpdaterHyper):
+    if h.wd > 0.0:
+        g = g - h.wd * w          # reference sign kept verbatim
+    e = jnp.asarray(epoch, jnp.float32)
+    fix1 = 1.0 - jnp.power(1.0 - h.decay1, e + 1)
+    fix2 = 1.0 - jnp.power(1.0 - h.decay2, e + 1)
+    lr_t = h.base_lr * jnp.sqrt(fix2) / fix1
+    m1n = m1 + h.decay1 * (g - m1)
+    m2n = m2 + h.decay2 * (g * g - m2)
+    w_new = w - lr_t * (m1n / (jnp.sqrt(m2n) + 1e-8))
+    return w_new, m1n, m2n
+
+
+def apply_updates(updater_type: str,
+                  hypers: Dict[str, Dict[str, UpdaterHyper]],
+                  params, grads, opt_state, epoch):
+    """Apply one optimizer step.  ``hypers[layer_key][field]`` carries the
+    per-tensor (tag-scoped) hyperparameters; ``epoch`` is the minibatch
+    counter driving the schedules.  Pure — call from inside jit."""
+    new_params = {}
+    if updater_type in ('sgd', 'nag'):
+        new_m = {}
+        step = _sgd_leaf if updater_type == 'sgd' else _nag_leaf
+        for lk, fields in params.items():
+            new_params[lk], new_m[lk] = {}, {}
+            for fk, w in fields.items():
+                h = hypers[lk][fk]
+                lr, mom = h.schedule(epoch)
+                w2, m2 = step(w, grads[lk][fk], opt_state['m'][lk][fk],
+                              lr, mom, h)
+                new_params[lk][fk] = w2
+                new_m[lk][fk] = m2
+        return new_params, {'m': new_m}
+    if updater_type == 'adam':
+        n1, n2 = {}, {}
+        for lk, fields in params.items():
+            new_params[lk], n1[lk], n2[lk] = {}, {}, {}
+            for fk, w in fields.items():
+                h = hypers[lk][fk]
+                w2, m1, m2 = _adam_leaf(w, grads[lk][fk],
+                                        opt_state['m1'][lk][fk],
+                                        opt_state['m2'][lk][fk], epoch, h)
+                new_params[lk][fk] = w2
+                n1[lk][fk] = m1
+                n2[lk][fk] = m2
+        return new_params, {'m1': n1, 'm2': n2}
+    raise ValueError(f'unknown updater type {updater_type}')

@@ -1,0 +1,6 @@
+"""Runtime services: config grammar, metrics, binary IO, prefetch."""
+
+from . import config, io_stream, metric, thread_buffer  # noqa: F401
+from .config import (apply_cli_overrides, cfg_get, parse_config_file,
+                     parse_config_string)
+from .metric import MetricSet

@@ -1,0 +1,129 @@
+"""Configuration tokenizer for the cxxnet ``.conf`` grammar.
+
+Reimplements the grammar accepted by the reference tokenizer
+(``/root/reference/src/utils/config.h:20-189``) without translating its code:
+
+* a config is a stream of ``name = value`` triples; tokens are separated by
+  whitespace; ``=`` is always its own token,
+* ``#`` starts a comment running to end of line,
+* ``"..."`` quotes a single-line string (backslash escapes the next char;
+  a newline inside is an error),
+* ``'...'`` quotes a multi-line string (backslash escapes the next char),
+* pairs are yielded **in file order** — downstream consumers replay them into
+  ``set_param`` calls, and ordering/scoping quirks are part of the contract
+  (see ``/root/reference/src/nnet/nnet_config.h:207-289``).
+
+Unknown keys are silently ignored by consumers, as in the reference.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Iterator, List, Tuple
+
+ConfigEntry = Tuple[str, str]
+
+
+class ConfigError(ValueError):
+    """Raised on malformed config input (unterminated string, bad pair)."""
+
+
+def _tokenize(text: str) -> Iterator[str]:
+    """Yield raw tokens: bare words, quoted strings, and ``=``."""
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == '#':
+            while i < n and text[i] not in '\r\n':
+                i += 1
+            continue
+        if c in ' \t\r\n':
+            i += 1
+            continue
+        if c == '=':
+            yield '='
+            i += 1
+            continue
+        if c in '"\'':
+            quote = c
+            i += 1
+            buf: List[str] = []
+            while True:
+                if i >= n:
+                    raise ConfigError("ConfigReader: unterminated string")
+                ch = text[i]
+                if ch == '\\':
+                    if i + 1 >= n:
+                        raise ConfigError("ConfigReader: unterminated string")
+                    buf.append(text[i + 1])
+                    i += 2
+                    continue
+                if ch == quote:
+                    i += 1
+                    break
+                if quote == '"' and ch in '\r\n':
+                    raise ConfigError("ConfigReader: unterminated string")
+                buf.append(ch)
+                i += 1
+            yield ''.join(buf)
+            continue
+        # bare token: runs until whitespace, '=', '#', or quote
+        j = i
+        while j < n and text[j] not in ' \t\r\n=#"\'':
+            j += 1
+        yield text[i:j]
+        i = j
+
+
+def parse_config_string(text: str) -> List[ConfigEntry]:
+    """Parse config text into an ordered list of ``(name, value)`` pairs."""
+    out: List[ConfigEntry] = []
+    toks = list(_tokenize(text))
+    i = 0
+    while i < len(toks):
+        name = toks[i]
+        if name == '=':
+            raise ConfigError("ConfigReader: stray '='")
+        if i + 2 >= len(toks) or toks[i + 1] != '=':
+            raise ConfigError(f"ConfigReader: expected '{name} = value'")
+        val = toks[i + 2]
+        if val == '=':
+            raise ConfigError(f"ConfigReader: missing value for '{name}'")
+        out.append((name, val))
+        i += 3
+    return out
+
+
+def parse_config_file(path: str) -> List[ConfigEntry]:
+    """Parse a ``.conf`` file into ordered ``(name, value)`` pairs."""
+    with io.open(path, 'r', encoding='utf-8', errors='replace') as f:
+        return parse_config_string(f.read())
+
+
+def apply_cli_overrides(cfg: List[ConfigEntry], argv: List[str]) -> List[ConfigEntry]:
+    """Append ``k=v`` command-line override pairs after the file's pairs.
+
+    Mirrors the reference driver behavior (``cxxnet_main.cpp:67-72``): CLI
+    pairs are replayed after the config file so later values win wherever a
+    consumer keeps only the last value.
+    """
+    out = list(cfg)
+    for arg in argv:
+        if '=' not in arg:
+            raise ConfigError(f"CLI override must be k=v, got: {arg}")
+        k, v = arg.split('=', 1)
+        out.append((k.strip(), v.strip()))
+    return out
+
+
+def cfg_get(cfg: List[ConfigEntry], name: str, default: str | None = None) -> str | None:
+    """Last-value-wins lookup, skipping the literal value ``default``.
+
+    The reference ignores assignments whose value is the string ``default``
+    (``cxxnet_main.cpp:84``); we reproduce that here.
+    """
+    val = default
+    for k, v in cfg:
+        if k == name and v != 'default':
+            val = v
+    return val

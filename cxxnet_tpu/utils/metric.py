@@ -1,0 +1,161 @@
+"""Evaluation metrics.
+
+Same metric semantics as the reference set (``src/utils/metric.h:20-236``):
+``error`` (argmax mismatch; binary threshold-at-0 when the score vector has a
+single column), ``rmse``, ``logloss`` (clipped to [1e-15, 1-1e-15], NaN check
+in the binary case), and ``rec@n``.  ``MetricSet`` carries a label-field name
+per metric (the ``metric[field] = name`` config syntax) and prints
+``\\tevname-metric[field]:value`` like the reference's ``Print``.
+
+Computation is vectorized numpy on host — metrics are an observability
+surface, not a device-compute path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Metric:
+    """Accumulating metric over (predscore, label) instance batches."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.sum_metric = 0.0
+        self.cnt_inst = 0
+
+    def clear(self) -> None:
+        self.sum_metric = 0.0
+        self.cnt_inst = 0
+
+    def add_eval(self, pred: np.ndarray, label: np.ndarray) -> None:
+        """pred: (n, k) score matrix; label: (n, m) label fields."""
+        pred = np.asarray(pred, dtype=np.float64)
+        label = np.asarray(label, dtype=np.float64)
+        if pred.shape[0] == 0:
+            return
+        self.sum_metric += float(np.sum(self._calc(pred, label)))
+        self.cnt_inst += pred.shape[0]
+
+    def get(self) -> float:
+        return self.sum_metric / max(self.cnt_inst, 1)
+
+    def _calc(self, pred: np.ndarray, label: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class MetricRMSE(Metric):
+    def __init__(self):
+        super().__init__('rmse')
+
+    def _calc(self, pred, label):
+        if pred.shape[1] != label.shape[1]:
+            raise ValueError('rmse: pred and label width must match')
+        return np.sum((pred - label) ** 2, axis=1)
+
+    def get(self) -> float:  # reference reports mean squared sum (no sqrt)
+        return self.sum_metric / max(self.cnt_inst, 1)
+
+
+class MetricError(Metric):
+    def __init__(self):
+        super().__init__('error')
+
+    def _calc(self, pred, label):
+        if pred.shape[1] != 1:
+            maxidx = np.argmax(pred, axis=1)
+        else:
+            maxidx = (pred[:, 0] > 0.0).astype(np.int64)
+        return (maxidx != label[:, 0].astype(np.int64)).astype(np.float64)
+
+
+class MetricLogloss(Metric):
+    def __init__(self):
+        super().__init__('logloss')
+
+    def _calc(self, pred, label):
+        eps = 1e-15
+        if pred.shape[1] != 1:
+            target = label[:, 0].astype(np.int64)
+            p = np.clip(pred[np.arange(pred.shape[0]), target], eps, 1.0 - eps)
+            return -np.log(p)
+        py = np.clip(pred[:, 0], eps, 1.0 - eps)
+        y = label[:, 0]
+        res = -(y * np.log(py) + (1.0 - y) * np.log(1.0 - py))
+        if np.any(np.isnan(res)):
+            raise FloatingPointError('logloss: NaN detected!')
+        return res
+
+
+class MetricRecall(Metric):
+    """rec@n: fraction of true labels present in the top-n scores."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        if not name.startswith('rec@'):
+            raise ValueError('must specify n for rec@n')
+        self.topn = int(name[4:])
+
+    def _calc(self, pred, label):
+        n = self.topn
+        if pred.shape[1] < n:
+            raise ValueError(
+                f'rec@{n} meaningless for score list of length {pred.shape[1]}')
+        # top-n indices per row (ties broken arbitrarily, matching the
+        # reference's shuffle-then-sort which randomizes tie order)
+        topidx = np.argpartition(-pred, n - 1, axis=1)[:, :n]
+        hits = np.zeros(pred.shape[0], dtype=np.float64)
+        for j in range(label.shape[1]):
+            hits += np.any(topidx == label[:, j:j + 1].astype(np.int64), axis=1)
+        return hits / label.shape[1]
+
+
+def create_metric(name: str) -> Metric | None:
+    if name == 'rmse':
+        return MetricRMSE()
+    if name == 'error':
+        return MetricError()
+    if name == 'logloss':
+        return MetricLogloss()
+    if name.startswith('rec@'):
+        return MetricRecall(name)
+    return None
+
+
+class MetricSet:
+    """A list of metrics, each bound to a label field name."""
+
+    def __init__(self):
+        self.evals: list[Metric] = []
+        self.label_fields: list[str] = []
+
+    def add_metric(self, name: str, field: str = 'label') -> None:
+        m = create_metric(name)
+        if m is None:
+            raise ValueError(f'Metric: unknown metric name: {name}')
+        self.evals.append(m)
+        self.label_fields.append(field)
+
+    def clear(self) -> None:
+        for m in self.evals:
+            m.clear()
+
+    def add_eval(self, predscores, label_info) -> None:
+        """predscores: list of (n,k) arrays, one per metric; label_info
+        provides ``.field(name) -> (n,m)`` label arrays."""
+        assert len(predscores) == len(self.evals), \
+            'Metric: number of predict scores must equal number of metrics'
+        for m, field, pred in zip(self.evals, self.label_fields, predscores):
+            m.add_eval(pred, label_info.field(field))
+
+    def print(self, evname: str) -> str:
+        out = []
+        for m, field in zip(self.evals, self.label_fields):
+            tag = f'{evname}-{m.name}'
+            if field != 'label':
+                tag += f'[{field}]'
+            out.append(f'\t{tag}:{m.get():g}')
+        return ''.join(out)
+
+    def __len__(self) -> int:
+        return len(self.evals)

@@ -1,0 +1,68 @@
+"""Double-buffered producer-thread prefetcher.
+
+The TPU-native equivalent of the reference's semaphore-driven
+``ThreadBuffer`` (``src/utils/thread_buffer.h:22-202``): a background thread
+runs the producer while the consumer drains a small bounded queue, hiding
+data-pipeline latency behind device compute.  Python threads are adequate
+here because the producers (file IO, JPEG decode via PIL, numpy slicing)
+release the GIL in their hot paths; the native C++ loader (runtime/) can be
+swapped in for the page-decode stage.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, TypeVar
+
+T = TypeVar('T')
+
+_STOP = object()
+
+
+class ThreadBuffer:
+    """Wraps a factory of iterators; prefetches ``buffer_size`` items ahead."""
+
+    def __init__(self, make_iter: Callable[[], Iterator[T]], buffer_size: int = 2):
+        self._make_iter = make_iter
+        self._buffer_size = max(1, buffer_size)
+
+    def _run(self, q: queue.Queue, stop: threading.Event, box: list) -> None:
+        try:
+            for item in self._make_iter():
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # propagate to consumer
+            box.append(e)
+        finally:
+            try:
+                q.put_nowait(_STOP)
+            except queue.Full:
+                pass   # consumer gone; stop flag is set
+
+    def __iter__(self):
+        # restart semantics = BeforeFirst(): a fresh producer each epoch;
+        # if the consumer abandons the generator early (GeneratorExit), the
+        # stop event unblocks and retires the producer thread
+        q: queue.Queue = queue.Queue(maxsize=self._buffer_size)
+        stop = threading.Event()
+        box: list = []
+        thread = threading.Thread(target=self._run, args=(q, stop, box),
+                                  daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _STOP:
+                    if box:
+                        raise box[0]
+                    return
+                yield item
+        finally:
+            stop.set()

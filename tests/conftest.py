@@ -1,0 +1,23 @@
+"""Test environment: force an 8-device virtual CPU mesh.
+
+Tests validate multi-chip sharding logic without TPU hardware via
+``xla_force_host_platform_device_count`` (the driver dry-runs the real
+multi-chip path separately through ``__graft_entry__.dryrun_multichip``).
+
+Note: the container's sitecustomize imports jax and registers the TPU
+(axon) PJRT plugin before pytest loads this conftest, so setting env vars
+alone is not enough — we also update the live jax config before any
+backend is initialized by a test.
+"""
+
+import os
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')

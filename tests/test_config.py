@@ -1,0 +1,52 @@
+"""Config tokenizer grammar tests (quirks from src/utils/config.h)."""
+
+import pytest
+
+from cxxnet_tpu.utils.config import (ConfigError, apply_cli_overrides,
+                                     cfg_get, parse_config_string)
+
+
+def test_basic_pairs_in_order():
+    cfg = parse_config_string('a = 1\nb=2\n  c   =   3\n')
+    assert cfg == [('a', '1'), ('b', '2'), ('c', '3')]
+
+
+def test_comments_stripped():
+    cfg = parse_config_string('# full line comment\na = 1  # trailing\n')
+    assert cfg == [('a', '1')]
+
+
+def test_quoted_strings_with_spaces_and_escapes():
+    cfg = parse_config_string('path = "a b/c.gz"\nq = "x\\"y"\n')
+    assert cfg == [('path', 'a b/c.gz'), ('q', 'x"y')]
+
+
+def test_multiline_single_quote():
+    cfg = parse_config_string("s = 'line1\nline2'\nnext = 1\n")
+    assert cfg == [('s', 'line1\nline2'), ('next', '1')]
+
+
+def test_unterminated_string_raises():
+    with pytest.raises(ConfigError):
+        parse_config_string('a = "oops\n')
+
+
+def test_layer_bracket_names():
+    cfg = parse_config_string('layer[0->1] = conv:c1\nmetric[label] = error\n')
+    assert cfg == [('layer[0->1]', 'conv:c1'), ('metric[label]', 'error')]
+
+
+def test_duplicate_keys_preserved_in_order():
+    cfg = parse_config_string('a = 1\na = 2\n')
+    assert cfg == [('a', '1'), ('a', '2')]
+    assert cfg_get(cfg, 'a') == '2'
+
+
+def test_default_value_skipped():
+    cfg = parse_config_string('a = 1\na = default\n')
+    assert cfg_get(cfg, 'a') == '1'
+
+
+def test_cli_overrides_append():
+    cfg = apply_cli_overrides([('a', '1')], ['a=9', 'b=x'])
+    assert cfg == [('a', '1'), ('a', '9'), ('b', 'x')]

@@ -1,0 +1,258 @@
+"""Differential layer tests — the pairtest harness reborn.
+
+Each layer's JAX forward (and jax.grad backward where the reference
+hand-writes one) is checked against an independent NumPy reference
+implementation, mirroring the reference's PairTestLayer strategy
+(src/layer/pairtest_layer-inl.hpp) with pytest instead of in-graph checks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu.layers import ForwardContext, NodeSpec, create_layer
+from cxxnet_tpu.layers.base import get_layer_type
+
+
+def make_layer(type_str, params=None, name=''):
+    layer = create_layer(get_layer_type(type_str), name=name)
+    for k, v in (params or {}).items():
+        layer.set_param(k, str(v))
+    return layer
+
+
+def run_layer(layer, in_specs, inputs, is_train=False, seed=0):
+    out_specs = layer.infer_shapes(in_specs)
+    rng = jax.random.PRNGKey(seed)
+    params = layer.init_params(rng, in_specs)
+    ctx = ForwardContext(is_train=is_train, rng=rng, layer_index=0)
+    outs = layer.forward(params, [jnp.asarray(x) for x in inputs], ctx)
+    return out_specs, params, [np.asarray(o) for o in outs]
+
+
+def test_fullc_forward_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 10).astype(np.float32)
+    layer = make_layer('fullc', {'nhidden': 7, 'init_sigma': 0.1})
+    specs, params, outs = run_layer(layer, [NodeSpec(1, 1, 10)], [x])
+    assert specs[0].x == 7
+    w, b = np.asarray(params['wmat']), np.asarray(params['bias'])
+    np.testing.assert_allclose(outs[0], x @ w + b, rtol=1e-5)
+
+
+def test_fullc_backward_matches_manual():
+    # reference backward: gW += out_grad^T · in ; gb += sum_rows(out_grad);
+    # in_grad = out_grad · W  (fullc_layer-inl.hpp:113-130)
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 10).astype(np.float32)
+    g = rng.randn(4, 7).astype(np.float32)
+    layer = make_layer('fullc', {'nhidden': 7})
+    layer.infer_shapes([NodeSpec(1, 1, 10)])
+    params = layer.init_params(jax.random.PRNGKey(0), [NodeSpec(1, 1, 10)])
+    ctx = ForwardContext(is_train=True, rng=None, layer_index=0)
+
+    def f(p, xin):
+        return jnp.sum(layer.forward(p, [xin], ctx)[0] * g)
+
+    grads = jax.grad(f, argnums=(0, 1))(params, jnp.asarray(x))
+    w = np.asarray(params['wmat'])
+    np.testing.assert_allclose(np.asarray(grads[0]['wmat']), x.T @ g, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(grads[0]['bias']), g.sum(0), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(grads[1]), g @ w.T, rtol=1e-4)
+
+
+@pytest.mark.parametrize('act,fn', [
+    ('relu', lambda x: np.maximum(x, 0)),
+    ('sigmoid', lambda x: 1 / (1 + np.exp(-x))),
+    ('tanh', np.tanh),
+    ('softplus', lambda x: np.log1p(np.exp(x))),
+])
+def test_activations(act, fn):
+    x = np.random.RandomState(2).randn(3, 5).astype(np.float32)
+    _, _, outs = run_layer(make_layer(act), [NodeSpec(1, 1, 5)], [x])
+    np.testing.assert_allclose(outs[0], fn(x), rtol=1e-5, atol=1e-6)
+
+
+def test_xelu():
+    x = np.array([[-2.0, 0.5]], dtype=np.float32)
+    _, _, outs = run_layer(make_layer('xelu', {'b': 4}), [NodeSpec(1, 1, 2)], [x])
+    np.testing.assert_allclose(outs[0], [[-0.5, 0.5]], rtol=1e-6)
+
+
+def test_flatten_uses_nchw_order():
+    # NHWC input must flatten in reference NCHW element order
+    x = np.arange(2 * 3 * 4 * 5, dtype=np.float32).reshape(2, 3, 4, 5)  # b,y,x,c
+    _, _, outs = run_layer(make_layer('flatten'), [NodeSpec(5, 3, 4)], [x])
+    expect = np.transpose(x, (0, 3, 1, 2)).reshape(2, -1)
+    np.testing.assert_array_equal(outs[0], expect)
+
+
+def test_conv_matches_naive_im2col():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 5, 6, 3).astype(np.float32)        # b,y,x,c
+    layer = make_layer('conv', {'nchannel': 4, 'kernel_size': 3,
+                                'stride': 2, 'pad': 1})
+    specs, params, outs = run_layer(layer, [NodeSpec(3, 5, 6)], [x])
+    w = np.asarray(params['wmat'])                       # kh,kw,cin,cout
+    b = np.asarray(params['bias'])
+    oy, ox = specs[0].y, specs[0].x
+    xp = np.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    ref = np.zeros((2, oy, ox, 4), np.float32)
+    for i in range(oy):
+        for j in range(ox):
+            patch = xp[:, i * 2:i * 2 + 3, j * 2:j * 2 + 3, :]
+            ref[:, i, j, :] = np.tensordot(patch, w, axes=([1, 2, 3], [0, 1, 2]))
+    ref += b
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_grouped_conv_groups_are_independent():
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 4, 4, 4).astype(np.float32)
+    layer = make_layer('conv', {'nchannel': 4, 'kernel_size': 1, 'ngroup': 2,
+                                'no_bias': 1})
+    specs, params, outs = run_layer(layer, [NodeSpec(4, 4, 4)], [x])
+    w = np.asarray(params['wmat'])   # (1,1,2,4): first 2 cout from ch 0-1
+    ref0 = x[..., :2] @ w[0, 0, :, :2]
+    np.testing.assert_allclose(outs[0][..., :2], ref0, rtol=1e-4, atol=1e-5)
+
+
+def test_max_pooling_ceil_shape_and_values():
+    # reference shape: min(in - k + s - 1, in - 1) / s + 1  → 28,k3,s2 → 14
+    x = np.random.RandomState(5).randn(1, 28, 28, 2).astype(np.float32)
+    layer = make_layer('max_pooling', {'kernel_size': 3, 'stride': 2})
+    specs, _, outs = run_layer(layer, [NodeSpec(2, 28, 28)], [x])
+    assert (specs[0].y, specs[0].x) == (14, 14)
+    # last window is clamped: starts at 26, covers rows 26..27
+    ref = x[0, 26:28, 26:28, 0].max()
+    np.testing.assert_allclose(outs[0][0, 13, 13, 0], ref, rtol=1e-6)
+
+
+def test_avg_pooling_divides_by_full_window():
+    x = np.ones((1, 6, 6, 1), np.float32)
+    layer = make_layer('avg_pooling', {'kernel_size': 3, 'stride': 2})
+    specs, _, outs = run_layer(layer, [NodeSpec(1, 6, 6)], [x])
+    # ceil formula: min(6-3+1, 5)//2+1 = 3; last window clamps to 2 rows/cols
+    # but still divides by the full 9 (pooling_layer-inl.hpp:47-49)
+    assert (specs[0].y, specs[0].x) == (3, 3)
+    np.testing.assert_allclose(outs[0][0, 2, 2, 0], 4.0 / 9.0, rtol=1e-6)
+    np.testing.assert_allclose(outs[0][0, 0, 0, 0], 1.0, rtol=1e-6)
+
+
+def test_lrn_matches_naive():
+    rng = np.random.RandomState(6)
+    x = rng.rand(2, 3, 3, 7).astype(np.float32)
+    layer = make_layer('lrn', {'local_size': 5, 'alpha': 0.001,
+                               'beta': 0.75, 'knorm': 1})
+    _, _, outs = run_layer(layer, [NodeSpec(7, 3, 3)], [x])
+    ref = np.zeros_like(x)
+    for c in range(7):
+        lo, hi = max(0, c - 2), min(7, c + 3)
+        norm = 1 + 0.001 / 5 * np.sum(x[..., lo:hi] ** 2, axis=-1)
+        ref[..., c] = x[..., c] * norm ** -0.75
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_batch_norm_uses_batch_stats_even_at_eval():
+    rng = np.random.RandomState(7)
+    x = rng.randn(8, 4, 4, 3).astype(np.float32) * 3 + 1
+    layer = make_layer('batch_norm')
+    _, params, outs = run_layer(layer, [NodeSpec(3, 4, 4)], [x],
+                                is_train=False)
+    out = outs[0]
+    np.testing.assert_allclose(out.mean(axis=(0, 1, 2)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(out.std(axis=(0, 1, 2)), 1.0, atol=1e-3)
+
+
+def test_dropout_train_scales_and_eval_identity():
+    x = np.ones((64, 100), np.float32)
+    layer = make_layer('dropout', {'threshold': 0.5})
+    _, _, outs_eval = run_layer(layer, [NodeSpec(1, 1, 100)], [x],
+                                is_train=False)
+    np.testing.assert_array_equal(outs_eval[0], x)
+    _, _, outs_train = run_layer(layer, [NodeSpec(1, 1, 100)], [x],
+                                 is_train=True)
+    vals = np.unique(outs_train[0])
+    assert set(np.round(vals, 4)) <= {0.0, 2.0}
+    assert abs(outs_train[0].mean() - 1.0) < 0.1
+
+
+def test_softmax_loss_grad_is_p_minus_y():
+    # the defining contract: d(loss)/d(logits) == (softmax(p) - onehot) * scale
+    rng = np.random.RandomState(8)
+    x = rng.randn(5, 4).astype(np.float32)
+    y = np.array([[0.0], [1.0], [2.0], [3.0], [1.0]], np.float32)
+    layer = make_layer('softmax', {'batch_size': 5})
+    layer.infer_shapes([NodeSpec(1, 1, 4)])
+    ctx = ForwardContext(is_train=True, rng=None, layer_index=0)
+
+    grad = jax.grad(
+        lambda xin: layer.loss({}, [xin], jnp.asarray(y), ctx))(jnp.asarray(x))
+    p = np.exp(x) / np.exp(x).sum(1, keepdims=True)
+    onehot = np.eye(4)[y[:, 0].astype(int)]
+    np.testing.assert_allclose(np.asarray(grad), (p - onehot) / 5.0,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_l2_and_multilogistic_grads():
+    rng = np.random.RandomState(9)
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.rand(3, 4).astype(np.float32)
+    ctx = ForwardContext(is_train=True, rng=None, layer_index=0)
+    l2 = make_layer('l2_loss', {'batch_size': 3})
+    g = jax.grad(lambda xin: l2.loss({}, [xin], jnp.asarray(y), ctx))(
+        jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g), (x - y) / 3.0, rtol=1e-4)
+    ml = make_layer('multi_logistic', {'batch_size': 3})
+    g = jax.grad(lambda xin: ml.loss({}, [xin], jnp.asarray(y), ctx))(
+        jnp.asarray(x))
+    p = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(np.asarray(g), (p - y) / 3.0, rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_concat_and_split():
+    a = np.ones((2, 3), np.float32)
+    b = 2 * np.ones((2, 4), np.float32)
+    layer = make_layer('concat')
+    specs, _, outs = run_layer(layer, [NodeSpec(1, 1, 3), NodeSpec(1, 1, 4)],
+                               [a, b])
+    assert specs[0].x == 7
+    np.testing.assert_array_equal(outs[0][:, :3], a)
+    ch = make_layer('ch_concat')
+    xa = np.ones((2, 4, 4, 3), np.float32)
+    xb = np.zeros((2, 4, 4, 2), np.float32)
+    specs, _, outs = run_layer(ch, [NodeSpec(3, 4, 4), NodeSpec(2, 4, 4)],
+                               [xa, xb])
+    assert specs[0].c == 5
+    assert outs[0].shape == (2, 4, 4, 5)
+
+
+def test_prelu():
+    x = np.array([[-4.0, 2.0]], np.float32)
+    layer = make_layer('prelu', {'init_slope': 0.25})
+    _, params, outs = run_layer(layer, [NodeSpec(1, 1, 2)], [x])
+    np.testing.assert_allclose(outs[0], [[-1.0, 2.0]], rtol=1e-6)
+
+
+def test_insanity_eval_uses_midpoint():
+    x = np.array([[-6.0, 3.0]], np.float32)
+    layer = make_layer('insanity', {'lb': 2, 'ub': 4})
+    _, _, outs = run_layer(layer, [NodeSpec(1, 1, 2)], [x], is_train=False)
+    np.testing.assert_allclose(outs[0], [[-2.0, 3.0]], rtol=1e-6)
+
+
+def test_pairtest_agrees_with_itself():
+    x = np.random.RandomState(10).randn(2, 6).astype(np.float32)
+    layer = make_layer('pairtest-relu-relu')
+    _, _, outs = run_layer(layer, [NodeSpec(1, 1, 6)], [x])
+    np.testing.assert_allclose(outs[0], np.maximum(x, 0), rtol=1e-6)
+
+
+def test_maxout():
+    x = np.array([[1.0, 5.0, 2.0, -1.0]], np.float32)
+    layer = make_layer('maxout', {'ngroup': 2})
+    specs, _, outs = run_layer(layer, [NodeSpec(1, 1, 4)], [x])
+    assert specs[0].x == 2
+    np.testing.assert_allclose(outs[0], [[5.0, 2.0]])

@@ -1,0 +1,171 @@
+"""End-to-end: NetConfig grammar → Net → training on synthetic data.
+
+Covers the minimum end-to-end slice (SURVEY.md §7 stage 4): the MNIST.conf
+MLP topology trains on a synthetic separable problem and reaches low error,
+plus checkpoint round-trip and the netconfig parser quirks.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.net_config import NetConfig
+from cxxnet_tpu.nnet.trainer import NetTrainer, parse_devices
+from cxxnet_tpu.utils.config import parse_config_string
+
+MLP_CONF = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.1
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 32
+dev = cpu
+eta = 0.5
+momentum = 0.9
+wd  = 0.0
+metric[label] = error
+"""
+
+
+def synth_batches(n_batches=40, bs=32, dim=16, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, dim).astype(np.float32) * 2
+    batches = []
+    for _ in range(n_batches):
+        y = rng.randint(0, k, size=bs)
+        x = centers[y] + 0.3 * rng.randn(bs, dim).astype(np.float32)
+        batches.append(DataBatch(x.reshape(bs, 1, 1, dim).astype(np.float32),
+                                 y[:, None].astype(np.float32)))
+    return batches
+
+
+def test_netconfig_grammar():
+    cfg = NetConfig()
+    cfg.configure(parse_config_string(MLP_CONF))
+    assert cfg.num_layers == 4
+    assert cfg.num_nodes == 4
+    assert cfg.node_names == ['in', 'fc1', 'sg1', 'fc2']
+    assert cfg.layers[0].nindex_in == [0]
+    assert cfg.layers[0].nindex_out == [1]
+    assert cfg.layers[1].nindex_in == [1]
+    assert cfg.layers[1].nindex_out == [2]
+    # layer[sg1->fc2] reuses node name fc1? no — allocates node named fc2
+    assert cfg.layers[3].nindex_in == cfg.layers[3].nindex_out  # self-loop
+    assert cfg.layer_name_map == {'fc1': 0, 'se1': 1, 'fc2': 2}
+    assert cfg.input_shape == (1, 1, 16)
+
+
+def test_netconfig_binary_roundtrip():
+    cfg = NetConfig()
+    cfg.configure(parse_config_string(MLP_CONF))
+    buf = io.BytesIO()
+    cfg.save_net(buf)
+    buf.seek(0)
+    cfg2 = NetConfig()
+    cfg2.load_net(buf)
+    assert cfg2.num_layers == cfg.num_layers
+    assert cfg2.node_names == cfg.node_names
+    assert all(a.struct_eq(b) for a, b in zip(cfg.layers, cfg2.layers))
+    assert cfg2.input_shape == cfg.input_shape
+
+
+def test_parse_devices():
+    assert parse_devices('gpu:0-3') == [0, 1, 2, 3]
+    assert parse_devices('tpu:0,2,5') == [0, 2, 5]
+    assert parse_devices('cpu') == []
+
+
+def test_mlp_trains_on_synthetic():
+    trainer = NetTrainer(parse_config_string(MLP_CONF))
+    trainer.init_model()
+    batches = synth_batches()
+    for round_ in range(6):
+        trainer.start_round(round_)
+        for b in batches:
+            trainer.update(b)
+    res = trainer.evaluate(iter(batches[:10]), 'test')
+    err = float(res.split(':')[-1])
+    assert err < 0.05, f'MLP failed to learn: {res}'
+
+
+def test_checkpoint_roundtrip_and_continue():
+    trainer = NetTrainer(parse_config_string(MLP_CONF))
+    trainer.init_model()
+    batches = synth_batches(n_batches=10)
+    for b in batches:
+        trainer.update(b)
+    buf = io.BytesIO()
+    trainer.save_model(buf)
+    res1 = trainer.evaluate(iter(batches), 'test')
+
+    trainer2 = NetTrainer(parse_config_string(MLP_CONF))
+    buf.seek(0)
+    trainer2.load_model(buf)
+    assert trainer2.epoch_counter == trainer.epoch_counter == 10
+    res2 = trainer2.evaluate(iter(batches), 'test')
+    assert res1.split(':')[-1] == res2.split(':')[-1]
+
+
+def test_finetune_copies_named_layers():
+    trainer = NetTrainer(parse_config_string(MLP_CONF))
+    trainer.init_model()
+    buf = io.BytesIO()
+    trainer.save_model(buf)
+    buf.seek(0)
+    trainer2 = NetTrainer(parse_config_string(MLP_CONF))
+    trainer2.copy_model_from(buf)
+    w1 = np.asarray(trainer.params['0']['wmat'])
+    w2 = np.asarray(trainer2.params['0']['wmat'])
+    np.testing.assert_allclose(w1, w2)
+    assert trainer2.epoch_counter == 0
+
+
+def test_update_period_accumulates():
+    conf = MLP_CONF + '\nupdate_period = 2\n'
+    trainer = NetTrainer(parse_config_string(conf))
+    trainer.init_model()
+    w0 = np.asarray(trainer.params['0']['wmat']).copy()
+    batches = synth_batches(n_batches=2)
+    trainer.update(batches[0])
+    w_after_1 = np.asarray(trainer.params['0']['wmat'])
+    np.testing.assert_array_equal(w0, w_after_1)  # no update yet
+    assert trainer.epoch_counter == 0
+    trainer.update(batches[1])
+    assert trainer.epoch_counter == 1
+    assert not np.array_equal(w0, np.asarray(trainer.params['0']['wmat']))
+
+
+def test_shared_layer_reuses_weights():
+    conf = """
+netconfig=start
+layer[+1:h1] = fullc:shared_fc
+  nhidden = 16
+layer[+1:a1] = sigmoid
+layer[a1->h2] = share[shared_fc]
+layer[+1] = fullc:out
+  nhidden = 16
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,16
+batch_size = 4
+dev = cpu
+metric = error
+"""
+    trainer = NetTrainer(parse_config_string(conf))
+    trainer.init_model()
+    # only 3 layers own params: shared_fc(0), out(3); share layer(2) aliases 0
+    assert set(trainer.params.keys()) == {'0', '3'}
+    rng = np.random.RandomState(0)
+    batch = DataBatch(rng.randn(4, 1, 1, 16).astype(np.float32),
+                      np.zeros((4, 1), np.float32))
+    trainer.update(batch)  # must run without error

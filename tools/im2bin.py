@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""im2bin — pack images listed in a .lst file into a BinaryPage stream.
+
+Equivalent of the reference packer (``/root/reference/tools/im2bin.cpp``):
+each image file's raw encoded bytes become one object in a sequence of
+64MB pages; records follow .lst order so the imgbin iterator can pair them.
+
+Usage: python tools/im2bin.py image.lst image_root out.bin
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from cxxnet_tpu.io.iter_img import parse_lst_line
+from cxxnet_tpu.utils.io_stream import BinaryPage
+
+
+def main(argv):
+    if len(argv) != 3:
+        print(__doc__)
+        return 1
+    lst_path, root, out_path = argv
+    page = BinaryPage()
+    n = 0
+    with open(out_path, 'wb') as fo, open(lst_path) as fl:
+        for line in fl:
+            if not line.strip():
+                continue
+            _, _, fname = parse_lst_line(line)
+            with open(os.path.join(root, fname) if root != '.' else fname,
+                      'rb') as fi:
+                blob = fi.read()
+            if not page.push(blob):
+                page.save(fo)
+                page.clear()
+                if not page.push(blob):
+                    raise ValueError(f'image larger than a page: {fname}')
+            n += 1
+            if n % 1000 == 0:
+                print(f'{n} images packed')
+        if page.size:
+            page.save(fo)
+    print(f'packed {n} images into {out_path}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))
