@@ -239,6 +239,9 @@ class ForwardContext:
     layer_index: int = -1
     round: int = 0                           # training round (insanity anneal)
     max_round: int = 1
+    # activation dtype for the MXU path (bfloat16 for mixed precision);
+    # params and loss stay float32, matmuls accumulate in float32
+    compute_dtype: object = jnp.float32
 
     def layer_rng(self) -> jax.Array:
         if self.rng is None:

@@ -49,10 +49,11 @@ class FullConnectLayer(Layer):
 
     def forward(self, params, inputs, ctx):
         x = as_mat(inputs[0])
-        out = x @ params['wmat']
+        w = params['wmat'].astype(x.dtype)
+        out = jnp.dot(x, w)
         if self.param.no_bias == 0:
-            out = out + params['bias']
-        return [out]
+            out = out + params['bias'].astype(x.dtype)
+        return [out.astype(x.dtype)]
 
 
 class _ActivationLayer(Layer):

@@ -61,13 +61,15 @@ class ConvolutionLayer(Layer):
     def forward(self, params, inputs, ctx):
         p = self.param
         x = inputs[0]  # (b, y, x, c)
+        # operands share the activation dtype; the MXU accumulates in f32
+        # internally for bf16 inputs, so no preferred_element_type needed
+        # (which also trips the conv transpose rule on mixed cotangents)
         out = lax.conv_general_dilated(
-            x, params['wmat'],
+            x, params['wmat'].astype(x.dtype),
             window_strides=(p.stride, p.stride),
             padding=((p.pad_y, p.pad_y), (p.pad_x, p.pad_x)),
             dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
-            feature_group_count=p.num_group,
-            preferred_element_type=jnp.float32)
+            feature_group_count=p.num_group)
         if p.no_bias == 0:
-            out = out + params['bias']
+            out = out + params['bias'].astype(x.dtype)
         return [out.astype(x.dtype)]

@@ -61,7 +61,8 @@ class LossLayerBase(Layer):
         """Scalar loss.  labels: (batch, label_width) for this layer's
         target field; mask: optional (batch,) 0/1 instance weights for
         padded tail batches."""
-        per_inst = self._per_instance_loss(as_mat(inputs[0]), labels)
+        x = as_mat(inputs[0]).astype(jnp.float32)   # losses always in f32
+        per_inst = self._per_instance_loss(x, labels)
         if mask is not None:
             per_inst = per_inst * mask
         return jnp.sum(per_inst) * self.scale
@@ -76,7 +77,8 @@ class SoftmaxLayer(LossLayerBase):
     type_id = kSoftmax
 
     def forward(self, params, inputs, ctx):
-        return [jax.nn.softmax(as_mat(inputs[0]), axis=-1)]
+        return [jax.nn.softmax(as_mat(inputs[0]).astype(jnp.float32),
+                               axis=-1)]
 
     def _per_instance_loss(self, x, labels):
         logp = jax.nn.log_softmax(x, axis=-1)

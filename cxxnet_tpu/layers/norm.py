@@ -47,17 +47,18 @@ class LRNLayer(Layer):
 
     def forward(self, params, inputs, ctx):
         x = inputs[0]  # (b, y, x, c)
+        x32 = x.astype(jnp.float32)
         n = self.nsize
         half_lo = (n - 1) // 2
         half_hi = n - 1 - half_lo
-        sq = x * x
+        sq = x32 * x32
         # cross-channel window sum via cumulative sum along the channel axis
         c = x.shape[-1]
         pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half_lo + 1, half_hi)])
         cums = jnp.cumsum(pad, axis=-1)
         window = (cums[..., n:n + c] - cums[..., 0:c])
         norm = window * (self.alpha / n) + self.knorm
-        return [x * jnp.power(norm, -self.beta)]
+        return [(x32 * jnp.power(norm, -self.beta)).astype(x.dtype)]
 
 
 @register_layer
@@ -93,9 +94,10 @@ class BatchNormLayer(Layer):
 
     def forward(self, params, inputs, ctx):
         x = inputs[0]
+        x32 = x.astype(jnp.float32)
         axes = tuple(range(x.ndim - 1))   # all but trailing channel/feature
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.mean((x - mean) ** 2, axis=axes)
+        mean = jnp.mean(x32, axis=axes)
+        var = jnp.mean((x32 - mean) ** 2, axis=axes)
         # batch statistics at train AND eval — the reference quirk
-        xhat = (x - mean) / jnp.sqrt(var + self.eps)
-        return [xhat * params['wmat'] + params['bias']]
+        xhat = (x32 - mean) / jnp.sqrt(var + self.eps)
+        return [(xhat * params['wmat'] + params['bias']).astype(x.dtype)]

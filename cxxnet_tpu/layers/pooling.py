@@ -41,8 +41,10 @@ def _reduce_pool(x, ky, kx, stride, mode):
         init, op = -jnp.inf, lax.max
     else:
         init, op = 0.0, lax.add
+    # init must stay a concrete scalar: a traced constant would stop JAX
+    # from recognizing the max/sum special forms, losing the autodiff rule
     out = lax.reduce_window(
-        x, jnp.asarray(init, x.dtype), op,
+        x, init, op,
         window_dimensions=(1, ky, kx, 1),
         window_strides=(1, stride, stride, 1),
         padding=((0, 0), (0, pad_y), (0, pad_x), (0, 0)))
@@ -58,17 +60,25 @@ class _PoolingBase(Layer):
         p, s = self.param, in_specs[0]
         if p.kernel_height <= 0 or p.kernel_width <= 0:
             raise ValueError('pooling: must set kernel_size correctly')
-        if p.kernel_width > s.x or p.kernel_height > s.y:
+        iy, ix = s.y + 2 * p.pad_y, s.x + 2 * p.pad_x
+        if p.kernel_width > ix or p.kernel_height > iy:
             raise ValueError('pooling: kernel size exceeds input')
         return [NodeSpec(s.c,
-                         pool_out_dim(s.y, p.kernel_height, p.stride),
-                         pool_out_dim(s.x, p.kernel_width, p.stride))]
+                         pool_out_dim(iy, p.kernel_height, p.stride),
+                         pool_out_dim(ix, p.kernel_width, p.stride))]
 
     def forward(self, params, inputs, ctx):
         p = self.param
         x = inputs[0]
         if self.pre_relu:
             x = jnp.maximum(x, 0.0)
+        if p.pad_y or p.pad_x:
+            # pad extension (the reference pooling has none): -inf for max
+            # so padding never wins; 0 for sum/avg
+            fill = -jnp.inf if self.mode == 'max' else 0.0
+            x = jnp.pad(x, ((0, 0), (p.pad_y, p.pad_y),
+                            (p.pad_x, p.pad_x), (0, 0)),
+                        constant_values=fill)
         out = _reduce_pool(x, p.kernel_height, p.kernel_width, p.stride,
                            self.mode)
         if self.mode == 'avg':

@@ -1,0 +1,15 @@
+"""Model zoo: programmatic builders for the benchmark topologies.
+
+Each builder returns ``.conf`` netconfig text identical in topology to the
+reference acceptance configs (BASELINE.md):
+
+* ``mlp_conf``    — example/MNIST/MNIST.conf 2-layer MLP
+* ``lenet_conf``  — example/MNIST/MNIST_CONV.conf conv net
+* ``alexnet_conf``— example/ImageNet/ImageNet.conf single-tower AlexNet
+  (grouped convs, LRN, dropout)
+* ``inception_bn_conf`` — GoogLeNet-family Inception with BatchNorm (the
+  reference has no in-tree conf; built from its conv/ch_concat/batch_norm
+  layers following the cxxnet-era model-zoo Inception-BN arrangement)
+"""
+
+from .builders import alexnet_conf, inception_bn_conf, lenet_conf, mlp_conf

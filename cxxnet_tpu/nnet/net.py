@@ -132,13 +132,16 @@ class Net:
         return params.get(str(self.layer_primary[i]), {})
 
     # --- forward / loss ---------------------------------------------------
-    def _input_to_device_layout(self, batch):
+    def _input_to_device_layout(self, batch, compute_dtype=jnp.float32):
         """Host batches arrive NCHW (c,y,x per instance); convert to the
-        on-device layout (NHWC images, flat matrices)."""
-        spec = self.node_specs[0]
+        on-device layout (NHWC images, flat matrices) and activation dtype.
+        Integer (uint8 pixel) batches are welcome — shipping raw bytes and
+        casting on device quarters host->device traffic."""
+        batch = batch.astype(compute_dtype)
         if batch.ndim == 2:
             return batch
         if batch.ndim == 4:
+            spec = self.node_specs[0]
             if spec.is_mat:
                 return batch.reshape(batch.shape[0], -1)
             return jnp.transpose(batch, (0, 2, 3, 1))
@@ -157,7 +160,7 @@ class Net:
         """
         cfg = self.cfg
         values: List[Optional[jax.Array]] = [None] * cfg.num_nodes
-        values[0] = self._input_to_device_layout(batch)
+        values[0] = self._input_to_device_layout(batch, ctx.compute_dtype)
         if cfg.extra_data_num:
             if extra_data is None or len(extra_data) < cfg.extra_data_num:
                 raise ValueError(

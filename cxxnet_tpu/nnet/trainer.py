@@ -37,6 +37,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..layers import ForwardContext
 from ..layers.loss import LossLayerBase
+from ..parallel.mesh import (batch_sharding, build_mesh, param_shardings,
+                             replicated_sharding)
 from ..updater import (apply_updates, create_updater_hyper, init_opt_state)
 from ..utils.metric import MetricSet
 from . import checkpoint
@@ -72,6 +74,8 @@ class NetTrainer:
         self.seed = 0
         self.round = 0
         self.max_round = 1
+        self.tensor_parallel = 1
+        self.compute_dtype = jnp.float32
         self.devices: List[int] = []
         self.metric = MetricSet()
         self.train_metric = MetricSet()
@@ -103,6 +107,14 @@ class NetTrainer:
             self.seed = int(val)
         if name == 'max_round':
             self.max_round = int(val)
+        if name == 'tensor_parallel':
+            self.tensor_parallel = int(val)
+        if name == 'compute_type':
+            table = {'float32': jnp.float32, 'bfloat16': jnp.bfloat16,
+                     'float16': jnp.float16}
+            if val not in table:
+                raise ValueError(f'unknown compute_type {val}')
+            self.compute_dtype = table[val]
         if name == 'metric' or name.startswith('metric['):
             # forms: metric / metric[field] / metric[field,node]; the node
             # part may itself contain brackets (top[-1]), so split on the
@@ -130,7 +142,7 @@ class NetTrainer:
                     devs.append(d)
         else:
             devs = [all_devs[0]]
-        return Mesh(np.asarray(devs), ('data',))
+        return build_mesh(devs, tp=self.tensor_parallel)
 
     def _resolve_eval_nodes(self) -> List[int]:
         out = []
@@ -167,19 +179,25 @@ class NetTrainer:
         self._post_params_init()
 
     def _post_params_init(self) -> None:
-        self.params = self._replicate(self.params)
-        self.opt_state = self._replicate(
-            init_opt_state(self.net_cfg.updater_type, self.params))
-        self.grad_acc = self._replicate(
-            jax.tree.map(jnp.zeros_like, self.params))
+        shardings = param_shardings(self.net, self.params, self._mesh)
+        put = lambda tree: jax.tree.map(  # noqa: E731
+            jax.device_put, tree, shardings)
+        self.params = put(self.params)
+        opt = init_opt_state(self.net_cfg.updater_type, self.params)
+        self.opt_state = {k: put(v) for k, v in opt.items()}
+        self.grad_acc = put(jax.tree.map(jnp.zeros_like, self.params))
 
-    def _replicate(self, tree):
-        sharding = NamedSharding(self._mesh, P())
-        return jax.device_put(tree, sharding)
-
-    def _shard_batch(self, data: np.ndarray):
-        sharding = NamedSharding(self._mesh, P('data'))
-        return jax.device_put(jnp.asarray(data), sharding)
+    def _shard_batch(self, data: np.ndarray, cast: bool = True):
+        data = np.asarray(data)
+        if data.dtype == np.float64:
+            data = data.astype(np.float32)
+        elif (cast and data.dtype == np.float32
+              and self.compute_dtype == jnp.bfloat16):
+            # ship activations at compute precision (host-side cast via
+            # ml_dtypes): halves H2D traffic
+            import ml_dtypes
+            data = data.astype(ml_dtypes.bfloat16)
+        return jax.device_put(jnp.asarray(data), batch_sharding(self._mesh))
 
     # --- jitted steps -----------------------------------------------------
     def _compile_steps(self) -> None:
@@ -188,9 +206,12 @@ class NetTrainer:
         updater_type = self.net_cfg.updater_type
         hypers = self.hypers
 
+        compute_dtype = self.compute_dtype
+
         def loss_fn(params, data, label, extra, rng, rnd):
             ctx = ForwardContext(is_train=True, rng=rng, round=rnd,
-                                 max_round=self.max_round)
+                                 max_round=self.max_round,
+                                 compute_dtype=compute_dtype)
             values, loss = net.forward(params, data, ctx,
                                        labels=net.make_label_info(label),
                                        extra_data=extra)
@@ -211,7 +232,8 @@ class NetTrainer:
         @jax.jit
         def forward_step(params, data, extra, rnd):
             ctx = ForwardContext(is_train=False, rng=None, round=rnd,
-                                 max_round=self.max_round)
+                                 max_round=self.max_round,
+                                 compute_dtype=compute_dtype)
             values, _ = net.forward(params, data, ctx, extra_data=extra)
             return values
 
@@ -229,7 +251,7 @@ class NetTrainer:
         rng = jax.random.fold_in(self._rng, 1 + self.sample_counter * 131 +
                                  self.round)
         data = self._shard_batch(batch.data)
-        label = self._shard_batch(batch.label)
+        label = self._shard_batch(batch.label, cast=False)
         extra = tuple(self._shard_batch(e) for e in batch.extra_data)
         (self.params, self.opt_state, self.grad_acc, loss, evals) = \
             self._train_step_fn(self.params, self.opt_state, self.grad_acc,
@@ -243,6 +265,22 @@ class NetTrainer:
             n = batch.batch_size - batch.num_batch_padd
             self.train_metric.add_eval(
                 [np.asarray(e)[:n] for e in evals], label_info.slice(n))
+        if do_update:
+            self.epoch_counter += 1
+        self.sample_counter += 1
+
+    def update_on_device(self, data, label) -> None:
+        """One training step over batches already resident on device (jax
+        arrays with the right shardings).  Used by benchmarks and by data
+        pipelines that pre-stage batches to hide host->device latency."""
+        do_update = (self.sample_counter + 1) % self.update_period == 0
+        rng = jax.random.fold_in(self._rng, 1 + self.sample_counter * 131 +
+                                 self.round)
+        (self.params, self.opt_state, self.grad_acc, _, _) = \
+            self._train_step_fn(self.params, self.opt_state, self.grad_acc,
+                                data, label, (), rng,
+                                self.epoch_counter, self.round,
+                                do_update=do_update)
         if do_update:
             self.epoch_counter += 1
         self.sample_counter += 1

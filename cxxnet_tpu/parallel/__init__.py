@@ -1,0 +1,5 @@
+"""Parallelism: device meshes, shardings, and distributed init."""
+
+from .mesh import (batch_sharding, build_mesh, param_shardings,
+                   replicated_sharding)
+from .distributed import maybe_init_distributed

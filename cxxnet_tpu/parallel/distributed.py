@@ -1,0 +1,47 @@
+"""Multi-host (DCN) initialization.
+
+Replaces the reference's distributed parameter-server deployment
+(``param_server = dist`` + ps-lite launcher, ``src/nnet/nnet_ps_server.cpp``)
+with ``jax.distributed``: every host runs the same trainer; the global mesh
+spans all hosts' devices; gradients ride ICI within a slice and DCN across
+hosts through the same XLA collectives.  The reference's env contract is
+kept: ``PS_RANK`` (worker rank) and ``dist_num_worker`` map onto
+process_id/num_processes, and the data pipeline shards input per worker
+exactly as ``iter_thread_imbin-inl.hpp:189-220`` did.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_init_distributed(cfg_pairs) -> bool:
+    """Initialize jax.distributed when the config/environment asks for it.
+
+    Triggers on ``param_server = dist`` (reference spelling) or the
+    presence of standard cluster env vars.  Returns True if distributed
+    mode was initialized.
+    """
+    want = any(k == 'param_server' and v == 'dist' for k, v in cfg_pairs)
+    coord = os.environ.get('CXXNET_COORDINATOR',
+                           os.environ.get('COORDINATOR_ADDRESS'))
+    if not want and coord is None:
+        return False
+    import jax
+    nproc = int(os.environ.get('CXXNET_NUM_WORKER',
+                               _cfg_get(cfg_pairs, 'dist_num_worker', '1')))
+    rank = int(os.environ.get('PS_RANK',
+                              _cfg_get(cfg_pairs, 'dist_worker_rank', '0')))
+    if nproc <= 1:
+        return False
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nproc, process_id=rank)
+    return True
+
+
+def _cfg_get(cfg_pairs, name, default):
+    val = default
+    for k, v in cfg_pairs:
+        if k == name:
+            val = v
+    return val

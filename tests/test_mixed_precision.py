@@ -1,0 +1,50 @@
+"""Mixed precision (bfloat16 activations) and uint8 input path."""
+
+import numpy as np
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_string
+from tests.test_net_mnist import MLP_CONF, synth_batches
+
+
+def test_mlp_trains_in_bfloat16():
+    conf = MLP_CONF + '\ncompute_type = bfloat16\n'
+    trainer = NetTrainer(parse_config_string(conf))
+    trainer.init_model()
+    batches = synth_batches()
+    for round_ in range(6):
+        trainer.start_round(round_)
+        for b in batches:
+            trainer.update(b)
+    res = trainer.evaluate(iter(batches[:10]), 'test')
+    err = float(res.split(':')[-1])
+    assert err < 0.05, f'bf16 MLP failed to learn: {res}'
+    # params stay float32 (mixed precision: bf16 activations only)
+    assert trainer.params['0']['wmat'].dtype == np.float32
+
+
+def test_uint8_input_batch():
+    conf = """
+netconfig=start
+layer[0->1] = conv:c1
+  nchannel = 4
+  kernel_size = 3
+layer[1->2] = flatten
+layer[2->3] = fullc:f1
+  nhidden = 4
+layer[3->3] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 8
+dev = cpu
+metric = error
+"""
+    trainer = NetTrainer(parse_config_string(conf))
+    trainer.init_model()
+    rng = np.random.RandomState(0)
+    batch = DataBatch(rng.randint(0, 256, (8, 3, 8, 8), dtype=np.uint8),
+                      rng.randint(0, 4, (8, 1)).astype(np.float32))
+    trainer.update(batch)          # uint8 ships raw, casts on device
+    pred = trainer.predict(batch)
+    assert pred.shape == (8,)
