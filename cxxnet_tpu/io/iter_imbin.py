@@ -87,15 +87,33 @@ class ImageBinIterator(IIterator):
                   f'worker {rank}/{nworker}')
 
     def _iter_pages(self, bin_path):
+        """Prefer the native C++ page reader (background prefetch thread +
+        libjpeg); fall back to the Python BinaryPage parser."""
+        from ..runtime.native import NativePageReader, native_available
+        if native_available():
+            reader = NativePageReader(bin_path)
+            try:
+                yield from reader.iter_pages()
+            finally:
+                reader.close()
+            return
         with open(bin_path, 'rb') as f:
             while True:
                 page = BinaryPage()
                 if not page.load(f):
                     return
-                yield page
+                yield list(page)
+
+    def _decode(self, blob):
+        from ..runtime.native import decode_jpeg
+        arr = decode_jpeg(blob)          # fast path: native libjpeg
+        if arr is None:                  # non-JPEG (png, ...) or no native
+            from PIL import Image
+            with Image.open(io.BytesIO(blob)) as im:
+                arr = np.asarray(im.convert('RGB'), np.uint8)
+        return np.transpose(arr.astype(np.float32), (2, 0, 1))
 
     def __iter__(self):
-        from PIL import Image
         sharded, rank, nworker = self._single_shard
         order = list(range(len(self._bins)))
         rng = np.random.RandomState(self.seed_data) if self.shuffle else None
@@ -105,8 +123,7 @@ class ImageBinIterator(IIterator):
             with open(self._lists[part]) as f:
                 lines = (parse_lst_line(l) for l in f if l.strip())
                 lines = iter(list(lines))
-            page_idx = 0
-            for page in self._iter_pages(self._bins[part]):
+            for page_idx, page in enumerate(self._iter_pages(self._bins[part])):
                 take = (not sharded) or (page_idx % nworker == rank)
                 for blob in page:
                     try:
@@ -116,9 +133,6 @@ class ImageBinIterator(IIterator):
                             'imgbin: .lst shorter than .bin contents')
                     if not take:
                         continue
-                    with Image.open(io.BytesIO(blob)) as im:
-                        arr = np.asarray(im.convert('RGB'), np.float32)
-                    yield DataInst(index, np.transpose(arr, (2, 0, 1)),
+                    yield DataInst(index, self._decode(blob),
                                    labels[:self.label_width]
                                    if self.label_width else labels)
-                page_idx += 1

@@ -1,0 +1,3 @@
+"""Native runtime bindings (C++ data loader, ctypes)."""
+
+from .native import NativePageReader, decode_jpeg, native_available

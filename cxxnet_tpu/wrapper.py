@@ -1,0 +1,230 @@
+"""User-level API with the reference wrapper's surface.
+
+The reference exposed its C++ trainer to Python through a C ABI + ctypes
+(``wrapper/cxxnet_wrapper.h:29-225``, ``wrapper/cxxnet.py:64-312``).  Here
+the trainer *is* Python/JAX, so the same user API — ``DataIter``, ``Net``
+(set_param/init_model/load/save/start_round/update/evaluate/predict/
+extract/set_weight/get_weight) and module-level ``train()`` helpers — binds
+directly, with no FFI hop on the train path.  Semantics preserved:
+
+* ``Net.update`` accepts a DataIter positioned on a batch or a raw
+  ``(batch, channel, y, x)`` numpy array + label,
+* ``get_weight``/``set_weight`` use the reference's on-disk weight layouts
+  (fullc wmat ``(nhidden, nin)``, conv ``(ngroup, nch/g, nin/g*kh*kw)``),
+  addressed by layer name and tag ('wmat'/'bias'),
+* model files interoperate with the CLI's ``models/%04d.model`` format.
+"""
+
+from __future__ import annotations
+
+import struct
+import sys
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+
+from .io.data import DataBatch, create_iterator
+from .nnet import checkpoint
+from .nnet.trainer import NetTrainer
+from .utils.config import parse_config_string
+
+
+class DataIter:
+    """Config-driven data iterator with the reference's cursor protocol."""
+
+    def __init__(self, cfg: str):
+        self._it = create_iterator(parse_config_string(cfg))
+        self._it.init()
+        self._cursor: Optional[Iterator] = None
+        self._batch: Optional[DataBatch] = None
+        self.head = True
+        self.tail = False
+
+    def before_first(self) -> None:
+        self._cursor = iter(self._it)
+        self._batch = None
+        self.head = True
+        self.tail = False
+
+    def next(self) -> bool:
+        if self._cursor is None:
+            self.before_first()
+        try:
+            self._batch = next(self._cursor)
+            self.head = False
+            return True
+        except StopIteration:
+            self.tail = True
+            self._batch = None
+            return False
+
+    def check_valid(self) -> None:
+        if self.head:
+            raise RuntimeError('iterator at head state; call next() first')
+        if self.tail:
+            raise RuntimeError('iterator reached end')
+
+    @property
+    def value(self) -> DataBatch:
+        self.check_valid()
+        return self._batch
+
+    def get_data(self) -> np.ndarray:
+        return np.asarray(self.value.data, np.float32)
+
+    def get_label(self) -> np.ndarray:
+        return np.asarray(self.value.label, np.float32)
+
+
+class Net:
+    """Neural net object (reference ``Net``, wrapper/cxxnet.py:105-280)."""
+
+    def __init__(self, dev: str = 'tpu', cfg: str = ''):
+        self._pairs = list(parse_config_string(cfg)) if cfg else []
+        if dev:
+            self._pairs.append(('dev', dev))
+        self._trainer: Optional[NetTrainer] = None
+
+    def _require(self) -> NetTrainer:
+        if self._trainer is None:
+            raise RuntimeError('call init_model()/load_model() first')
+        return self._trainer
+
+    def set_param(self, name, value) -> None:
+        self._pairs.append((str(name), str(value)))
+
+    def init_model(self) -> None:
+        self._trainer = NetTrainer(self._pairs)
+        self._trainer.init_model()
+
+    def load_model(self, fname: str) -> None:
+        self._trainer = NetTrainer(self._pairs)
+        with open(fname, 'rb') as f:
+            f.read(4)   # net_type prefix
+            self._trainer.load_model(f)
+
+    def save_model(self, fname: str, net_type: int = 0) -> None:
+        with open(fname, 'wb') as f:
+            f.write(int(net_type).to_bytes(4, 'little', signed=True))
+            self._require().save_model(f)
+
+    def start_round(self, round_counter: int) -> None:
+        self._require().start_round(round_counter)
+
+    def update(self, data, label=None) -> None:
+        tr = self._require()
+        if isinstance(data, DataIter):
+            tr.update(data.value)
+            return
+        data = np.asarray(data, np.float32)
+        if data.ndim != 4:
+            raise ValueError('Net.update: need 4-d (batch, channel, y, x)')
+        if label is None:
+            raise ValueError('Net.update: need label')
+        label = np.asarray(label, np.float32)
+        if label.ndim == 1:
+            label = label[:, None]
+        if label.shape[0] != data.shape[0]:
+            raise ValueError('Net.update: data/label size mismatch')
+        tr.update(DataBatch(data, label))
+
+    def evaluate(self, data: 'DataIter', name: str) -> str:
+        if not isinstance(data, DataIter):
+            raise TypeError('evaluate needs a DataIter')
+        data.before_first()
+        return self._require().evaluate(iter(data._it), name)
+
+    def predict(self, data) -> np.ndarray:
+        tr = self._require()
+        if isinstance(data, DataIter):
+            return tr.predict(data.value)
+        data = np.asarray(data, np.float32)
+        if data.ndim != 4:
+            raise ValueError('need 4-d tensor to predict')
+        return tr.predict(DataBatch(data, np.zeros((data.shape[0], 1),
+                                                   np.float32)))
+
+    def extract(self, data, name: str) -> np.ndarray:
+        tr = self._require()
+        if isinstance(data, DataIter):
+            return tr.extract_feature(data.value, name)
+        data = np.asarray(data, np.float32)
+        return tr.extract_feature(
+            DataBatch(data, np.zeros((data.shape[0], 1), np.float32)), name)
+
+    # --- weight access (visitor equivalent) -------------------------------
+    def _resolve(self, layer_name: str):
+        tr = self._require()
+        idx = tr.net_cfg.get_layer_index(layer_name)
+        return tr, idx, tr.net_cfg.layers[idx].type
+
+    def get_weight(self, layer_name: str, tag: str) -> Optional[np.ndarray]:
+        if tag not in ('bias', 'wmat'):
+            raise ValueError('tag must be bias or wmat')
+        tr, idx, type_id = self._resolve(layer_name)
+        rec = tr.params.get(str(idx), {})
+        if tag not in rec:
+            return None
+        arr = np.asarray(jax.device_get(rec[tag]), np.float32)
+        layer = tr.net.layers[idx]
+        return checkpoint.to_disk_layout(type_id, tag, arr,
+                                         layer.param.num_group)
+
+    def set_weight(self, weight: np.ndarray, layer_name: str,
+                   tag: str) -> None:
+        if tag not in ('bias', 'wmat'):
+            raise ValueError('tag must be bias or wmat')
+        tr, idx, type_id = self._resolve(layer_name)
+        key = str(idx)
+        if key not in tr.params or tag not in tr.params[key]:
+            raise KeyError(f'layer {layer_name} has no weight {tag}')
+        layer = tr.net.layers[idx]
+        mem = checkpoint.from_disk_layout(
+            type_id, tag, np.asarray(weight, np.float32), layer)
+        if mem.shape != tr.params[key][tag].shape:
+            raise ValueError(
+                f'set_weight: shape {mem.shape} != '
+                f'{tr.params[key][tag].shape}')
+        params = dict(tr.params)
+        params[key] = dict(params[key])
+        params[key][tag] = jax.device_put(mem,
+                                          tr.params[key][tag].sharding)
+        tr.params = params
+
+
+def train_iter(cfg: str, data: DataIter, num_round: int, param,
+               eval_data: Optional[DataIter] = None) -> Net:
+    """Module-level train helper over a DataIter (wrapper/cxxnet.py:281)."""
+    net = Net(cfg=cfg)
+    if isinstance(param, dict):
+        param = param.items()
+    for k, v in param:
+        net.set_param(k, v)
+    net.init_model()
+    for r in range(num_round):
+        net.start_round(r)
+        data.before_first()
+        counter = 0
+        while data.next():
+            net.update(data)
+            counter += 1
+            if counter % 100 == 0:
+                print(f'[{r}] {counter} batch passed')
+        if eval_data is not None:
+            sys.stderr.write(net.evaluate(eval_data, 'eval') + '\n')
+    return net
+
+
+def train(cfg: str, data, label, num_round: int, param) -> Net:
+    """Module-level train helper over a numpy batch (wrapper/cxxnet.py:300)."""
+    net = Net(cfg=cfg)
+    if isinstance(param, dict):
+        param = param.items()
+    for k, v in param:
+        net.set_param(k, v)
+    net.init_model()
+    for r in range(num_round):
+        net.start_round(r)
+        net.update(data=data, label=label)
+    return net

@@ -1,0 +1,196 @@
+// cxxnet_tpu native runtime: binary-page data loader + JPEG decode.
+//
+// TPU-native counterpart of the reference's native IO stack
+// (src/io/iter_thread_imbin-inl.hpp + utils/thread_buffer.h + utils/decoder.h):
+// a background reader thread streams fixed 64MB BinaryPages from disk into a
+// bounded ring (the double-buffer pipeline), objects are exposed zero-copy,
+// and JPEG blobs decode straight to RGB via libjpeg.  Exposed as a plain C
+// ABI consumed through ctypes (cxxnet_tpu/runtime/native.py).
+//
+// Page format (byte-compatible with utils/io.h:253-326):
+//   int32 data[64<<18]; data[0]=count, data[1+i]=cumulative byte offsets,
+//   object r occupies [PAGE_BYTES - data[r+2], PAGE_BYTES - data[r+1]).
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+#include <csetjmp>
+
+namespace {
+
+constexpr size_t kPageInts = 64u << 18;
+constexpr size_t kPageBytes = kPageInts * 4;
+
+struct Page {
+  std::vector<char> buf;
+  Page() : buf(kPageBytes) {}
+  const int32_t* head() const {
+    return reinterpret_cast<const int32_t*>(buf.data());
+  }
+  int count() const { return head()[0]; }
+  const char* obj(int r, size_t* size) const {
+    const int32_t* h = head();
+    size_t lo = kPageBytes - static_cast<size_t>(h[r + 2]);
+    *size = static_cast<size_t>(h[r + 2] - h[r + 1]);
+    return buf.data() + lo;
+  }
+};
+
+// Bounded-ring page prefetcher: one reader thread, consumer pops in order.
+struct PageStream {
+  FILE* fp = nullptr;
+  std::thread reader;
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  std::deque<std::unique_ptr<Page>> ready;
+  size_t max_ready = 2;
+  bool eof = false;
+  bool stop = false;
+  std::unique_ptr<Page> current;
+
+  ~PageStream() { Close(); }
+
+  bool Open(const char* path, int prefetch) {
+    fp = fopen(path, "rb");
+    if (!fp) return false;
+    max_ready = prefetch > 0 ? static_cast<size_t>(prefetch) : 2;
+    reader = std::thread([this] { ReadLoop(); });
+    return true;
+  }
+
+  void ReadLoop() {
+    for (;;) {
+      auto page = std::make_unique<Page>();
+      size_t got = fread(page->buf.data(), 1, kPageBytes, fp);
+      bool ok = got == kPageBytes;
+      std::unique_lock<std::mutex> lk(mu);
+      if (!ok) {
+        eof = true;
+        cv_get.notify_all();
+        return;
+      }
+      cv_put.wait(lk, [this] { return ready.size() < max_ready || stop; });
+      if (stop) return;
+      ready.push_back(std::move(page));
+      cv_get.notify_one();
+    }
+  }
+
+  // returns object count, or -1 at end of stream
+  int NextPage() {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_get.wait(lk, [this] { return !ready.empty() || eof || stop; });
+    if (ready.empty()) return -1;
+    current = std::move(ready.front());
+    ready.pop_front();
+    cv_put.notify_one();
+    return current->count();
+  }
+
+  void Close() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+      cv_put.notify_all();
+      cv_get.notify_all();
+    }
+    if (reader.joinable()) reader.join();
+    if (fp) {
+      fclose(fp);
+      fp = nullptr;
+    }
+  }
+};
+
+// libjpeg error handling: jump back instead of exit()
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jb, 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* cxr_open(const char* path, int prefetch_pages) {
+  auto* s = new PageStream();
+  if (!s->Open(path, prefetch_pages)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int cxr_next_page(void* handle) {
+  return static_cast<PageStream*>(handle)->NextPage();
+}
+
+const char* cxr_get_obj(void* handle, int r, size_t* size) {
+  auto* s = static_cast<PageStream*>(handle);
+  if (!s->current || r >= s->current->count()) {
+    *size = 0;
+    return nullptr;
+  }
+  return s->current->obj(r, size);
+}
+
+void cxr_close(void* handle) { delete static_cast<PageStream*>(handle); }
+
+// Decode a JPEG blob to tightly-packed RGB (H*W*3 uint8).  Returns 0 on
+// success; fills *w/*h.  out may be null to query dimensions only.
+int cxr_jpeg_decode(const unsigned char* blob, size_t size,
+                    unsigned char* out, size_t out_capacity,
+                    int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(blob),
+               static_cast<unsigned long>(size));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  *w = static_cast<int>(cinfo.image_width);
+  *h = static_cast<int>(cinfo.image_height);
+  if (out == nullptr) {
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  size_t need = static_cast<size_t>(*w) * (*h) * 3;
+  if (out_capacity < need) {
+    jpeg_destroy_decompress(&cinfo);
+    return -3;
+  }
+  jpeg_start_decompress(&cinfo);
+  size_t stride = static_cast<size_t>(cinfo.output_width) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = out + cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,102 @@
+"""Wrapper API tests (reference wrapper/cxxnet.py surface)."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import wrapper
+from tests.test_io import write_mnist
+
+NET_CFG = """
+netconfig=start
+layer[+1:f1] = fullc:fc1
+  nhidden = 16
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 3
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,64
+batch_size = 10
+dev = cpu
+eta = 0.3
+momentum = 0.9
+metric = error
+"""
+
+
+def make_iter_cfg(tmp_path):
+    pi, pl, img, y = write_mnist(str(tmp_path))
+    return f"""
+iter = mnist
+  path_img = "{pi}"
+  path_label = "{pl}"
+  batch_size = 10
+  silent = 1
+iter = end
+"""
+
+
+def test_dataiter_protocol(tmp_path):
+    it = wrapper.DataIter(make_iter_cfg(tmp_path))
+    with pytest.raises(RuntimeError):
+        it.get_data()
+    assert it.next()
+    assert it.get_data().shape == (10, 1, 1, 64)
+    assert it.get_label().shape == (10, 1)
+    n = 1
+    while it.next():
+        n += 1
+    assert n == 5
+    it.before_first()
+    assert it.next()
+
+
+def test_net_train_eval_weights(tmp_path):
+    it = wrapper.DataIter(make_iter_cfg(tmp_path))
+    net = wrapper.Net(dev='cpu', cfg=NET_CFG)
+    net.init_model()
+    for r in range(3):
+        net.start_round(r)
+        it.before_first()
+        while it.next():
+            net.update(it)
+    res = net.evaluate(it, 'test')
+    assert 'test-error' in res
+    # weight access in reference disk layout: (nhidden, nin)
+    w = net.get_weight('fc1', 'wmat')
+    assert w.shape == (16, 64)
+    b = net.get_weight('fc1', 'bias')
+    assert b.shape == (16,)
+    # roundtrip set_weight
+    net.set_weight(w * 0.5, 'fc1', 'wmat')
+    np.testing.assert_allclose(net.get_weight('fc1', 'wmat'), w * 0.5,
+                               rtol=1e-6)
+
+
+def test_net_update_numpy_and_predict():
+    rng = np.random.RandomState(0)
+    x = rng.randn(10, 1, 1, 64).astype(np.float32)
+    y = rng.randint(0, 3, 10).astype(np.float32)
+    net = wrapper.train(NET_CFG, x, y, 3, {'eta': 0.1})
+    pred = net.predict(x)
+    assert pred.shape == (10,)
+    feat = net.extract(x, 'f1')
+    assert feat.shape == (10, 16)
+
+
+def test_model_file_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.randn(10, 1, 1, 64).astype(np.float32)
+    y = rng.randint(0, 3, 10).astype(np.float32)
+    net = wrapper.train(NET_CFG, x, y, 1, {})
+    path = str(tmp_path / 'm.model')
+    net.save_model(path)
+    net2 = wrapper.Net(dev='cpu', cfg=NET_CFG)
+    net2.load_model(path)
+    np.testing.assert_allclose(net.get_weight('fc1', 'wmat'),
+                               net2.get_weight('fc1', 'wmat'), rtol=1e-6)
+    np.testing.assert_array_equal(net.predict(x), net2.predict(x))
