@@ -3,3 +3,5 @@
 from .mesh import (batch_sharding, build_mesh, param_shardings,
                    replicated_sharding)
 from .distributed import maybe_init_distributed
+from .sequence import (attention_reference, ring_attention,
+                       ulysses_attention)

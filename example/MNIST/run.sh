@@ -1,0 +1,9 @@
+#!/bin/bash
+# download MNIST and train the MLP config
+mkdir -p data
+cd data
+for f in train-images-idx3-ubyte train-labels-idx1-ubyte t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+  [ -f $f.gz ] || wget -q http://yann.lecun.com/exdb/mnist/$f.gz
+done
+cd ..
+python -m cxxnet_tpu.main MNIST.conf

@@ -1,0 +1,25 @@
+"""Every example config must parse, build, and shape-infer."""
+
+import glob
+import os
+
+import pytest
+
+from cxxnet_tpu.nnet.net import Net
+from cxxnet_tpu.nnet.net_config import NetConfig
+from cxxnet_tpu.utils.config import parse_config_file
+
+EXAMPLES = sorted(glob.glob(os.path.join(
+    os.path.dirname(__file__), '..', 'example', '*', '*.conf')))
+
+
+@pytest.mark.parametrize('conf', EXAMPLES, ids=[os.path.basename(p) for p in EXAMPLES])
+def test_example_conf_builds(conf):
+    pairs = parse_config_file(conf)
+    cfg = NetConfig()
+    cfg.configure(pairs)
+    assert cfg.num_layers > 0
+    net = Net(cfg)
+    # final node exists and has positive size
+    last = cfg.layers[-1].nindex_out[-1]
+    assert net.node_specs[last].flat_size > 0
