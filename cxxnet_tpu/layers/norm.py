@@ -47,6 +47,10 @@ class LRNLayer(Layer):
 
     def forward(self, params, inputs, ctx):
         x = inputs[0]  # (b, y, x, c)
+        from ..ops.pallas_kernels import lrn_pallas, pallas_enabled
+        if pallas_enabled():
+            return [lrn_pallas(x, self.nsize, self.alpha, self.beta,
+                               self.knorm)]
         x32 = x.astype(jnp.float32)
         n = self.nsize
         half_lo = (n - 1) // 2

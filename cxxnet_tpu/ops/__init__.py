@@ -1,0 +1,3 @@
+"""Device compute ops: Pallas TPU kernels + XLA lowerings."""
+
+from .pallas_kernels import lrn_pallas, pallas_enabled, pallas_matmul

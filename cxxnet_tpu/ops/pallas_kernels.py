@@ -1,0 +1,196 @@
+"""Pallas TPU kernels for hot ops.
+
+Per the north-star mapping (BASELINE.json), the reference's hand-written
+CUDA/mshadow hot paths become TPU kernels.  Design notes:
+
+* **conv / pooling** stay on XLA's native convolution/reduce-window — on
+  TPU those already lower to MXU-optimal programs (the cuDNN analogy);
+  a hand-written Pallas conv would have to re-derive XLA's spatial
+  partitioning to break even.  Measured, not assumed: see bench notes.
+* **LRN** is the real fusion win: the XLA lowering materializes the
+  padded/cumsum intermediates in HBM, while the Pallas kernel computes
+  ``x * (k + alpha/n * (x^2 @ band))^-beta`` in one VMEM pass — the
+  channel-window sum becomes a banded matmul on the MXU, and square /
+  power / multiply fuse around it.  Forward and backward are both single
+  kernels wired through ``jax.custom_vjp``.
+* **fullc** gets a tiled-MXU matmul (``pallas_matmul``) used when
+  ``CXXNET_PALLAS=1``; XLA's dot is the default.
+
+All kernels run under ``interpret=True`` on CPU, which is how the test
+suite validates them without hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+def pallas_enabled() -> bool:
+    """Opt-in switch for the Pallas paths (config ``use_pallas=1`` sets it
+    process-wide; default off until benchmarked ahead on hardware)."""
+    return os.environ.get('CXXNET_PALLAS', '0') == '1'
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != 'tpu'
+
+
+def _block_spec(shape, index_map=None):
+    if _VMEM is not None:
+        return pl.BlockSpec(shape, index_map, memory_space=_VMEM)
+    return pl.BlockSpec(shape, index_map)
+
+
+def _band_matrix(c: int, nsize: int, dtype=jnp.float32):
+    """(c, c) 0/1 band: column j sums channels in j's LRN window."""
+    half_lo = (nsize - 1) // 2
+    half_hi = nsize - 1 - half_lo
+    idx = np.arange(c)
+    band = ((idx[:, None] >= idx[None, :] - half_lo)
+            & (idx[:, None] <= idx[None, :] + half_hi))
+    return jnp.asarray(band, dtype)
+
+
+def _pad_rows(x2, tile):
+    rows = x2.shape[0]
+    pad = (-rows) % tile
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    return x2, rows
+
+
+# --- LRN ------------------------------------------------------------------
+
+def _lrn_fwd_kernel(x_ref, band_ref, o_ref, norm_ref, *, alpha_n, beta,
+                    knorm):
+    x = x_ref[:].astype(jnp.float32)
+    win = jnp.dot(x * x, band_ref[:], preferred_element_type=jnp.float32)
+    norm = knorm + alpha_n * win
+    norm_ref[:] = norm
+    o_ref[:] = (x * jnp.power(norm, -beta)).astype(o_ref.dtype)
+
+
+def _lrn_bwd_kernel(x_ref, g_ref, band_ref, norm_ref, dx_ref, *, alpha_n,
+                    beta):
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    norm = norm_ref[:]
+    npow = jnp.power(norm, -beta)
+    # dL/dx = g * norm^-b - 2*b*alpha_n * x * ((g*x*norm^(-b-1)) @ band^T)
+    inner = jnp.dot(g * x * npow / norm, band_ref[:],
+                    preferred_element_type=jnp.float32)
+    dx_ref[:] = (g * npow - 2.0 * beta * alpha_n * x * inner
+                 ).astype(dx_ref.dtype)
+
+
+_ROW_TILE = 512
+
+
+def _lrn_call(kernel, outs, args, c, rows_padded):
+    grid = (rows_padded // _ROW_TILE,)
+    row_spec = _block_spec((_ROW_TILE, c), lambda i: (i, 0))
+    band_spec = _block_spec((c, c), lambda i: (0, 0))
+    specs = []
+    for a in args:
+        specs.append(band_spec if a.shape == (c, c) else row_spec)
+    return pl.pallas_call(
+        kernel,
+        out_shape=outs,
+        grid=grid,
+        in_specs=specs,
+        out_specs=[row_spec] * len(outs) if isinstance(outs, list)
+        else row_spec,
+        interpret=_interpret(),
+    )(*args)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def lrn_pallas(x, nsize: int, alpha: float, beta: float, knorm: float):
+    """Cross-channel LRN over NHWC input, Pallas-fused."""
+    out, _ = _lrn_fwd_impl(x, nsize, alpha, beta, knorm)
+    return out
+
+
+def _lrn_fwd_impl(x, nsize, alpha, beta, knorm):
+    b = x.shape[:-1]
+    c = x.shape[-1]
+    x2, rows = _pad_rows(x.reshape(-1, c), _ROW_TILE)
+    band = _band_matrix(c, nsize)
+    kernel = functools.partial(_lrn_fwd_kernel, alpha_n=alpha / nsize,
+                               beta=beta, knorm=knorm)
+    out, norm = _lrn_call(
+        kernel,
+        [jax.ShapeDtypeStruct(x2.shape, x.dtype),
+         jax.ShapeDtypeStruct(x2.shape, jnp.float32)],
+        (x2, band), c, x2.shape[0])
+    return out[:rows].reshape(*b, c), norm[:rows]
+
+
+def _lrn_vjp_fwd(x, nsize, alpha, beta, knorm):
+    out, norm = _lrn_fwd_impl(x, nsize, alpha, beta, knorm)
+    return out, (x, norm)
+
+
+def _lrn_vjp_bwd(nsize, alpha, beta, knorm, res, g):
+    x, norm = res
+    b = x.shape[:-1]
+    c = x.shape[-1]
+    x2, rows = _pad_rows(x.reshape(-1, c), _ROW_TILE)
+    g2, _ = _pad_rows(g.reshape(-1, c).astype(jnp.float32), _ROW_TILE)
+    n2, _ = _pad_rows(norm, _ROW_TILE)
+    n2 = jnp.where(n2 == 0.0, 1.0, n2)   # padded rows: avoid 0^-b
+    # backward contracts the transposed band: dx_j sums over windows i
+    # that contain j (identical for symmetric/odd windows)
+    band = _band_matrix(c, nsize).T
+    kernel = functools.partial(_lrn_bwd_kernel, alpha_n=alpha / nsize,
+                               beta=beta)
+    dx = _lrn_call(
+        kernel, jax.ShapeDtypeStruct(x2.shape, x.dtype),
+        (x2, g2, band, n2), c, x2.shape[0])
+    return (dx[:rows].reshape(*b, c),)
+
+
+lrn_pallas.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)
+
+
+# --- tiled matmul (fullc) -------------------------------------------------
+
+def _matmul_kernel(a_ref, b_ref, o_ref):
+    o_ref[:] = jnp.dot(a_ref[:], b_ref[:],
+                       preferred_element_type=jnp.float32
+                       ).astype(o_ref.dtype)
+
+
+def pallas_matmul(a, b, tile_m: int = 256, tile_n: int = 256):
+    """(m, k) @ (k, n) with an MXU-tiled Pallas kernel.  K is kept whole
+    per tile (fits VMEM for fullc-sized layers)."""
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2
+    pm, pn = (-m) % tile_m, (-n) % tile_n
+    ap = jnp.pad(a, ((0, pm), (0, 0))) if pm else a
+    bp = jnp.pad(b, ((0, 0), (0, pn))) if pn else b
+    mm, nn = ap.shape[0], bp.shape[1]
+    out = pl.pallas_call(
+        _matmul_kernel,
+        out_shape=jax.ShapeDtypeStruct((mm, nn), a.dtype),
+        grid=(mm // tile_m, nn // tile_n),
+        in_specs=[_block_spec((tile_m, k), lambda i, j: (i, 0)),
+                  _block_spec((k, tile_n), lambda i, j: (0, j))],
+        out_specs=_block_spec((tile_m, tile_n), lambda i, j: (i, j)),
+        interpret=_interpret(),
+    )(ap, bp)
+    return out[:m, :n]

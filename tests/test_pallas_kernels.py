@@ -1,0 +1,88 @@
+"""Pallas kernel differential tests (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu.ops.pallas_kernels import lrn_pallas, pallas_matmul
+
+
+def lrn_ref(x, nsize, alpha, beta, knorm):
+    """Pure-jnp LRN (the XLA path in layers/norm.py)."""
+    c = x.shape[-1]
+    half_lo = (nsize - 1) // 2
+    sq = x * x
+    out = np.zeros_like(x)
+    for ch in range(c):
+        lo = max(0, ch - half_lo)
+        hi = min(c, ch + (nsize - 1 - half_lo) + 1)
+        norm = knorm + alpha / nsize * np.sum(sq[..., lo:hi], axis=-1)
+        out[..., ch] = x[..., ch] * norm ** -beta
+    return out
+
+
+@pytest.mark.parametrize('nsize', [3, 5, 4])
+def test_lrn_pallas_forward(nsize):
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 5, 96).astype(np.float32)
+    out = np.asarray(lrn_pallas(jnp.asarray(x), nsize, 0.001, 0.75, 1.0))
+    ref = lrn_ref(x, nsize, 0.001, 0.75, 1.0)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize('nsize', [5, 4])
+def test_lrn_pallas_grad_matches_autodiff(nsize):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.rand(2, 2, 3, 32).astype(np.float32) + 0.1)
+
+    def jnp_lrn(x):
+        c = x.shape[-1]
+        half_lo = (nsize - 1) // 2
+        half_hi = nsize - 1 - half_lo
+        sq = x * x
+        pad = jnp.pad(sq, [(0, 0)] * 3 + [(half_lo + 1, half_hi)])
+        cums = jnp.cumsum(pad, axis=-1)
+        win = cums[..., nsize:nsize + c] - cums[..., 0:c]
+        norm = win * (0.001 / nsize) + 1.0
+        return x * jnp.power(norm, -0.75)
+
+    g_ref = jax.grad(lambda x: jnp.sum(jnp_lrn(x) ** 2))(x)
+    g_pl = jax.grad(lambda x: jnp.sum(
+        lrn_pallas(x, nsize, 0.001, 0.75, 1.0) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_pl), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lrn_pallas_under_jit():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.rand(4, 2, 2, 16).astype(np.float32))
+    f = jax.jit(lambda x: lrn_pallas(x, 5, 0.001, 0.75, 1.0))
+    np.testing.assert_allclose(np.asarray(f(x)),
+                               lrn_ref(np.asarray(x), 5, 0.001, 0.75, 1.0),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize('m,k,n', [(100, 64, 70), (256, 512, 256)])
+def test_pallas_matmul(m, k, n):
+    rng = np.random.RandomState(3)
+    a = rng.randn(m, k).astype(np.float32)
+    b = rng.randn(k, n).astype(np.float32)
+    out = np.asarray(pallas_matmul(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(out, a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_lrn_layer_uses_pallas_when_enabled(monkeypatch):
+    monkeypatch.setenv('CXXNET_PALLAS', '1')
+    from cxxnet_tpu.layers import ForwardContext, NodeSpec, create_layer
+    from cxxnet_tpu.layers.base import get_layer_type
+    rng = np.random.RandomState(4)
+    x = rng.rand(2, 3, 3, 8).astype(np.float32)
+    layer = create_layer(get_layer_type('lrn'))
+    layer.set_param('local_size', '5')
+    layer.infer_shapes([NodeSpec(8, 3, 3)])
+    ctx = ForwardContext(is_train=False)
+    out = layer.forward({}, [jnp.asarray(x)], ctx)[0]
+    np.testing.assert_allclose(np.asarray(out),
+                               lrn_ref(x, 5, 0.001, 0.75, 1.0),
+                               rtol=1e-5, atol=1e-6)
