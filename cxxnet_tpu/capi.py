@@ -1,0 +1,135 @@
+"""Python glue behind the native C ABI (runtime/cxxnet_wrapper.cc).
+
+The reference exposed its C++ trainer through a C ABI
+(``wrapper/cxxnet_wrapper.h:29-225``) so other languages could bind it.
+Here the dependency points the other way — the trainer lives in
+Python/JAX — so the native ``libcxxnetwrapper.so`` embeds CPython and
+calls the flat functions in this module.  Each function takes only
+C-friendly types (memoryviews, tuples, strings) and returns either a
+contiguous float32 ``np.ndarray``, a ``str``, or ``None`` so the C layer
+needs no per-call marshalling logic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .wrapper import DataIter, Net
+
+
+def _from_buffer(mv, shape: Tuple[int, ...]) -> np.ndarray:
+    arr = np.frombuffer(mv, np.float32, count=int(np.prod(shape)))
+    return arr.reshape(shape).copy()
+
+
+def _as_f32(arr: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(arr, np.float32)
+
+
+def _as_4d(arr: np.ndarray) -> np.ndarray:
+    """Shape to 4-d (batch, c, y, x) the way reference nodes are laid out
+    (matrices become (batch, 1, 1, len), layer/layer.h:44-55)."""
+    arr = _as_f32(arr)
+    if arr.ndim == 4:
+        return arr
+    if arr.ndim == 2:
+        return arr.reshape(arr.shape[0], 1, 1, arr.shape[1])
+    if arr.ndim == 1:
+        return arr.reshape(arr.shape[0], 1, 1, 1)
+    raise ValueError(f'cannot view shape {arr.shape} as 4-d node')
+
+
+# ---- iterator surface (CXNIO*) ------------------------------------------
+
+def io_create(cfg: str) -> DataIter:
+    return DataIter(cfg)
+
+
+def io_next(it: DataIter) -> int:
+    return 1 if it.next() else 0
+
+
+def io_before_first(it: DataIter) -> None:
+    it.before_first()
+
+
+def io_get_data(it: DataIter) -> np.ndarray:
+    return _as_4d(it.get_data())
+
+
+def io_get_label(it: DataIter) -> np.ndarray:
+    lab = _as_f32(it.get_label())
+    return lab if lab.ndim == 2 else lab.reshape(lab.shape[0], -1)
+
+
+# ---- net surface (CXNNet*) ----------------------------------------------
+
+def net_create(device: str, cfg: str) -> Net:
+    return Net(dev=device or '', cfg=cfg)
+
+
+def net_set_param(net: Net, name: str, val: str) -> None:
+    net.set_param(name, val)
+
+
+def net_init_model(net: Net) -> None:
+    net.init_model()
+
+
+def net_save_model(net: Net, fname: str) -> None:
+    net.save_model(fname)
+
+
+def net_load_model(net: Net, fname: str) -> None:
+    net.load_model(fname)
+
+
+def net_start_round(net: Net, rnd: int) -> None:
+    net.start_round(rnd)
+
+
+def net_set_weight(net: Net, mv, size: int, layer_name: str,
+                   tag: str) -> None:
+    cur = net.get_weight(layer_name, tag)
+    if cur is None:
+        raise KeyError(f'layer {layer_name} has no weight {tag}')
+    if int(size) != cur.size:
+        raise ValueError(f'set_weight: size {size} != {cur.size}')
+    net.set_weight(_from_buffer(mv, cur.shape), layer_name, tag)
+
+
+def net_get_weight(net: Net, layer_name: str,
+                   tag: str) -> Optional[np.ndarray]:
+    w = net.get_weight(layer_name, tag)
+    return None if w is None else _as_f32(w)
+
+
+def net_update_iter(net: Net, it: DataIter) -> None:
+    net.update(it)
+
+
+def net_update_batch(net: Net, data_mv, dshape, label_mv, lshape) -> None:
+    net.update(_from_buffer(data_mv, tuple(dshape)),
+               _from_buffer(label_mv, tuple(lshape)))
+
+
+def net_predict_batch(net: Net, data_mv, dshape) -> np.ndarray:
+    return _as_f32(net.predict(_from_buffer(data_mv, tuple(dshape))))
+
+
+def net_predict_iter(net: Net, it: DataIter) -> np.ndarray:
+    return _as_f32(net.predict(it))
+
+
+def net_extract_batch(net: Net, data_mv, dshape, node: str) -> np.ndarray:
+    return _as_4d(net.extract(_from_buffer(data_mv, tuple(dshape)), node))
+
+
+def net_extract_iter(net: Net, it: DataIter, node: str) -> np.ndarray:
+    return _as_4d(net.extract(it, node))
+
+
+def net_evaluate(net: Net, it: DataIter, name: str) -> str:
+    return net.evaluate(it, name)

@@ -50,7 +50,11 @@ class FullConnectLayer(Layer):
     def forward(self, params, inputs, ctx):
         x = as_mat(inputs[0])
         w = params['wmat'].astype(x.dtype)
-        out = jnp.dot(x, w)
+        from ..ops.pallas_kernels import pallas_enabled, pallas_matmul
+        if pallas_enabled():
+            out = pallas_matmul(x, w)
+        else:
+            out = jnp.dot(x, w)
         if self.param.no_bias == 0:
             out = out + params['bias'].astype(x.dtype)
         return [out.astype(x.dtype)]

@@ -25,6 +25,7 @@ Reference semantics preserved:
 from __future__ import annotations
 
 import io as _io
+import os
 import re
 import struct
 from functools import partial
@@ -109,6 +110,9 @@ class NetTrainer:
             self.max_round = int(val)
         if name == 'tensor_parallel':
             self.tensor_parallel = int(val)
+        if name == 'use_pallas':
+            # process-wide switch read by ops.pallas_kernels.pallas_enabled
+            os.environ['CXXNET_PALLAS'] = val
         if name == 'compute_type':
             table = {'float32': jnp.float32, 'bfloat16': jnp.bfloat16,
                      'float16': jnp.float16}

@@ -41,7 +41,8 @@ except Exception:  # pragma: no cover
 def pallas_enabled() -> bool:
     """Opt-in switch for the Pallas paths (config ``use_pallas=1`` sets it
     process-wide; default off until benchmarked ahead on hardware)."""
-    return os.environ.get('CXXNET_PALLAS', '0') == '1'
+    return os.environ.get('CXXNET_PALLAS', '0').strip().lower() \
+        in ('1', 'true', 'yes', 'on')
 
 
 def _interpret() -> bool:
@@ -99,13 +100,15 @@ def _lrn_bwd_kernel(x_ref, g_ref, band_ref, norm_ref, dx_ref, *, alpha_n,
 _ROW_TILE = 512
 
 
-def _lrn_call(kernel, outs, args, c, rows_padded):
+def _lrn_call(kernel, outs, args, c, rows_padded, band_arg):
+    """band_arg: index into ``args`` of the (c, c) band matrix — dispatch
+    is positional because row blocks can also be (c, c) when the padded
+    row count happens to equal the channel count."""
     grid = (rows_padded // _ROW_TILE,)
     row_spec = _block_spec((_ROW_TILE, c), lambda i: (i, 0))
     band_spec = _block_spec((c, c), lambda i: (0, 0))
-    specs = []
-    for a in args:
-        specs.append(band_spec if a.shape == (c, c) else row_spec)
+    specs = [band_spec if i == band_arg else row_spec
+             for i in range(len(args))]
     return pl.pallas_call(
         kernel,
         out_shape=outs,
@@ -135,7 +138,7 @@ def _lrn_fwd_impl(x, nsize, alpha, beta, knorm):
         kernel,
         [jax.ShapeDtypeStruct(x2.shape, x.dtype),
          jax.ShapeDtypeStruct(x2.shape, jnp.float32)],
-        (x2, band), c, x2.shape[0])
+        (x2, band), c, x2.shape[0], band_arg=1)
     return out[:rows].reshape(*b, c), norm[:rows]
 
 
@@ -159,7 +162,7 @@ def _lrn_vjp_bwd(nsize, alpha, beta, knorm, res, g):
                                beta=beta)
     dx = _lrn_call(
         kernel, jax.ShapeDtypeStruct(x2.shape, x.dtype),
-        (x2, g2, band, n2), c, x2.shape[0])
+        (x2, g2, band, n2), c, x2.shape[0], band_arg=2)
     return (dx[:rows].reshape(*b, c),)
 
 
@@ -174,9 +177,28 @@ def _matmul_kernel(a_ref, b_ref, o_ref):
                        ).astype(o_ref.dtype)
 
 
-def pallas_matmul(a, b, tile_m: int = 256, tile_n: int = 256):
-    """(m, k) @ (k, n) with an MXU-tiled Pallas kernel.  K is kept whole
-    per tile (fits VMEM for fullc-sized layers)."""
+@jax.custom_vjp
+def pallas_matmul(a, b):
+    """(m, k) @ (k, n) with an MXU-tiled Pallas kernel; differentiable
+    (backward runs the same kernel on the transposed operands)."""
+    return _matmul_impl(a, b)
+
+
+def _matmul_vjp_fwd(a, b):
+    return _matmul_impl(a, b), (a, b)
+
+
+def _matmul_vjp_bwd(res, g):
+    a, b = res
+    return (_matmul_impl(g, b.T).astype(a.dtype),
+            _matmul_impl(a.T, g).astype(b.dtype))
+
+
+pallas_matmul.defvjp(_matmul_vjp_fwd, _matmul_vjp_bwd)
+
+
+def _matmul_impl(a, b, tile_m: int = 256, tile_n: int = 256):
+    """K is kept whole per tile (fits VMEM for fullc-sized layers)."""
     m, k = a.shape
     k2, n = b.shape
     assert k == k2

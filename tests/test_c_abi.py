@@ -1,0 +1,208 @@
+"""Native C ABI wrapper tests (runtime/libcxxnetwrapper.so).
+
+Two consumption modes, both exercised:
+* ctypes from an already-running Python process (the library attaches to
+  the live interpreter through the GIL instead of re-initializing),
+* a standalone C program linking the library, which embeds CPython itself
+  — the reference's "wrapper for other languages" use case
+  (wrapper/cxxnet_wrapper.h:1-8).
+"""
+
+import ctypes
+import os
+import pathlib
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+RUNTIME = REPO / 'runtime'
+LIB = RUNTIME / 'libcxxnetwrapper.so'
+
+TINY_CONF = """
+netconfig=start
+layer[0->1] = fullc:fc1
+  nhidden = 16
+layer[1->2] = sigmoid
+layer[2->3] = fullc:fc2
+  nhidden = 4
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 16
+eta = 0.3
+momentum = 0.9
+metric = error
+"""
+
+
+def _build():
+    if LIB.exists():
+        return True
+    r = subprocess.run(['make', 'libcxxnetwrapper.so'], cwd=RUNTIME,
+                       capture_output=True, text=True)
+    return r.returncode == 0 and LIB.exists()
+
+
+pytestmark = pytest.mark.skipif(not _build(),
+                                reason='cannot build libcxxnetwrapper.so')
+
+
+@pytest.fixture(scope='module')
+def lib():
+    L = ctypes.CDLL(str(LIB))
+    u = ctypes.c_uint
+    L.CXNNetCreate.restype = ctypes.c_void_p
+    L.CXNNetCreate.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    L.CXNNetFree.argtypes = [ctypes.c_void_p]
+    L.CXNNetSetParam.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p]
+    L.CXNNetInitModel.argtypes = [ctypes.c_void_p]
+    L.CXNNetSaveModel.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    L.CXNNetLoadModel.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    L.CXNNetStartRound.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    F = ctypes.POINTER(ctypes.c_float)
+    L.CXNNetUpdateBatch.argtypes = [ctypes.c_void_p, F, u * 4, F, u * 2]
+    L.CXNNetPredictBatch.restype = F
+    L.CXNNetPredictBatch.argtypes = [ctypes.c_void_p, F, u * 4,
+                                     ctypes.POINTER(u)]
+    L.CXNNetExtractBatch.restype = F
+    L.CXNNetExtractBatch.argtypes = [ctypes.c_void_p, F, u * 4,
+                                     ctypes.c_char_p, u * 4]
+    L.CXNNetGetWeight.restype = F
+    L.CXNNetGetWeight.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_char_p, u * 4, ctypes.POINTER(u)]
+    L.CXNNetSetWeight.argtypes = [ctypes.c_void_p, F, u, ctypes.c_char_p,
+                                  ctypes.c_char_p]
+    return L
+
+
+def _fptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def test_ctypes_train_predict_weights(lib, tmp_path):
+    u4 = (ctypes.c_uint * 4)
+    net = lib.CXNNetCreate(b'cpu', TINY_CONF.encode())
+    assert net
+    lib.CXNNetInitModel(net)
+    lib.CXNNetStartRound(net, 0)
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(16, 1, 1, 8).astype(np.float32)
+    label = (rng.randint(0, 4, (16, 1))).astype(np.float32)
+    for _ in range(3):
+        lib.CXNNetUpdateBatch(net, _fptr(data), u4(16, 1, 1, 8),
+                              _fptr(label), (ctypes.c_uint * 2)(16, 1))
+
+    out_size = ctypes.c_uint(0)
+    p = lib.CXNNetPredictBatch(net, _fptr(data), u4(16, 1, 1, 8),
+                               ctypes.byref(out_size))
+    assert out_size.value == 16
+    preds = np.ctypeslib.as_array(p, (16,))
+    assert set(np.unique(preds)).issubset({0., 1., 2., 3.})
+
+    # extract a hidden node by name
+    oshape = u4(0, 0, 0, 0)
+    p = lib.CXNNetExtractBatch(net, _fptr(data), u4(16, 1, 1, 8), b'2',
+                               oshape)
+    assert list(oshape) == [16, 1, 1, 16]
+
+    # weight get/set roundtrip in disk layout (nhidden, nin)
+    wshape = u4(0, 0, 0, 0)
+    wdim = ctypes.c_uint(0)
+    wp = lib.CXNNetGetWeight(net, b'fc1', b'wmat', wshape, ctypes.byref(wdim))
+    assert wdim.value == 2 and list(wshape)[:2] == [16, 8]
+    w = np.ctypeslib.as_array(wp, (16, 8)).copy()
+    w2 = w * 2.0
+    lib.CXNNetSetWeight(net, _fptr(w2), ctypes.c_uint(w2.size), b'fc1',
+                        b'wmat')
+    wp = lib.CXNNetGetWeight(net, b'fc1', b'wmat', wshape, ctypes.byref(wdim))
+    got = np.ctypeslib.as_array(wp, (16, 8))
+    np.testing.assert_allclose(got, w2, rtol=1e-6)
+
+    # save / load through a second handle
+    fname = str(tmp_path / 'm.model').encode()
+    lib.CXNNetSaveModel(net, fname)
+    net2 = lib.CXNNetCreate(b'cpu', TINY_CONF.encode())
+    lib.CXNNetLoadModel(net2, fname)
+    wp = lib.CXNNetGetWeight(net2, b'fc1', b'wmat', wshape,
+                             ctypes.byref(wdim))
+    got = np.ctypeslib.as_array(wp, (16, 8))
+    np.testing.assert_allclose(got, w2, rtol=1e-6)
+    lib.CXNNetFree(net2)
+    lib.CXNNetFree(net)
+
+
+C_DRIVER = r'''
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef unsigned int cxx_uint;
+typedef float cxx_real_t;
+
+void *CXNNetCreate(const char *device, const char *cfg);
+void CXNNetFree(void *handle);
+void CXNNetInitModel(void *handle);
+void CXNNetStartRound(void *handle, int round);
+void CXNNetUpdateBatch(void *handle, cxx_real_t *p_data,
+                       const cxx_uint dshape[4], cxx_real_t *p_label,
+                       const cxx_uint lshape[2]);
+const cxx_real_t *CXNNetPredictBatch(void *handle, cxx_real_t *p_data,
+                                     const cxx_uint dshape[4],
+                                     cxx_uint *out_size);
+const cxx_real_t *CXNNetGetWeight(void *handle, const char *layer_name,
+                                  const char *wtag, cxx_uint wshape[4],
+                                  cxx_uint *out_dim);
+
+static const char *kConf = "%CONF%";
+
+int main(void) {
+  void *net = CXNNetCreate("cpu", kConf);
+  if (!net) return 1;
+  CXNNetInitModel(net);
+  CXNNetStartRound(net, 0);
+  float data[16 * 8];
+  float label[16];
+  unsigned seed = 7;
+  for (int i = 0; i < 16 * 8; ++i) {
+    seed = seed * 1103515245u + 12345u;
+    data[i] = (float)(seed % 1000) / 500.0f - 1.0f;
+  }
+  for (int i = 0; i < 16; ++i) label[i] = (float)(i % 4);
+  cxx_uint dshape[4] = {16, 1, 1, 8};
+  cxx_uint lshape[2] = {16, 1};
+  for (int step = 0; step < 3; ++step)
+    CXNNetUpdateBatch(net, data, dshape, label, lshape);
+  cxx_uint out_size = 0;
+  const float *pred = CXNNetPredictBatch(net, data, dshape, &out_size);
+  if (out_size != 16 || pred == NULL) return 2;
+  cxx_uint wshape[4];
+  cxx_uint wdim = 0;
+  const float *w = CXNNetGetWeight(net, "fc1", "wmat", wshape, &wdim);
+  if (wdim != 2 || wshape[0] != 16 || wshape[1] != 8 || w == NULL) return 3;
+  CXNNetFree(net);
+  printf("C_ABI_OK\n");
+  return 0;
+}
+'''
+
+
+def test_standalone_c_program(tmp_path):
+    src = tmp_path / 'driver.c'
+    conf = TINY_CONF.replace('\n', '\\n')
+    src.write_text(C_DRIVER.replace('%CONF%', conf))
+    exe = tmp_path / 'driver'
+    r = subprocess.run(
+        ['gcc', '-O1', str(src), '-o', str(exe),
+         f'-L{RUNTIME}', '-lcxxnetwrapper', f'-Wl,-rpath,{RUNTIME}'],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ)
+    env['PYTHONPATH'] = str(REPO) + os.pathsep + env.get('PYTHONPATH', '')
+    env['JAX_PLATFORMS'] = 'cpu'
+    r = subprocess.run([str(exe)], capture_output=True, text=True, env=env,
+                       timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert 'C_ABI_OK' in r.stdout

@@ -86,3 +86,28 @@ def test_lrn_layer_uses_pallas_when_enabled(monkeypatch):
     np.testing.assert_allclose(np.asarray(out),
                                lrn_ref(x, 5, 0.001, 0.75, 1.0),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_matmul_grad():
+    rng = np.random.RandomState(5)
+    a = jnp.asarray(rng.randn(64, 48).astype(np.float32))
+    b = jnp.asarray(rng.randn(48, 32).astype(np.float32))
+    g = jnp.asarray(rng.randn(64, 32).astype(np.float32))
+    da, db = jax.vjp(pallas_matmul, a, b)[1](g)
+    np.testing.assert_allclose(np.asarray(da), np.asarray(g @ b.T),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(db), np.asarray(a.T @ g),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_lrn_pallas_rows_equal_channels():
+    # regression: padded row count == channel count must not misroute the
+    # band matrix (positional BlockSpec dispatch in _lrn_call)
+    from cxxnet_tpu.ops import pallas_kernels as pk
+    rng = np.random.RandomState(6)
+    c = pk._ROW_TILE
+    x = jnp.asarray(rng.rand(pk._ROW_TILE // 4, 2, 2, c).astype(np.float32))
+    out = pk.lrn_pallas(x, 5, 0.001, 0.75, 1.0)
+    np.testing.assert_allclose(np.asarray(out),
+                               lrn_ref(np.asarray(x), 5, 0.001, 0.75, 1.0),
+                               rtol=1e-4, atol=1e-5)
