@@ -1,0 +1,279 @@
+"""TransformerLM — the long-context / multi-dimensional-parallelism
+flagship.
+
+The reference framework tops out at data parallelism over GPUs
+(SURVEY.md §2.5); this model family is where the TPU build goes past it:
+one ``shard_map`` over a ``(pipe, data, seq, model)`` mesh runs the FULL
+training step with every collective explicit and riding ICI:
+
+* **dp**   — batch sharded over ``data``; gradient pmean over data+seq,
+* **pp**   — transformer blocks stacked on a leading stage axis sharded
+  over ``pipe``; GPipe microbatch schedule (parallel/pipeline.py),
+* **sp**   — sequence sharded over ``seq``; exact ring attention
+  (parallel/sequence.py) rotates K/V blocks with ``ppermute``,
+* **tp**   — attention heads and FFN hidden sharded over ``model``;
+  row-parallel output projections finish with ``psum``,
+* **ep**   — switch-MoE FFN, experts sharded over ``data`` with
+  all_to_all dispatch/combine (parallel/moe.py).
+
+Because everything lives in one shard_map body, the strategies compose:
+ring attention runs inside a pipeline stage inside the microbatch scan.
+Backward is ``jax.value_and_grad`` straight through (collectives
+transpose to collectives); the SGD update runs sharded in the same body,
+so optimizer state never leaves the device that owns the shard.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass, field
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.moe import moe_ffn_local
+from ..parallel.pipeline import pipeline_stage_loop, split_microbatches
+from ..parallel.sequence import _local_attention, _ring_attention_local
+
+try:                                    # jax >= 0.5 spelling
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+AXES = ('pipe', 'data', 'seq', 'model')
+
+
+@dataclass
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 64
+    num_heads: int = 4
+    d_ff: int = 128
+    num_stages: int = 2          # pipeline stages == transformer blocks
+    seq_len: int = 64
+    num_experts: int = 0         # 0 = dense FFN; >0 = switch-MoE FFN
+    capacity_factor: float = 2.0
+    attn: str = 'ring'           # 'ring' | 'local'
+    causal: bool = True
+    num_microbatches: int = 4
+    dtype: object = jnp.float32
+
+
+def init_params(rng: np.random.RandomState, cfg: TransformerConfig):
+    """Stage params stacked on axis 0 (the ``pipe``-sharded axis)."""
+    s, d, f, v = cfg.num_stages, cfg.d_model, cfg.d_ff, cfg.vocab_size
+
+    def init(*shape, scale=None):
+        scale = scale or 1.0 / math.sqrt(shape[-2] if len(shape) > 1
+                                         else shape[-1])
+        return jnp.asarray(rng.randn(*shape) * scale, cfg.dtype)
+
+    stages = {
+        'ln1_scale': jnp.ones((s, d), cfg.dtype),
+        'ln1_bias': jnp.zeros((s, d), cfg.dtype),
+        'wq': init(s, d, d), 'wk': init(s, d, d), 'wv': init(s, d, d),
+        'wo': init(s, d, d),
+        'ln2_scale': jnp.ones((s, d), cfg.dtype),
+        'ln2_bias': jnp.zeros((s, d), cfg.dtype),
+    }
+    if cfg.num_experts:
+        e = cfg.num_experts
+        stages['gate'] = init(s, d, e)
+        stages['w1'] = init(s, e, d, f)
+        stages['w2'] = init(s, e, f, d, scale=1.0 / math.sqrt(f))
+    else:
+        stages['w1'] = init(s, d, f)
+        stages['w2'] = init(s, f, d, scale=1.0 / math.sqrt(f))
+    return {
+        'embed': init(v, d, scale=0.02),
+        'head': init(d, v),
+        'stages': stages,
+    }
+
+
+def param_specs(cfg: TransformerConfig):
+    """PartitionSpecs over AXES for every leaf."""
+    col = P('pipe', None, 'model')       # qkv: heads sharded over model
+    stages = {
+        'ln1_scale': P('pipe', None), 'ln1_bias': P('pipe', None),
+        'wq': col, 'wk': col, 'wv': col,
+        'wo': P('pipe', 'model', None),  # row-parallel out-proj -> psum
+        'ln2_scale': P('pipe', None), 'ln2_bias': P('pipe', None),
+    }
+    if cfg.num_experts:
+        stages['gate'] = P('pipe', None, None)
+        stages['w1'] = P('pipe', 'data', None, None)   # ep over data axis
+        stages['w2'] = P('pipe', 'data', None, None)
+    else:
+        stages['w1'] = P('pipe', None, 'model')        # col-parallel
+        stages['w2'] = P('pipe', 'model', None)        # row-parallel
+    return {'embed': P(None, None), 'head': P(None, None),
+            'stages': stages}
+
+
+def _map_with_specs(fn, tree, specs):
+    """Apply ``fn(leaf, spec)`` over parallel nested dicts (PartitionSpec
+    is a tuple subclass, so jax.tree.map would descend into it)."""
+    if isinstance(tree, dict):
+        return {k: _map_with_specs(fn, v, specs[k]) for k, v in tree.items()}
+    return fn(tree, specs)
+
+
+def _layer_norm(x, scale, bias):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + 1e-6) * scale + bias).astype(x.dtype)
+
+
+def _stage_fn(p, x, *, cfg: TransformerConfig, tp: int, sp: int):
+    """One transformer block on the local activation shard.
+    x: (mb_local, s_local, D).  p: this stage's params (leading dim
+    squeezed).  Collectives: 'seq' (ring attention), 'model' (psum for
+    row-parallel projections), 'data' (MoE all_to_all)."""
+    mb, s_loc, d = x.shape
+    h_local = cfg.num_heads // tp        # heads owned by this model rank
+    hd = d // cfg.num_heads
+
+    # --- attention ---------------------------------------------------------
+    y = _layer_norm(x, p['ln1_scale'], p['ln1_bias'])
+    q = (y @ p['wq']).reshape(mb, s_loc, h_local, hd)
+    k = (y @ p['wk']).reshape(mb, s_loc, h_local, hd)
+    v = (y @ p['wv']).reshape(mb, s_loc, h_local, hd)
+    if cfg.attn == 'ring' and sp > 1:
+        attn = _ring_attention_local(q, k, v, axis_name='seq',
+                                     causal=cfg.causal)
+    else:
+        mask = None
+        if cfg.causal:
+            mask = jnp.tril(jnp.ones((s_loc, s_loc), bool))[None, None]
+        attn = _local_attention(q, k, v, 1.0 / math.sqrt(hd), mask)
+    attn = attn.reshape(mb, s_loc, h_local * hd)
+    out = attn @ p['wo']                  # row-parallel: partial sums
+    if tp > 1:
+        out = lax.psum(out, 'model')
+    x = x + out
+
+    # --- ffn ---------------------------------------------------------------
+    y = _layer_norm(x, p['ln2_scale'], p['ln2_bias'])
+    if cfg.num_experts:
+        yf = y.reshape(mb * s_loc, d)
+        ff = moe_ffn_local(yf, p['gate'], p['w1'], p['w2'],
+                           axis_name='data',
+                           capacity_factor=cfg.capacity_factor)
+        ff = ff.reshape(mb, s_loc, d)
+    else:
+        ff = jax.nn.relu(y @ p['w1']) @ p['w2']
+        if tp > 1:
+            ff = lax.psum(ff, 'model')
+    return x + ff
+
+
+def _loss_local(params, tokens, labels, *, cfg, tp, sp):
+    """Local shard loss: embed -> pipelined blocks -> head -> mean NLL."""
+    h = jnp.take(params['embed'], tokens, axis=0)        # (b, s, D)
+    xs = split_microbatches(h, cfg.num_microbatches)
+    stage = functools.partial(_stage_fn, cfg=cfg, tp=tp, sp=sp)
+    hs = pipeline_stage_loop(stage, params['stages'], xs,
+                             axis_name='pipe', num_stages=cfg.num_stages)
+    h = hs.reshape(h.shape)
+    logits = (h @ params['head']).astype(jnp.float32)     # (b, s, V)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
+    return nll.mean()
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 0.1):
+    """Jitted full train step: (params, tokens, labels) ->
+    (new_params, loss).  tokens/labels are global (B, seq_len) int32."""
+    tp = mesh.shape['model']
+    sp = mesh.shape['seq']
+    if cfg.num_heads % tp:
+        raise ValueError('num_heads must divide model axis')
+    if sp > 1 and cfg.attn != 'ring':
+        raise ValueError(
+            f"attn='{cfg.attn}' on a seq-sharded mesh (seq={sp}) would "
+            "attend block-diagonally; use attn='ring'")
+    specs = param_specs(cfg)
+    tok_spec = P('data', 'seq')
+
+    n_ranks = (mesh.shape['pipe'] * mesh.shape['data']
+               * mesh.shape['seq'] * mesh.shape['model'])
+
+    def _replicated_axes(spec: P) -> Tuple[str, ...]:
+        used = {a for part in spec if part is not None
+                for a in ((part,) if isinstance(part, str) else part)}
+        return tuple(a for a in AXES if a not in used)
+
+    def body(params, tokens, labels):
+        loss, grads = jax.value_and_grad(
+            functools.partial(_loss_local, cfg=cfg, tp=tp, sp=sp))(
+                params, tokens, labels)
+        # Per-rank autodiff yields d(sum of every rank's local loss)/
+        # d(local shard) — collective transposes already crossed ranks.
+        # Tie replicas back together: sum each leaf's gradient over the
+        # axes it is replicated on, then normalize by the total rank
+        # count so the result is the gradient of the *mean* loss.
+        # Validated against the single-device oracle in
+        # tests/test_transformer_parallel.py.
+        def tie(g, spec):
+            rep = _replicated_axes(spec)
+            if rep:
+                g = lax.psum(g, rep)
+            return g / n_ranks
+        grads = _map_with_specs(tie, grads, specs)
+        new_params = jax.tree.map(
+            lambda w, g: (w - lr * g).astype(w.dtype), params, grads)
+        return new_params, lax.pmean(loss, AXES)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(specs, tok_spec, tok_spec),
+                   out_specs=(specs, P()),
+                   check_vma=False)
+    return jax.jit(fn)
+
+
+def build_transformer_mesh(n_devices: int,
+                           pp: int, dp: int, sp: int, tp: int,
+                           devices=None) -> Mesh:
+    if pp * dp * sp * tp != n_devices:
+        raise ValueError(f'pp*dp*sp*tp = {pp * dp * sp * tp} '
+                         f'!= {n_devices} devices')
+    devs = np.asarray(devices if devices is not None
+                      else jax.devices()[:n_devices])
+    return Mesh(devs.reshape(pp, dp, sp, tp), AXES)
+
+
+def reference_loss(params, tokens, labels, cfg: TransformerConfig):
+    """Single-device oracle: same math, no mesh, sequential stages."""
+    h = jnp.take(params['embed'], tokens, axis=0)
+    for i in range(cfg.num_stages):
+        p = jax.tree.map(lambda a: a[i], params['stages'])
+        mb, s, d = h.shape
+        hd = d // cfg.num_heads
+        y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
+        q = (y @ p['wq']).reshape(mb, s, cfg.num_heads, hd)
+        k = (y @ p['wk']).reshape(mb, s, cfg.num_heads, hd)
+        v = (y @ p['wv']).reshape(mb, s, cfg.num_heads, hd)
+        mask = None
+        if cfg.causal:
+            mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+        attn = _local_attention(q, k, v, 1.0 / math.sqrt(hd), mask)
+        h = h + attn.reshape(mb, s, d) @ p['wo']
+        y = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
+        if cfg.num_experts:
+            from ..parallel.moe import moe_ffn_reference
+            ff = moe_ffn_reference(y.reshape(mb * s, d), p['gate'],
+                                   p['w1'], p['w2'],
+                                   capacity_factor=cfg.capacity_factor)
+            h = h + ff.reshape(mb, s, d)
+        else:
+            h = h + jax.nn.relu(y @ p['w1']) @ p['w2']
+    logits = (h @ params['head']).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()

@@ -1,0 +1,86 @@
+"""Expert parallelism: top-1 (switch) mixture-of-experts with all_to_all
+dispatch.
+
+No counterpart in the reference (SURVEY.md §2.5 lists expert parallelism
+as absent); built TPU-first: experts are sharded over a mesh axis, tokens
+are routed with two ``lax.all_to_all`` collectives (dispatch + combine)
+that ride ICI, and every shape is static (capacity-bounded routing with
+token dropping, the standard Switch-Transformer discipline) so the whole
+thing jits.
+
+Layout convention inside shard_map over ``axis_name`` (n devices):
+* tokens: local ``(T, D)`` (batch/sequence sharded outside),
+* expert weights: local ``(E/n, D, F)`` / ``(E/n, F, D)`` — each device
+  owns ``E/n`` experts,
+* gate: ``(D, E)`` replicated.
+
+Dispatch: every device builds a per-expert capacity buffer ``(E, C, D)``
+from its own tokens, all_to_all ships expert-group ``e`` to the device
+owning it → ``(E/n, n*C, D)``; the expert FFN runs batched over its
+``n*C`` slots; the reverse all_to_all brings results home and the combine
+einsum scatters them back to token order scaled by the gate probability.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def switch_gate(x, gate_w, capacity: int):
+    """Top-1 gating with capacity.  x:(T,D), gate_w:(D,E) ->
+    dispatch:(T,E,C) 0/1, combine:(T,E,C) = dispatch * gate_prob."""
+    logits = x @ gate_w.astype(x.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                      # (T,)
+    sel = jax.nn.one_hot(expert, gate_w.shape[1], dtype=jnp.float32)
+    pos = jnp.cumsum(sel, axis=0) * sel                      # 1-based slot
+    keep = (pos > 0) & (pos <= capacity)
+    slot = jnp.where(keep, pos - 1, 0).astype(jnp.int32)
+    dispatch = (jax.nn.one_hot(slot.max(axis=-1), capacity,
+                               dtype=jnp.float32)
+                [:, None, :] * (sel * keep)[:, :, None])     # (T,E,C)
+    gate_prob = (probs * sel).sum(-1, keepdims=True)         # (T,1)
+    combine = dispatch * gate_prob[:, :, None]
+    return dispatch, combine
+
+
+def moe_ffn_local(x, gate_w, w1, w2, *, axis_name=None,
+                  capacity_factor: float = 2.0):
+    """Switch FFN.  Call INSIDE shard_map when ``axis_name`` is given
+    (w1/w2 then hold the local expert shard); standalone single-device
+    otherwise (w1/w2 hold all experts).
+
+    x: (T, D) local tokens; w1: (E_local, D, F); w2: (E_local, F, D);
+    gate_w: (D, E_global).  Returns (T, D).
+    """
+    n = 1 if axis_name is None else lax.psum(1, axis_name)
+    e_local = w1.shape[0]
+    e_global = e_local * n
+    t = x.shape[0]
+    capacity = max(1, int(capacity_factor * t / e_global))
+    dispatch, combine = switch_gate(x, gate_w, capacity)
+    xf = x.astype(jnp.float32)
+    buf = jnp.einsum('td,tec->ecd', xf, dispatch)            # (E, C, D)
+    if axis_name is not None:
+        # ship expert-group e to its owner; receive our experts' tokens
+        # from every peer: (E, C, D) -> (E_local, n*C, D)
+        buf = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=1,
+                             tiled=True)
+    h = jax.nn.relu(jnp.einsum('ecd,edf->ecf', buf,
+                               w1.astype(jnp.float32)))
+    y = jnp.einsum('ecf,efd->ecd', h, w2.astype(jnp.float32))
+    if axis_name is not None:
+        # (E_local, n*C, D) -> (E, C, D): results back to the sender
+        y = lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0,
+                           tiled=True)
+    out = jnp.einsum('ecd,tec->td', y, combine)
+    return out.astype(x.dtype)
+
+
+def moe_ffn_reference(x, gate_w, w1, w2, capacity_factor: float = 2.0):
+    """Single-device oracle: same routing/capacity semantics, dense loop
+    over all experts.  w1: (E, D, F), w2: (E, F, D)."""
+    return moe_ffn_local(x, gate_w, w1, w2, axis_name=None,
+                         capacity_factor=capacity_factor)

@@ -1,0 +1,79 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.5: model always
+fits one device), but this framework treats multi-dimensional sharding as
+first-class.  The TPU-idiomatic formulation: stage parameters are stacked
+on a leading axis sharded over the ``pipe`` mesh axis, the schedule is a
+``lax.scan`` over ticks, and stage-to-stage activation transfer is a
+``lax.ppermute`` — XLA overlaps the permute with the next tick's compute.
+
+The schedule is plain GPipe: with S stages and M microbatches the loop
+runs ``M + S - 1`` ticks; stage 0 injects microbatch ``t`` at tick ``t``,
+stage ``S-1`` emits microbatch ``t-(S-1)``.  Bubble fraction
+``(S-1)/(M+S-1)`` — pick ``M >= 4*S`` in real runs.  Backward is ordinary
+``jax.grad`` through the scan (ppermute transposes to the reverse
+permute), which yields the mirrored backward pipeline for free.
+
+Used inside a ``shard_map`` whose mesh includes ``axis_name``; composes
+with sequence/tensor/expert collectives on other axes because everything
+lives in one shard_map body (see models/transformer.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_stage_loop(stage_fn: Callable, stage_params, xs,
+                        *, axis_name: str, num_stages: int):
+    """Run microbatches through the pipeline.  Call INSIDE shard_map.
+
+    stage_fn(params, x_mb) -> y_mb with ``y_mb.shape == x_mb.shape``
+    (homogeneous stages — the transformer-block case).
+    stage_params: local shard of the stacked params — leaves have leading
+    dim 1 (the stage owned by this device); passed to stage_fn squeezed.
+    xs: (M, mb, ...) microbatches, replicated over ``axis_name``.
+    Returns (M, mb, ...) outputs, replicated over ``axis_name`` (the last
+    stage's result is broadcast with a masked psum).
+    """
+    S = num_stages
+    idx = lax.axis_index(axis_name)
+    p_local = jax.tree.map(lambda a: a[0], stage_params)
+    M = xs.shape[0]
+    T = M + S - 1
+    # stage i receives from i-1; no wraparound (stage 0 injects fresh data)
+    perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(carry, t):
+        state, outs = carry
+        inj = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, M - 1), 0,
+                                       keepdims=False)
+        x_in = jnp.where(idx == 0, inj, state)
+        y = stage_fn(p_local, x_in)
+        widx = jnp.clip(t - (S - 1), 0, M - 1)
+        old = lax.dynamic_index_in_dim(outs, widx, 0, keepdims=False)
+        write = jnp.logical_and(idx == S - 1, t >= S - 1)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(write, y, old), widx, 0)
+        state = lax.ppermute(y, axis_name, perm) if perm else y
+        return (state, outs), None
+
+    state0 = jnp.zeros_like(xs[0])
+    outs0 = jnp.zeros_like(xs)
+    (_, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(T))
+    # broadcast the last stage's outputs to every pipe rank
+    return lax.psum(jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)),
+                    axis_name)
+
+
+def split_microbatches(x, num_microbatches: int):
+    """(B, ...) -> (M, B/M, ...)."""
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f'batch {b} not divisible by microbatches {num_microbatches}')
+    return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])

@@ -1,0 +1,212 @@
+"""Pipeline / expert / composed-parallelism tests on the 8-device CPU mesh.
+
+Every distributed program is validated against a single-device oracle:
+same math, no mesh.  The composed TransformerLM step checks both the
+forward loss and the parameter update (i.e. the gradients, including the
+replica-tying psums) to oracle SGD.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from cxxnet_tpu.models import transformer as tfm
+from cxxnet_tpu.parallel.moe import moe_ffn_local, moe_ffn_reference
+from cxxnet_tpu.parallel.pipeline import (pipeline_stage_loop,
+                                          split_microbatches)
+
+
+def _devices(n):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f'need {n} devices, have {len(devs)}')
+    return devs[:n]
+
+
+# --- pipeline -------------------------------------------------------------
+
+def test_pipeline_matches_sequential():
+    S, M, mb, d = 4, 8, 2, 16
+    rng = np.random.RandomState(0)
+    ws = jnp.asarray(rng.randn(S, d, d).astype(np.float32) * 0.3)
+    bs = jnp.asarray(rng.randn(S, d).astype(np.float32) * 0.1)
+    x = jnp.asarray(rng.randn(M * mb, d).astype(np.float32))
+
+    def stage(p, h):
+        return jnp.tanh(h @ p['w'] + p['b'])
+
+    mesh = Mesh(np.asarray(_devices(S)), ('pipe',))
+    fn = shard_map(
+        functools.partial(pipeline_stage_loop, stage, axis_name='pipe',
+                          num_stages=S),
+        mesh=mesh,
+        in_specs=({'w': P('pipe'), 'b': P('pipe')}, P()),
+        out_specs=P(), check_vma=False)
+    got = fn({'w': ws, 'b': bs}, split_microbatches(x, M))
+    got = got.reshape(M * mb, d)
+
+    ref = x
+    for i in range(S):
+        ref = jnp.tanh(ref @ ws[i] + bs[i])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_gradients_flow():
+    S, M, mb, d = 2, 4, 2, 8
+    rng = np.random.RandomState(1)
+    ws = jnp.asarray(rng.randn(S, d, d).astype(np.float32) * 0.3)
+    x = jnp.asarray(rng.randn(M * mb, d).astype(np.float32))
+    mesh = Mesh(np.asarray(_devices(S)), ('pipe',))
+
+    def stage(p, h):
+        return jnp.tanh(h @ p)
+
+    def loss_local(ws_local, xs):
+        out = pipeline_stage_loop(stage, ws_local, xs,
+                                  axis_name='pipe', num_stages=S)
+        return (out ** 2).mean()
+
+    def body(ws_in, xs):
+        return jax.grad(lambda w: loss_local(w, xs))(ws_in)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P('pipe'), P()),
+                   out_specs=P('pipe'), check_vma=False)
+    g = fn(ws, split_microbatches(x, M))
+
+    def ref_loss(ws):
+        h = x
+        for i in range(S):
+            h = jnp.tanh(h @ ws[i])
+        return (h ** 2).mean()
+
+    # each pipe rank's autodiff sums both ranks' identical local losses
+    ref = jax.grad(ref_loss)(ws) * S
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+# --- expert parallelism ---------------------------------------------------
+
+def test_moe_all_to_all_matches_reference():
+    n, e, t, d, f = 4, 8, 32, 16, 24
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(n * t, d).astype(np.float32))
+    gate_w = jnp.asarray(rng.randn(d, e).astype(np.float32))
+    w1 = jnp.asarray(rng.randn(e, d, f).astype(np.float32) * 0.2)
+    w2 = jnp.asarray(rng.randn(e, f, d).astype(np.float32) * 0.2)
+    mesh = Mesh(np.asarray(_devices(n)), ('data',))
+    # ample capacity (>= local tokens) so no token is dropped and the
+    # sharded program must agree with the dense oracle exactly
+    cf = float(e)
+    fn = shard_map(
+        functools.partial(moe_ffn_local, axis_name='data',
+                          capacity_factor=cf),
+        mesh=mesh,
+        in_specs=(P('data'), P(), P('data'), P('data')),
+        out_specs=P('data'), check_vma=False)
+    got = fn(x, gate_w, w1, w2)
+    # oracle shard-by-shard (capacity is per-shard in the sharded run)
+    # same per-expert capacity as the sharded run: capacity is computed
+    # from local token count and GLOBAL expert count in both cases
+    refs = [moe_ffn_reference(x[i * t:(i + 1) * t], gate_w, w1, w2,
+                              capacity_factor=cf)
+            for i in range(n)]
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(jnp.concatenate(refs)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_drops_over_capacity():
+    # capacity 1 with all tokens routed to one expert: only 1 kept
+    d, f = 4, 8
+    x = jnp.ones((6, d), jnp.float32)
+    gate_w = jnp.zeros((d, 2), jnp.float32).at[:, 0].set(1.0)
+    w1 = jnp.ones((2, d, f), jnp.float32)
+    w2 = jnp.ones((2, f, d), jnp.float32)
+    out = moe_ffn_reference(x, gate_w, w1, w2, capacity_factor=1.0 / 3)
+    nonzero_rows = (np.abs(np.asarray(out)).sum(-1) > 0).sum()
+    assert nonzero_rows == 1
+
+
+# --- composed transformer step -------------------------------------------
+
+def _make_inputs(cfg, batch, seed=3):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, cfg.vocab_size, (batch, cfg.seq_len))
+    labels = rng.randint(0, cfg.vocab_size, (batch, cfg.seq_len))
+    return jnp.asarray(tokens, jnp.int32), jnp.asarray(labels, jnp.int32)
+
+
+@pytest.mark.parametrize('pp,dp,sp,tp,experts', [
+    (2, 2, 2, 1, 0),    # pipeline + data + ring-attention sequence
+    (2, 2, 2, 1, 4),    # + switch-MoE experts over the data axis
+    (2, 1, 1, 4, 0),    # pipeline + 4-way tensor parallel
+])
+def test_transformer_step_matches_oracle(pp, dp, sp, tp, experts):
+    # ample MoE capacity: the sharded run routes per (data, seq) shard
+    # per microbatch while the oracle routes the whole batch, so only a
+    # drop-free setting is exactly comparable
+    cfg = tfm.TransformerConfig(
+        vocab_size=32, d_model=16, num_heads=4, d_ff=32,
+        num_stages=pp, seq_len=16, num_experts=experts,
+        num_microbatches=2, attn='ring',
+        capacity_factor=float(max(experts, 1) * 8))
+    mesh = tfm.build_transformer_mesh(8, pp, dp, sp, tp,
+                                      devices=_devices(8))
+    rng = np.random.RandomState(4)
+    params = tfm.init_params(rng, cfg)
+    batch = 4
+    tokens, labels = _make_inputs(cfg, batch)
+
+    step = tfm.make_train_step(cfg, mesh, lr=0.1)
+    new_params, loss = step(params, tokens, labels)
+
+    ref_loss = tfm.reference_loss(params, tokens, labels, cfg)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-4, atol=1e-5)
+
+    ref_grads = jax.grad(
+        lambda p: tfm.reference_loss(p, tokens, labels, cfg))(params)
+    ref_new = jax.tree.map(lambda w, g: w - 0.1 * g, params, ref_grads)
+    flat_got = jax.tree.leaves_with_path(new_params)
+    flat_ref = dict(jax.tree.leaves_with_path(ref_new))
+    for path, got in flat_got:
+        ref = flat_ref[path]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-3, atol=2e-4,
+            err_msg=f'param mismatch at {jax.tree_util.keystr(path)}')
+
+
+def test_transformer_loss_decreases():
+    cfg = tfm.TransformerConfig(vocab_size=16, d_model=16, num_heads=2,
+                                d_ff=32, num_stages=2, seq_len=8,
+                                num_microbatches=2)
+    mesh = tfm.build_transformer_mesh(8, 2, 2, 2, 1, devices=_devices(8))
+    rng = np.random.RandomState(5)
+    params = tfm.init_params(rng, cfg)
+    tokens, _ = _make_inputs(cfg, 4)
+    labels = tokens   # learnable target: predict the input token
+    step = tfm.make_train_step(cfg, mesh, lr=0.2)
+    losses = []
+    for _ in range(10):
+        params, loss = step(params, tokens, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_local_attn_rejected_on_seq_mesh():
+    cfg = tfm.TransformerConfig(num_stages=2, attn='local')
+    mesh = tfm.build_transformer_mesh(8, 2, 2, 2, 1, devices=_devices(8))
+    with pytest.raises(ValueError, match='block-diagonal'):
+        tfm.make_train_step(cfg, mesh)
