@@ -32,6 +32,21 @@ class FullConnectLayer(Layer):
     type_id = kFullConnect
     param_fields = ('wmat', 'bias')
 
+    def __init__(self, name: str = ''):
+        super().__init__(name=name)
+        # Reference knob (fullc_layer-inl.hpp:17,22,120-122): push
+        # activations + output-grads to the parameter server and compute dW
+        # after the gather, saving bandwidth for big FC layers.  Under XLA
+        # the gradient all-reduce strategy is chosen by the SPMD
+        # partitioner, so the flag is accepted for config compatibility but
+        # the comm optimization itself is delegated to the compiler.
+        self.fullc_gather = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == 'fullc_gather':
+            self.fullc_gather = int(val)
+        super().set_param(name, val)
+
     def infer_shapes(self, in_specs: List[NodeSpec]) -> List[NodeSpec]:
         assert len(in_specs) == 1, 'fullc: only supports 1-1 connection'
         if self.param.num_hidden <= 0:

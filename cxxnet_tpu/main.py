@@ -22,6 +22,7 @@ from typing import List, Optional, Tuple
 from .io.data import create_iterator
 from .nnet.trainer import NetTrainer
 from .utils.config import apply_cli_overrides, parse_config_file
+from .utils.profiler import TraceWindow
 
 ConfigEntry = Tuple[str, str]
 
@@ -197,6 +198,15 @@ class LearnTask:
             return
         if self.test_io:
             print('start I/O test')
+        tracer = TraceWindow()
+        tracer.configure(self.cfg)
+        batch_counter = 0
+        try:
+            self._train_rounds(tracer, batch_counter, start)
+        finally:
+            tracer.stop()
+
+    def _train_rounds(self, tracer, batch_counter, start) -> None:
         cc = self.max_round
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
@@ -206,7 +216,9 @@ class LearnTask:
             self.net_trainer.start_round(self.start_counter)
             for batch in self.itr_train:
                 if self.test_io == 0:
+                    tracer.before_update(batch_counter)
                     self.net_trainer.update(batch)
+                    batch_counter += 1
                 sample_counter += 1
                 if sample_counter % self.print_step == 0 and not self.silent:
                     elapsed = int(time.time() - start)

@@ -76,6 +76,7 @@ class NetTrainer:
         self.round = 0
         self.max_round = 1
         self.tensor_parallel = 1
+        self.test_on_server = 0
         self.compute_dtype = jnp.float32
         self.devices: List[int] = []
         self.metric = MetricSet()
@@ -110,6 +111,8 @@ class NetTrainer:
             self.max_round = int(val)
         if name == 'tensor_parallel':
             self.tensor_parallel = int(val)
+        if name == 'test_on_server':
+            self.test_on_server = int(val)
         if name == 'use_pallas':
             # process-wide switch read by ops.pallas_kernels.pallas_enabled
             os.environ['CXXNET_PALLAS'] = val
@@ -247,6 +250,38 @@ class NetTrainer:
     # --- training ---------------------------------------------------------
     def start_round(self, round_: int) -> None:
         self.round = round_
+        if self.test_on_server:
+            bad = self.check_weight_consistency()
+            assert bad == 0, f'{bad} weight tensors diverged across replicas'
+
+    def check_weight_consistency(self) -> int:
+        """``test_on_server`` analog (``async_updater-inl.hpp:144-154``).
+
+        The reference had every worker fetch the server's weight copy at
+        round start and compare.  Here there is no server: the invariant is
+        that every device holding a replica of the same parameter shard
+        agrees bitwise (catching nondeterministic collectives or sharding
+        bugs).  Returns the number of mismatching tensors; mismatches are
+        reported on stderr like the reference's CheckWeight_.
+        """
+        import sys
+        bad = 0
+        for lk, fields in self.params.items():
+            for fk, arr in fields.items():
+                seen: Dict[str, np.ndarray] = {}
+                for sh in arr.addressable_shards:
+                    key = str(sh.index)
+                    d = np.asarray(sh.data)
+                    if key in seen:
+                        if not np.array_equal(seen[key], d, equal_nan=True):
+                            bad += 1
+                            sys.stderr.write(
+                                f'weight inconsistent: layer {lk} field {fk} '
+                                f'(device {sh.device})\n')
+                            break
+                    else:
+                        seen[key] = d
+        return bad
 
     def update(self, batch) -> None:
         """One minibatch through forward/backward/(maybe) update —

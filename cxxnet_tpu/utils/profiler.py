@@ -1,0 +1,67 @@
+"""Profiling / tracing subsystem.
+
+The reference has only wall-clock second counters printed every
+``print_step`` batches (``cxxnet_main.cpp:376-387``, ``utils/timer.h:16-30``)
+— no tracer, no per-op timing.  On TPU the idiomatic replacement is the JAX
+profiler: it records an XLA trace (per-op device timing, HBM usage, fusion
+boundaries) viewable in TensorBoard / Perfetto.
+
+Config surface (global section)::
+
+    profile_dir = traces        # enables tracing; directory for the trace
+    profile_start_batch = 10    # first update() covered (default 10,
+    profile_stop_batch = 20     #   skipping compile) .. last (exclusive)
+
+The window is batch-based so the first (compiling) steps are excluded by
+default.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+class TraceWindow:
+    """Start/stop ``jax.profiler`` around a window of training batches."""
+
+    def __init__(self):
+        self.profile_dir = ''
+        self.start_batch = 10
+        self.stop_batch = 20
+        self._active = False
+        self._done = False
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == 'profile_dir':
+            self.profile_dir = val
+        if name == 'profile_start_batch':
+            self.start_batch = int(val)
+        if name == 'profile_stop_batch':
+            self.stop_batch = int(val)
+
+    def configure(self, cfg: List[Tuple[str, str]]) -> None:
+        for name, val in cfg:
+            self.set_param(name, val)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.profile_dir)
+
+    def before_update(self, batch_counter: int) -> None:
+        """Call before each ``trainer.update``; ``batch_counter`` counts from 0."""
+        if not self.enabled or self._done:
+            return
+        if not self._active and batch_counter >= self.start_batch:
+            import jax
+            jax.profiler.start_trace(self.profile_dir)
+            self._active = True
+        elif self._active and batch_counter >= self.stop_batch:
+            self.stop()
+
+    def stop(self) -> None:
+        """Finish the trace (idempotent; also call at end of training)."""
+        if self._active:
+            import jax
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Local multi-worker launcher — analog of the reference's ps-lite tracker
+(reference ``example/MNIST/mpi.conf`` + dmlc launcher).
+
+Reads a launcher config (``num_workers``, ``app_conf``, ``coordinator``,
+``arg``) and spawns one trainer process per worker with the environment
+contract consumed by ``cxxnet_tpu.parallel.distributed``:
+
+  CXXNET_COORDINATOR   coordinator host:port (worker 0 binds it)
+  CXXNET_NUM_WORKER    number of processes in the job
+  PS_RANK              this process's rank (reference env var name kept,
+                       iter_thread_imbin-inl.hpp:190-194 — also shards the
+                       data pipeline per worker)
+
+For a real TPU pod each host runs the same command under its own scheduler
+(GKE/xmanager); this script is the single-machine version for development
+and CI, forcing each worker onto the CPU backend.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def parse_launcher_conf(path):
+    cfg = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split('#', 1)[0].strip()
+            if not line or '=' not in line:
+                continue
+            k, _, v = line.partition('=')
+            cfg[k.strip()] = v.strip()
+    return cfg
+
+
+def main(argv):
+    if not argv:
+        print('Usage: launch_dist.py <launcher.conf> [extra k=v ...]')
+        return 1
+    conf_path = argv[0]
+    cfg = parse_launcher_conf(conf_path)
+    nworker = int(cfg.get('num_workers', '1'))
+    app_conf = cfg.get('app_conf')
+    coord = cfg.get('coordinator', '127.0.0.1:9900')
+    extra = cfg.get('arg', '').split() + list(argv[1:])
+    workdir = os.path.dirname(os.path.abspath(conf_path))
+    procs = []
+    for rank in range(nworker):
+        env = dict(os.environ)
+        env.update({
+            'CXXNET_COORDINATOR': coord,
+            'CXXNET_NUM_WORKER': str(nworker),
+            'PS_RANK': str(rank),
+            'JAX_PLATFORMS': 'cpu',
+        })
+        cmd = [sys.executable, '-m', 'cxxnet_tpu.main', app_conf] + extra + [
+            f'dist_num_worker={nworker}', f'dist_worker_rank={rank}']
+        procs.append(subprocess.Popen(cmd, cwd=workdir, env=env))
+    rcs = [p.wait() for p in procs]
+    return next((rc for rc in rcs if rc), 0)
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))
