@@ -41,10 +41,16 @@ class ThreadBuffer:
         except BaseException as e:  # propagate to consumer
             box.append(e)
         finally:
-            try:
-                q.put_nowait(_STOP)
-            except queue.Full:
-                pass   # consumer gone; stop flag is set
+            # the sentinel must not be dropped: a full queue usually means
+            # the consumer is merely slow, and losing _STOP would leave it
+            # blocked in q.get() forever once it drains the items.  Keep
+            # trying until it lands or the consumer abandons us (stop set).
+            while not stop.is_set():
+                try:
+                    q.put(_STOP, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     def __iter__(self):
         # restart semantics = BeforeFirst(): a fresh producer each epoch;

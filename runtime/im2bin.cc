@@ -1,0 +1,142 @@
+// im2bin — native image packer (counterpart of reference tools/im2bin.cpp).
+//
+// Packs the raw encoded bytes of every image listed in a .lst file
+// ("index \t label(s) \t filename" per line) into a stream of 64MB
+// BinaryPages (format notes in cxxnet_runtime.cc; byte-compatible with the
+// reference utils/io.h:253-326 and cxxnet_tpu.utils.io_stream.BinaryPage).
+//
+//   im2bin image.lst image_root_dir output.bin
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr size_t kPageInts = 64u << 18;
+constexpr size_t kPageBytes = kPageInts * 4;
+
+// Write-side BinaryPage: int32 header at the front (head[0]=count,
+// head[1+i]=cumulative sizes), blobs packed backwards from the page end.
+struct PageWriter {
+  std::vector<char> buf;
+  int32_t* head;
+  size_t tail;  // byte offset of the lowest packed blob
+
+  PageWriter() : buf(kPageBytes) { Clear(); }
+
+  void Clear() {
+    std::memset(buf.data(), 0, kPageBytes);
+    head = reinterpret_cast<int32_t*>(buf.data());
+    tail = kPageBytes;
+  }
+
+  int Count() const { return head[0]; }
+
+  size_t FreeBytes() const {
+    size_t header_end = (static_cast<size_t>(Count()) + 2) * 4;
+    return tail - header_end;
+  }
+
+  bool Push(const std::vector<char>& blob) {
+    if (FreeBytes() < blob.size() + 4) return false;
+    int n = Count();
+    head[n + 2] = head[n + 1] + static_cast<int32_t>(blob.size());
+    tail -= blob.size();
+    std::memcpy(buf.data() + tail, blob.data(), blob.size());
+    head[0] = n + 1;
+    return true;
+  }
+
+  bool Save(FILE* fo) const {
+    return fwrite(buf.data(), 1, kPageBytes, fo) == kPageBytes;
+  }
+};
+
+// .lst line: "index \t label [label ...] \t filename".  Filename may hold
+// spaces when the line is tab-separated (everything after the last tab);
+// whitespace-separated lists (accepted by the Python tools,
+// cxxnet_tpu/io/iter_img.py parse_lst_line) fall back to the last
+// whitespace-delimited token.
+bool ParseLstLine(const std::string& line, std::string* fname) {
+  size_t end = line.find_last_not_of(" \t\r\n");
+  if (end == std::string::npos) return false;
+  size_t last_tab = line.find_last_of('\t', end);
+  size_t sep = last_tab == std::string::npos
+                   ? line.find_last_of(" \t", end)
+                   : last_tab;
+  if (sep == std::string::npos || sep >= end) return false;
+  *fname = line.substr(sep + 1, end - sep);
+  return true;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    fprintf(stderr, "Usage: im2bin image.lst image_root_dir output_file\n");
+    return 1;
+  }
+  std::string root = argv[2];
+  if (!root.empty() && root != "." && root.back() != '/') root += '/';
+  if (root == ".") root.clear();
+
+  FILE* flst = fopen(argv[1], "r");
+  if (!flst) { fprintf(stderr, "cannot open %s\n", argv[1]); return 1; }
+  FILE* fo = fopen(argv[3], "wb");
+  if (!fo) { fprintf(stderr, "cannot open %s\n", argv[3]); return 1; }
+
+  PageWriter pg;
+  long imcnt = 0, pgcnt = 0;
+  time_t start = time(nullptr);
+  printf("create image binary pack from %s...\n", argv[1]);
+
+  char linebuf[1 << 16];
+  while (fgets(linebuf, sizeof(linebuf), flst)) {
+    std::string line(linebuf), fname;
+    if (line.find_first_not_of(" \t\r\n") == std::string::npos) continue;
+    if (!ParseLstLine(line, &fname)) {
+      fprintf(stderr, "malformed .lst line: %s", linebuf);
+      return 1;
+    }
+    std::string path = root + fname;
+    FILE* fi = fopen(path.c_str(), "rb");
+    if (!fi) { fprintf(stderr, "cannot open image %s\n", path.c_str()); return 1; }
+    fseek(fi, 0, SEEK_END);
+    long sz = ftell(fi);
+    fseek(fi, 0, SEEK_SET);
+    std::vector<char> blob(static_cast<size_t>(sz));
+    if (fread(blob.data(), 1, blob.size(), fi) != blob.size()) {
+      fprintf(stderr, "read error on %s\n", path.c_str());
+      return 1;
+    }
+    fclose(fi);
+
+    if (!pg.Push(blob)) {
+      if (!pg.Save(fo)) { fprintf(stderr, "write error\n"); return 1; }
+      ++pgcnt;
+      pg.Clear();
+      if (!pg.Push(blob)) {
+        fprintf(stderr, "image %s too large for one page\n", path.c_str());
+        return 1;
+      }
+    }
+    if (++imcnt % 1000 == 0) {
+      printf("\r[%8ld] images -> %ld pages, %ld sec elapsed", imcnt, pgcnt,
+             static_cast<long>(time(nullptr) - start));
+      fflush(stdout);
+    }
+  }
+  if (pg.Count() != 0) {
+    if (!pg.Save(fo)) { fprintf(stderr, "write error\n"); return 1; }
+    ++pgcnt;
+  }
+  printf("\nfinished: [%8ld] images -> %ld pages, %ld sec elapsed\n", imcnt,
+         pgcnt, static_cast<long>(time(nullptr) - start));
+  fclose(fo);
+  fclose(flst);
+  return 0;
+}

@@ -163,3 +163,39 @@ def test_augment_affine_rotation_180(tmp_path):
     # bright pixel moves to (9,8): 180° about the reference's size/2 center
     pos = np.unravel_index(np.argmax(out[0]), out[0].shape)
     assert pos == (9, 8), pos
+
+
+def test_threadbuffer_slow_consumer_terminates():
+    """Regression: producer finishing against a full queue must still
+    deliver the stop sentinel (a slow consumer previously hung forever)."""
+    import time as _time
+    from cxxnet_tpu.utils.thread_buffer import ThreadBuffer
+    buf = ThreadBuffer(lambda: iter([1, 2, 3]), buffer_size=1)
+    got = []
+    for item in buf:
+        _time.sleep(0.3)     # let the producer finish while the queue is full
+        got.append(item)
+    assert got == [1, 2, 3]
+
+
+def test_native_im2bin_matches_python_tool(tmp_path):
+    """runtime/im2bin output must be byte-identical to tools/im2bin.py,
+    for both tab- and space-separated .lst files."""
+    root_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native_tool = os.path.join(root_dir, 'runtime', 'im2bin')
+    if not os.path.exists(native_tool):
+        pytest.skip('runtime/im2bin not built')
+    py_tool = os.path.join(root_dir, 'tools', 'im2bin.py')
+    lst = make_img_dataset(str(tmp_path), n=8)
+    # space-separated variant of the same list
+    lst_sp = str(tmp_path / 'space.lst')
+    with open(lst) as f, open(lst_sp, 'w') as g:
+        g.write(f.read().replace('\t', ' '))
+    for lst_file, tag in ((lst, 'tab'), (lst_sp, 'sp')):
+        py_bin = str(tmp_path / f'py_{tag}.bin')
+        nat_bin = str(tmp_path / f'nat_{tag}.bin')
+        subprocess.check_call([sys.executable, py_tool, lst_file,
+                               str(tmp_path), py_bin])
+        subprocess.check_call([native_tool, lst_file, str(tmp_path), nat_bin])
+        with open(py_bin, 'rb') as a, open(nat_bin, 'rb') as b:
+            assert a.read() == b.read()

@@ -77,3 +77,4 @@ def test_imgbin_iterator_uses_native_jpeg(tmp_path):
     batches = list(it)
     assert len(batches) == 2
     assert batches[0].data.shape == (3, 3, 20, 20)
+

@@ -44,6 +44,10 @@ def main(argv):
     cfg = parse_launcher_conf(conf_path)
     nworker = int(cfg.get('num_workers', '1'))
     app_conf = cfg.get('app_conf')
+    if not app_conf:
+        print(f'{conf_path}: missing required key "app_conf" '
+              '(the trainer config each worker runs)')
+        return 1
     coord = cfg.get('coordinator', '127.0.0.1:9900')
     extra = cfg.get('arg', '').split() + list(argv[1:])
     workdir = os.path.dirname(os.path.abspath(conf_path))
