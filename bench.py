@@ -168,10 +168,15 @@ eval_train = 0
 
 
 def main() -> int:
+    modes = {'alexnet': bench_alexnet,
+             'inception_bn': bench_inception_bn,
+             'mnist_tta': bench_mnist_tta}
     mode = sys.argv[1] if len(sys.argv) > 1 else 'alexnet'
-    return {'alexnet': bench_alexnet,
-            'inception_bn': bench_inception_bn,
-            'mnist_tta': bench_mnist_tta}[mode]()
+    if mode not in modes:
+        print(f'unknown bench mode {mode!r}; choose from '
+              f'{sorted(modes)}', file=sys.stderr)
+        return 2
+    return modes[mode]()
 
 
 if __name__ == '__main__':

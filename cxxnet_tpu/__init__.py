@@ -8,3 +8,27 @@ and data-parallel scaling over a ``jax.sharding.Mesh``.
 """
 
 __version__ = '0.1.0'
+
+
+def _honor_platform_env() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative.
+
+    Some deployment images force-register an out-of-process TPU PJRT
+    plugin from ``sitecustomize`` in every interpreter, which can override
+    the env var's backend selection (and hang backend discovery when the
+    device link is unreachable).  Re-asserting the env choice through the
+    live config keeps ``JAX_PLATFORMS=cpu`` runs (tests, embedded C-ABI
+    hosts, data tooling) off the device path entirely.
+    """
+    import os
+    want = os.environ.get('JAX_PLATFORMS')
+    if not want:
+        return
+    try:
+        import jax
+        jax.config.update('jax_platforms', want)
+    except Exception:       # jax absent/too old: backend selection is moot
+        pass
+
+
+_honor_platform_env()

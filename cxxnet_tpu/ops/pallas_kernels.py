@@ -23,6 +23,7 @@ suite validates them without hardware.
 from __future__ import annotations
 
 import functools
+import math
 import os
 
 import jax
@@ -216,3 +217,258 @@ def _matmul_impl(a, b, tile_m: int = 256, tile_n: int = 256):
         interpret=_interpret(),
     )(ap, bp)
     return out[:m, :n]
+
+
+# --- flash attention ------------------------------------------------------
+#
+# Fused online-softmax attention: the (seq_q, seq_k) score matrix never
+# leaves VMEM.  Forward and both backward passes (dq; dk/dv) are blockwise
+# Pallas kernels wired through jax.custom_vjp, with the standard
+# log-sum-exp + delta recomputation scheme.  Layout inside the kernels is
+# (batch*heads, seq, head_dim); the public API takes (b, s, h, d).
+
+_NEG_INF = -1e30
+
+
+def _causal_mask(qi, kj, bq, bk, sk_valid):
+    """(bq, bk) bool mask of *allowed* positions for query block qi /
+    key block kj, also masking padded keys beyond sk_valid."""
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return (q_pos >= k_pos) & (k_pos < sk_valid)
+
+
+def _valid_mask(kj, bq, bk, sk_valid):
+    k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return k_pos < sk_valid
+
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the varying-manual-axes of ``like`` so
+    pallas_call works under shard_map(check_vma=True)."""
+    vma = getattr(getattr(like, 'aval', None), 'vma', None)
+    if vma is not None:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:          # older jax without the vma kwarg
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                      block_k, sk_valid):
+    q = q_ref[0].astype(jnp.float32) * scale            # (bq, d)
+    qi = pl.program_id(1)
+    bq, d = q.shape
+    nk = k_ref.shape[1] // block_k
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        mask = (_causal_mask(qi, j, bq, block_k, sk_valid) if causal
+                else _valid_mask(j, bq, block_k, sk_valid))
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc = jnp.zeros((bq, d), jnp.float32)
+    m = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc, m, l))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe))[:, None]
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                     *, scale, causal, block_k, sk_valid):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    qi = pl.program_id(1)
+    bq, d = q.shape
+    nk = k_ref.shape[1] // block_k
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        mask = (_causal_mask(qi, j, bq, block_k, sk_valid) if causal
+                else _valid_mask(j, bq, block_k, sk_valid))
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, *, scale, causal, block_q, sq_valid):
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    kj = pl.program_id(1)
+    bk, d = k.shape
+    nq = q_ref.shape[1] // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
+        # mask: query rows beyond sq_valid contribute nothing (their do is
+        # zero-padded anyway); causal applies q>=k with roles swapped
+        q_pos = (i * block_q
+                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
+        k_pos = (kj * bk
+                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1))
+        mask = q_pos < sq_valid
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
+        dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None])
+        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nq, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pad_seq(x, block):
+    pad = (-x.shape[1]) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _flash_blocks(seq, block):
+    return max(1, min(block, seq))
+
+
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
+    """q,k,v: (bh, s, d).  Returns (out, lse) with lse over valid keys."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq = _flash_blocks(sq, block_q)
+    bk = _flash_blocks(sk, block_k)
+    qp, kp, vp = _pad_seq(q, bq), _pad_seq(k, bk), _pad_seq(v, bk)
+    sqp, skp = qp.shape[1], kp.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
+                               block_k=bk, sk_valid=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=[_sds((bh, sqp, d), q.dtype, qp),
+                   _sds((bh, sqp, 1), jnp.float32, qp)],
+        grid=(bh, sqp // bq),
+        in_specs=[_block_spec((1, bq, d), lambda i, j: (i, j, 0)),
+                  _block_spec((1, skp, d), lambda i, j: (i, 0, 0)),
+                  _block_spec((1, skp, d), lambda i, j: (i, 0, 0))],
+        out_specs=[_block_spec((1, bq, d), lambda i, j: (i, j, 0)),
+                   _block_spec((1, bq, 1), lambda i, j: (i, j, 0))],
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return out[:, :sq], lse[:, :sq, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, causal, block_q, block_k):
+    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+    return out
+
+
+def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bhsd_bwd(causal, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq = _flash_blocks(sq, block_q)
+    bk = _flash_blocks(sk, block_k)
+    scale = 1.0 / math.sqrt(d)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    qp, gp = _pad_seq(q, bq), _pad_seq(g, bq)
+    kp, vp = _pad_seq(k, bk), _pad_seq(v, bk)
+    sqp, skp = qp.shape[1], kp.shape[1]
+    pad_q = sqp - sq
+    lse_p = jnp.pad(lse, ((0, 0), (0, pad_q)))[..., None]
+    delta_p = jnp.pad(delta, ((0, 0), (0, pad_q)))[..., None]
+
+    dq_kernel = functools.partial(_flash_dq_kernel, scale=scale,
+                                  causal=causal, block_k=bk, sk_valid=sk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=_sds((bh, sqp, d), q.dtype, qp),
+        grid=(bh, sqp // bq),
+        in_specs=[_block_spec((1, bq, d), lambda i, j: (i, j, 0)),
+                  _block_spec((1, skp, d), lambda i, j: (i, 0, 0)),
+                  _block_spec((1, skp, d), lambda i, j: (i, 0, 0)),
+                  _block_spec((1, bq, d), lambda i, j: (i, j, 0)),
+                  _block_spec((1, bq, 1), lambda i, j: (i, j, 0)),
+                  _block_spec((1, bq, 1), lambda i, j: (i, j, 0))],
+        out_specs=_block_spec((1, bq, d), lambda i, j: (i, j, 0)),
+        interpret=_interpret(),
+    )(qp, kp, vp, gp, lse_p, delta_p)
+
+    dkv_kernel = functools.partial(_flash_dkv_kernel, scale=scale,
+                                   causal=causal, block_q=bq, sq_valid=sq)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=[_sds((bh, skp, d), k.dtype, kp),
+                   _sds((bh, skp, d), v.dtype, vp)],
+        grid=(bh, skp // bk),
+        in_specs=[_block_spec((1, sqp, d), lambda i, j: (i, 0, 0)),
+                  _block_spec((1, bk, d), lambda i, j: (i, j, 0)),
+                  _block_spec((1, bk, d), lambda i, j: (i, j, 0)),
+                  _block_spec((1, sqp, d), lambda i, j: (i, 0, 0)),
+                  _block_spec((1, sqp, 1), lambda i, j: (i, 0, 0)),
+                  _block_spec((1, sqp, 1), lambda i, j: (i, 0, 0))],
+        out_specs=[_block_spec((1, bk, d), lambda i, j: (i, j, 0)),
+                   _block_spec((1, bk, d), lambda i, j: (i, j, 0))],
+        interpret=_interpret(),
+    )(qp, kp, vp, gp, lse_p, delta_p)
+
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128):
+    """Fused attention over ``(batch, seq, heads, head_dim)`` arrays.
+
+    Exact (online-softmax) attention; O(seq) memory — the score matrix
+    stays in VMEM blocks.  Differentiable via blockwise Pallas backward
+    kernels.  Oracle: ``parallel.sequence.attention_reference``.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+
+    def to_bhsd(x, s):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, x.shape[-1])
+
+    out = _flash_bhsd(to_bhsd(q, sq), to_bhsd(k, sk), to_bhsd(v, sk),
+                      causal, block_q, block_k)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

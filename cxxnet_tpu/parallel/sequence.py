@@ -130,6 +130,14 @@ def _ulysses_local(q, k, v, axis_name: str, causal: bool):
     q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    from ..ops.pallas_kernels import flash_attention, pallas_enabled
+    if pallas_enabled():
+        # fused online-softmax kernel: O(seq) memory for the local dense
+        # attention after the head scatter
+        out = flash_attention(q, k, v, causal=causal)
+        out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                             tiled=True)
+        return out
     scale = 1.0 / math.sqrt(q.shape[-1])
     mask = None
     if causal:
@@ -149,8 +157,21 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = 'data',
     if q.shape[2] % mesh.shape[axis_name]:
         raise ValueError('ulysses: heads must divide the mesh axis')
     spec = P(None, axis_name, None, None)
-    fn = shard_map(
-        functools.partial(_ulysses_local, axis_name=axis_name,
-                          causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    kwargs = {}
+    from ..ops.pallas_kernels import pallas_enabled
+    if pallas_enabled():
+        # pallas_call in interpret mode doesn't propagate varying-manual-
+        # axes yet (jax suggests this workaround in its error message)
+        kwargs = {'check_vma': False}
+    try:
+        fn = shard_map(
+            functools.partial(_ulysses_local, axis_name=axis_name,
+                              causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kwargs)
+    except TypeError:               # older jax: check_rep spelling
+        fn = shard_map(
+            functools.partial(_ulysses_local, axis_name=axis_name,
+                              causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            **({'check_rep': False} if kwargs else {}))
     return fn(q, k, v)

@@ -11,6 +11,7 @@
 #include <cstdio>
 #include <cstring>
 #include <ctime>
+#include <fstream>
 #include <string>
 #include <vector>
 
@@ -56,20 +57,21 @@ struct PageWriter {
   }
 };
 
-// .lst line: "index \t label [label ...] \t filename".  Filename may hold
-// spaces when the line is tab-separated (everything after the last tab);
-// whitespace-separated lists (accepted by the Python tools,
-// cxxnet_tpu/io/iter_img.py parse_lst_line) fall back to the last
-// whitespace-delimited token.
+// .lst line: "index \t label [label ...] \t filename".  Same rule as the
+// Python parser (cxxnet_tpu/io/iter_img.py parse_lst_line): split on tabs
+// when that yields >= 3 fields (filename = last field, may hold spaces);
+// otherwise fall back to whitespace splitting (filename = last token).
 bool ParseLstLine(const std::string& line, std::string* fname) {
   size_t end = line.find_last_not_of(" \t\r\n");
   if (end == std::string::npos) return false;
-  size_t last_tab = line.find_last_of('\t', end);
-  size_t sep = last_tab == std::string::npos
-                   ? line.find_last_of(" \t", end)
-                   : last_tab;
-  if (sep == std::string::npos || sep >= end) return false;
-  *fname = line.substr(sep + 1, end - sep);
+  size_t begin = line.find_first_not_of(" \t\r\n");
+  std::string body = line.substr(begin, end - begin + 1);
+  int tab_fields = 1;
+  for (char c : body) tab_fields += (c == '\t');
+  size_t sep = tab_fields >= 3 ? body.find_last_of('\t')
+                               : body.find_last_of(" \t");
+  if (sep == std::string::npos || sep + 1 >= body.size()) return false;
+  *fname = body.substr(sep + 1);
   return true;
 }
 
@@ -84,7 +86,7 @@ int main(int argc, char** argv) {
   if (!root.empty() && root != "." && root.back() != '/') root += '/';
   if (root == ".") root.clear();
 
-  FILE* flst = fopen(argv[1], "r");
+  std::ifstream flst(argv[1]);
   if (!flst) { fprintf(stderr, "cannot open %s\n", argv[1]); return 1; }
   FILE* fo = fopen(argv[3], "wb");
   if (!fo) { fprintf(stderr, "cannot open %s\n", argv[3]); return 1; }
@@ -94,12 +96,12 @@ int main(int argc, char** argv) {
   time_t start = time(nullptr);
   printf("create image binary pack from %s...\n", argv[1]);
 
-  char linebuf[1 << 16];
-  while (fgets(linebuf, sizeof(linebuf), flst)) {
-    std::string line(linebuf), fname;
+  std::string line;
+  while (std::getline(flst, line)) {
+    std::string fname;
     if (line.find_first_not_of(" \t\r\n") == std::string::npos) continue;
     if (!ParseLstLine(line, &fname)) {
-      fprintf(stderr, "malformed .lst line: %s", linebuf);
+      fprintf(stderr, "malformed .lst line: %s\n", line.c_str());
       return 1;
     }
     std::string path = root + fname;
@@ -137,6 +139,5 @@ int main(int argc, char** argv) {
   printf("\nfinished: [%8ld] images -> %ld pages, %ld sec elapsed\n", imcnt,
          pgcnt, static_cast<long>(time(nullptr) - start));
   fclose(fo);
-  fclose(flst);
   return 0;
 }
