@@ -256,100 +256,141 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                      block_k, sk_valid):
-    q = q_ref[0].astype(jnp.float32) * scale            # (bq, d)
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *, scale, causal, sk_valid):
+    """Grid (bh, q_blocks, k_blocks): only one (block, d) tile of each
+    operand is VMEM-resident at a time; the online-softmax state lives in
+    VMEM scratch carried across the innermost (key) grid dimension."""
     qi = pl.program_id(1)
-    bq, d = q.shape
-    nk = k_ref.shape[1] // block_k
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
 
-    def body(j, carry):
-        acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # skip key blocks that are entirely masked: fully above the causal
+    # diagonal, or entirely in the padded key range
+    run = kj * bk < sk_valid
+    if causal:
+        run = jnp.logical_and(run, qi * bq + bq - 1 >= kj * bk)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        mask = (_causal_mask(qi, j, bq, block_k, sk_valid) if causal
-                else _valid_mask(j, bq, block_k, sk_valid))
+        mask = (_causal_mask(qi, kj, bq, bk, sk_valid) if causal
+                else _valid_mask(kj, bq, bk, sk_valid))
         s = jnp.where(mask, s, _NEG_INF)
+        m = m_ref[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(mask, p, 0.0)
+        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
         corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
+        l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
+        m_ref[:, 0] = m_new
+        acc_ref[:] = (acc_ref[:] * corr[:, None]
+                      + jnp.dot(p, v_blk, preferred_element_type=jnp.float32))
 
-    acc = jnp.zeros((bq, d), jnp.float32)
-    m = jnp.full((bq,), _NEG_INF, jnp.float32)
-    l = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc, m, l))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe))[:, None]
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(l_safe))[:, None]
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                     *, scale, causal, block_k, sk_valid):
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, :, 0]
-    delta = delta_ref[0, :, 0]
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, dqacc_ref, *, scale, causal, sk_valid):
     qi = pl.program_id(1)
-    bq, d = q.shape
-    nk = k_ref.shape[1] // block_k
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
 
-    def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        dqacc_ref[:] = jnp.zeros_like(dqacc_ref)
+
+    run = kj * bk < sk_valid
+    if causal:
+        run = jnp.logical_and(run, qi * bq + bq - 1 >= kj * bk)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-        mask = (_causal_mask(qi, j, bq, block_k, sk_valid) if causal
-                else _valid_mask(j, bq, block_k, sk_valid))
+        mask = (_causal_mask(qi, kj, bq, bk, sk_valid) if causal
+                else _valid_mask(kj, bq, bk, sk_valid))
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        dqacc_ref[:] = dqacc_ref[:] + jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = (dqacc_ref[:] * scale).astype(dq_ref.dtype)
 
 
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, *, scale, causal, block_q, sq_valid):
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+                      dk_ref, dv_ref, dkacc_ref, dvacc_ref, *, scale,
+                      causal, sq_valid):
     kj = pl.program_id(1)
-    bk, d = k.shape
-    nq = q_ref.shape[1] // block_q
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+    bk = k_ref.shape[1]
+    bq = q_ref.shape[1]
 
-    def body(i, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), 0]
-        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+    @pl.when(qi == 0)
+    def _init():
+        dkacc_ref[:] = jnp.zeros_like(dkacc_ref)
+        dvacc_ref[:] = jnp.zeros_like(dvacc_ref)
+
+    # skip query blocks entirely below the valid range or, for causal,
+    # entirely above the diagonal (no query in the block sees key block kj)
+    run = qi * bq < sq_valid
+    if causal:
+        run = jnp.logical_and(run, qi * bq + bq - 1 >= kj * bk)
+
+    @pl.when(run)
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q_blk = q_ref[0].astype(jnp.float32)
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[0, :, 0]
+        delta_blk = delta_ref[0, :, 0]
         s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
-        # mask: query rows beyond sq_valid contribute nothing (their do is
-        # zero-padded anyway); causal applies q>=k with roles swapped
-        q_pos = (i * block_q
-                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
+        q_pos = (qi * bq
+                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
         k_pos = (kj * bk
-                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1))
+                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
         mask = q_pos < sq_valid
         if causal:
             mask = mask & (q_pos >= k_pos)
         p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
-        dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dvacc_ref[:] = dvacc_ref[:] + jnp.dot(
+            p.T, do_blk, preferred_element_type=jnp.float32)
         dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_blk[:, None])
-        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
-        return dk, dv
+        dkacc_ref[:] = dkacc_ref[:] + jnp.dot(
+            ds.T, q_blk, preferred_element_type=jnp.float32)
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, nq, body, (dk0, dv0))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = (dkacc_ref[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dvacc_ref[:].astype(dv_ref.dtype)
 
 
 def _pad_seq(x, block):
@@ -363,6 +404,12 @@ def _flash_blocks(seq, block):
     return max(1, min(block, seq))
 
 
+def _scratch(shape, dtype=jnp.float32):
+    if pltpu is None:          # pragma: no cover - exotic installs only
+        raise RuntimeError('flash_attention needs pallas TPU memory spaces')
+    return pltpu.VMEM(shape, dtype)
+
+
 def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
     """q,k,v: (bh, s, d).  Returns (out, lse) with lse over valid keys."""
     bh, sq, d = q.shape
@@ -373,17 +420,19 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
     sqp, skp = qp.shape[1], kp.shape[1]
     scale = 1.0 / math.sqrt(d)
     kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
-                               block_k=bk, sk_valid=sk)
+                               sk_valid=sk)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[_sds((bh, sqp, d), q.dtype, qp),
                    _sds((bh, sqp, 1), jnp.float32, qp)],
-        grid=(bh, sqp // bq),
-        in_specs=[_block_spec((1, bq, d), lambda i, j: (i, j, 0)),
-                  _block_spec((1, skp, d), lambda i, j: (i, 0, 0)),
-                  _block_spec((1, skp, d), lambda i, j: (i, 0, 0))],
-        out_specs=[_block_spec((1, bq, d), lambda i, j: (i, j, 0)),
-                   _block_spec((1, bq, 1), lambda i, j: (i, j, 0))],
+        grid=(bh, sqp // bq, skp // bk),
+        in_specs=[_block_spec((1, bq, d), lambda i, j, t: (i, j, 0)),
+                  _block_spec((1, bk, d), lambda i, j, t: (i, t, 0)),
+                  _block_spec((1, bk, d), lambda i, j, t: (i, t, 0))],
+        out_specs=[_block_spec((1, bq, d), lambda i, j, t: (i, j, 0)),
+                   _block_spec((1, bq, 1), lambda i, j, t: (i, j, 0))],
+        scratch_shapes=[_scratch((bq, d)), _scratch((bq, 1)),
+                        _scratch((bq, 1))],
         interpret=_interpret(),
     )(qp, kp, vp)
     return out[:, :sq], lse[:, :sq, 0]
@@ -416,36 +465,38 @@ def _flash_bhsd_bwd(causal, block_q, block_k, res, g):
     delta_p = jnp.pad(delta, ((0, 0), (0, pad_q)))[..., None]
 
     dq_kernel = functools.partial(_flash_dq_kernel, scale=scale,
-                                  causal=causal, block_k=bk, sk_valid=sk)
+                                  causal=causal, sk_valid=sk)
     dq = pl.pallas_call(
         dq_kernel,
         out_shape=_sds((bh, sqp, d), q.dtype, qp),
-        grid=(bh, sqp // bq),
-        in_specs=[_block_spec((1, bq, d), lambda i, j: (i, j, 0)),
-                  _block_spec((1, skp, d), lambda i, j: (i, 0, 0)),
-                  _block_spec((1, skp, d), lambda i, j: (i, 0, 0)),
-                  _block_spec((1, bq, d), lambda i, j: (i, j, 0)),
-                  _block_spec((1, bq, 1), lambda i, j: (i, j, 0)),
-                  _block_spec((1, bq, 1), lambda i, j: (i, j, 0))],
-        out_specs=_block_spec((1, bq, d), lambda i, j: (i, j, 0)),
+        grid=(bh, sqp // bq, skp // bk),
+        in_specs=[_block_spec((1, bq, d), lambda i, j, t: (i, j, 0)),
+                  _block_spec((1, bk, d), lambda i, j, t: (i, t, 0)),
+                  _block_spec((1, bk, d), lambda i, j, t: (i, t, 0)),
+                  _block_spec((1, bq, d), lambda i, j, t: (i, j, 0)),
+                  _block_spec((1, bq, 1), lambda i, j, t: (i, j, 0)),
+                  _block_spec((1, bq, 1), lambda i, j, t: (i, j, 0))],
+        out_specs=_block_spec((1, bq, d), lambda i, j, t: (i, j, 0)),
+        scratch_shapes=[_scratch((bq, d))],
         interpret=_interpret(),
     )(qp, kp, vp, gp, lse_p, delta_p)
 
     dkv_kernel = functools.partial(_flash_dkv_kernel, scale=scale,
-                                   causal=causal, block_q=bq, sq_valid=sq)
+                                   causal=causal, sq_valid=sq)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         out_shape=[_sds((bh, skp, d), k.dtype, kp),
                    _sds((bh, skp, d), v.dtype, vp)],
-        grid=(bh, skp // bk),
-        in_specs=[_block_spec((1, sqp, d), lambda i, j: (i, 0, 0)),
-                  _block_spec((1, bk, d), lambda i, j: (i, j, 0)),
-                  _block_spec((1, bk, d), lambda i, j: (i, j, 0)),
-                  _block_spec((1, sqp, d), lambda i, j: (i, 0, 0)),
-                  _block_spec((1, sqp, 1), lambda i, j: (i, 0, 0)),
-                  _block_spec((1, sqp, 1), lambda i, j: (i, 0, 0))],
-        out_specs=[_block_spec((1, bk, d), lambda i, j: (i, j, 0)),
-                   _block_spec((1, bk, d), lambda i, j: (i, j, 0))],
+        grid=(bh, skp // bk, sqp // bq),
+        in_specs=[_block_spec((1, bq, d), lambda i, t, j: (i, j, 0)),
+                  _block_spec((1, bk, d), lambda i, t, j: (i, t, 0)),
+                  _block_spec((1, bk, d), lambda i, t, j: (i, t, 0)),
+                  _block_spec((1, bq, d), lambda i, t, j: (i, j, 0)),
+                  _block_spec((1, bq, 1), lambda i, t, j: (i, j, 0)),
+                  _block_spec((1, bq, 1), lambda i, t, j: (i, j, 0))],
+        out_specs=[_block_spec((1, bk, d), lambda i, t, j: (i, t, 0)),
+                   _block_spec((1, bk, d), lambda i, t, j: (i, t, 0))],
+        scratch_shapes=[_scratch((bk, d)), _scratch((bk, d))],
         interpret=_interpret(),
     )(qp, kp, vp, gp, lse_p, delta_p)
 

@@ -130,20 +130,19 @@ def _ulysses_local(q, k, v, axis_name: str, causal: bool):
     q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    from ..ops.pallas_kernels import flash_attention, pallas_enabled
-    if pallas_enabled():
+    from ..ops import pallas_kernels as pk
+    if pk.pallas_enabled() and pk.pltpu is not None:
         # fused online-softmax kernel: O(seq) memory for the local dense
-        # attention after the head scatter
-        out = flash_attention(q, k, v, causal=causal)
-        out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
-                             tiled=True)
-        return out
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    mask = None
-    if causal:
-        s = q.shape[1]
-        mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
-    out = _local_attention(q, k, v, scale, mask)
+        # attention after the head scatter (dense fallback when the TPU
+        # pallas memory spaces aren't importable)
+        out = pk.flash_attention(q, k, v, causal=causal)
+    else:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        mask = None
+        if causal:
+            s = q.shape[1]
+            mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+        out = _local_attention(q, k, v, scale, mask)
     # (b, s, h/n, d) -> (b, s/n, h, d)
     out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                          tiled=True)
@@ -157,21 +156,19 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = 'data',
     if q.shape[2] % mesh.shape[axis_name]:
         raise ValueError('ulysses: heads must divide the mesh axis')
     spec = P(None, axis_name, None, None)
-    kwargs = {}
+    local = functools.partial(_ulysses_local, axis_name=axis_name,
+                              causal=causal)
+    wrap = functools.partial(shard_map, local, mesh=mesh,
+                             in_specs=(spec, spec, spec), out_specs=spec)
     from ..ops.pallas_kernels import pallas_enabled
-    if pallas_enabled():
-        # pallas_call in interpret mode doesn't propagate varying-manual-
-        # axes yet (jax suggests this workaround in its error message)
-        kwargs = {'check_vma': False}
-    try:
-        fn = shard_map(
-            functools.partial(_ulysses_local, axis_name=axis_name,
-                              causal=causal),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kwargs)
-    except TypeError:               # older jax: check_rep spelling
-        fn = shard_map(
-            functools.partial(_ulysses_local, axis_name=axis_name,
-                              causal=causal),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            **({'check_rep': False} if kwargs else {}))
+    if not pallas_enabled():
+        fn = wrap()
+    else:
+        # pallas_call doesn't propagate varying-manual-axes through its
+        # interpreter yet; jax's own error message prescribes disabling the
+        # replication check (check_rep on older jax spellings)
+        try:
+            fn = wrap(check_vma=False)
+        except TypeError:
+            fn = wrap(check_rep=False)
     return fn(q, k, v)

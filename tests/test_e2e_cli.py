@@ -1,0 +1,224 @@
+"""End-to-end CLI drives (the reference's example-configs-as-tests idea,
+SURVEY §4.4): full subprocess runs of ``python -m cxxnet_tpu.main``."""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from PIL import Image
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(conf_path, cwd, *overrides, timeout=240):
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    r = subprocess.run(
+        [sys.executable, '-m', 'cxxnet_tpu.main', conf_path, *overrides],
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    return r
+
+
+def _final_eval(stderr: str, name: str) -> float:
+    vals = re.findall(rf'{name}-error:([0-9.eE+-]+)', stderr)
+    assert vals, stderr
+    return float(vals[-1])
+
+
+def make_quadrant_images(root, n, size=24, fmt='png'):
+    rng = np.random.RandomState(0)
+    lines = []
+    for i in range(n):
+        c = i % 4
+        img = np.zeros((size, size, 3), np.uint8)
+        r0, c0 = (c // 2) * (size // 2), (c % 2) * (size // 2)
+        img[r0:r0 + size // 2, c0:c0 + size // 2] = \
+            rng.randint(120, 255, (size // 2, size // 2, 3))
+        Image.fromarray(img).save(os.path.join(root, f'im{i}.{fmt}'))
+        lines.append(f'{i}\t{c}\tim{i}.{fmt}')
+    lst = os.path.join(root, 'train.lst')
+    with open(lst, 'w') as f:
+        f.write('\n'.join(lines) + '\n')
+    return lst
+
+
+def test_cli_imgbin_conv_train(tmp_path):
+    """Native im2bin pack -> imgbin + threadbuffer -> conv net -> pred."""
+    make_quadrant_images(str(tmp_path), 32)
+    tool = os.path.join(REPO, 'runtime', 'im2bin')
+    if not os.path.exists(tool):
+        tool = [sys.executable, os.path.join(REPO, 'tools', 'im2bin.py')]
+    else:
+        tool = [tool]
+    subprocess.check_call(tool + ['train.lst', '.', 'train.bin'],
+                          cwd=str(tmp_path))
+    conf = tmp_path / 'conv.conf'
+    conf.write_text("""
+data = train
+iter = imgbin
+  image_list = train.lst
+  image_bin = train.bin
+  shuffle = 1
+iter = threadbuffer
+iter = end
+eval = trainset
+iter = imgbin
+  image_list = train.lst
+  image_bin = train.bin
+iter = end
+netconfig = start
+layer[0->1] = conv:c1
+  nchannel = 8
+  kernel_size = 5
+  stride = 2
+layer[1->2] = relu
+layer[2->3] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[3->4] = flatten
+layer[4->5] = fullc:f1
+  nhidden = 4
+layer[5->5] = softmax
+netconfig = end
+input_shape = 3,24,24
+batch_size = 8
+dev = cpu
+eta = 0.01
+momentum = 0.9
+num_round = 3
+metric[label] = error
+divideby = 256
+""")
+    r = _run_cli(str(conf), str(tmp_path))
+    assert _final_eval(r.stderr, 'trainset') < 0.2
+    # pred task against the saved model
+    pred_conf = tmp_path / 'pred.conf'
+    pred_conf.write_text(conf.read_text().replace('data = train', 'pred = out.txt', 1)
+                         + '\ntask = pred\nmodel_in = ./models/0003.model\n')
+    _run_cli(str(pred_conf), str(tmp_path))
+    preds = np.loadtxt(tmp_path / 'out.txt')
+    labels = np.arange(32) % 4
+    assert (preds == labels).mean() > 0.8
+
+
+def test_cli_augmented_training(tmp_path):
+    """kaggle_bowl-style heavy augmentation (rotate/shear/crop/mirror)
+    through the img iterator — the run must parse, augment, and learn."""
+    make_quadrant_images(str(tmp_path), 24, size=32)
+    conf = tmp_path / 'aug.conf'
+    conf.write_text("""
+data = train
+iter = img
+  image_list = train.lst
+  image_root = .
+  shuffle = 1
+  rand_crop = 1
+  rand_mirror = 1
+  max_rotate_angle = 15
+  max_shear_ratio = 0.1
+  min_crop_size = 24
+  max_crop_size = 28
+iter = end
+eval = trainset
+iter = img
+  image_list = train.lst
+  image_root = .
+iter = end
+netconfig = start
+layer[0->1] = conv:c1
+  nchannel = 6
+  kernel_size = 5
+  stride = 2
+layer[1->2] = relu
+layer[2->3] = flatten
+layer[3->4] = fullc:f1
+  nhidden = 4
+layer[4->4] = softmax
+netconfig = end
+input_shape = 3,24,24
+batch_size = 8
+dev = cpu
+eta = 0.02
+momentum = 0.9
+num_round = 4
+metric[label] = error
+divideby = 256
+""")
+    r = _run_cli(str(conf), str(tmp_path))
+    assert _final_eval(r.stderr, 'trainset') < 0.3
+
+
+@pytest.mark.slow
+def test_two_worker_distributed_launch(tmp_path):
+    """2-process jax.distributed data-parallel run via the launcher
+    (the reference's mpi.conf 2-worker topology, SURVEY §4.4)."""
+    import gzip
+    import struct
+    rng = np.random.RandomState(0)
+
+    def blobs(n):
+        y = rng.randint(0, 4, n)
+        x = np.zeros((n, 28, 28), np.uint8)
+        for i, c in enumerate(y):
+            r0, c0 = (c // 2) * 14, (c % 2) * 14
+            x[i, r0:r0 + 14, c0:c0 + 14] = rng.randint(128, 255, (14, 14))
+        return x, y
+
+    for tag, cnt in (('train', 800), ('t10k', 200)):
+        x, y = blobs(cnt)
+        with gzip.open(tmp_path / f'{tag}-images.gz', 'wb') as f:
+            f.write(struct.pack('>iiii', 2051, cnt, 28, 28))
+            f.write(x.tobytes())
+        with gzip.open(tmp_path / f'{tag}-labels.gz', 'wb') as f:
+            f.write(struct.pack('>ii', 2049, cnt))
+            f.write(y.astype(np.uint8).tobytes())
+    (tmp_path / 'mlp.conf').write_text("""
+data = train
+iter = mnist
+  path_img = train-images.gz
+  path_label = train-labels.gz
+  shuffle = 1
+iter = end
+eval = test
+iter = mnist
+  path_img = t10k-images.gz
+  path_label = t10k-labels.gz
+iter = end
+netconfig = start
+layer[0->1] = fullc:fc1
+  nhidden = 32
+layer[1->2] = sigmoid
+layer[2->3] = fullc:fc2
+  nhidden = 4
+layer[3->3] = softmax
+netconfig = end
+input_shape = 1,1,784
+batch_size = 100
+input_flat = 1
+dev = cpu
+eta = 0.1
+momentum = 0.9
+num_round = 2
+metric[label] = error
+""")
+    import socket
+    with socket.socket() as s:       # grab a free coordinator port
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    (tmp_path / 'dist.conf').write_text(
+        'num_workers = 2\napp_conf = mlp.conf\n'
+        f'coordinator = 127.0.0.1:{port}\n'
+        'arg = param_server=dist silent=1\n')
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'launch_dist.py'),
+         str(tmp_path / 'dist.conf')],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert _final_eval(r.stderr, 'test') < 0.1
