@@ -25,6 +25,7 @@ import numpy as np
 
 BASELINE_IMAGES_PER_SEC = 500.0          # AlexNet stand-in (see docstring)
 BASELINE_INCEPTION_IMAGES_PER_SEC = 130.0  # Inception-BN stand-in, same era
+BASELINE_GOOGLENET_IMAGES_PER_SEC = 150.0  # GoogLeNet v1 stand-in, same era
 BASELINE_MNIST_TTA_SEC = 30.0            # reference MNIST.conf CPU run
 
 
@@ -118,6 +119,29 @@ compute_type = bfloat16
                        BASELINE_INCEPTION_IMAGES_PER_SEC, last_key=str(last))
 
 
+def bench_googlenet() -> int:
+    from cxxnet_tpu.models import googlenet_conf
+    from cxxnet_tpu.nnet.net_config import NetConfig
+    from cxxnet_tpu.utils.config import parse_config_string
+    batch_size = 128
+    conf = googlenet_conf() + f"""
+batch_size = {batch_size}
+eta = 0.01
+momentum = 0.9
+metric = error
+eval_train = 0
+random_type = xavier
+compute_type = bfloat16
+"""
+    cfg = NetConfig()
+    cfg.configure(parse_config_string(conf))
+    name_to_idx = {e.name: i for i, e in enumerate(cfg.layers) if e.name}
+    return _throughput(conf, batch_size, (3, 224, 224),
+                       'googlenet_images_per_sec_per_chip',
+                       BASELINE_GOOGLENET_IMAGES_PER_SEC,
+                       last_key=str(name_to_idx['loss3_fc']))
+
+
 def bench_mnist_tta() -> int:
     """Time to 2% test error on synthetic-free real MNIST shapes is not
     possible offline; use the standard quadrant-blob surrogate (same
@@ -170,6 +194,7 @@ eval_train = 0
 def main() -> int:
     modes = {'alexnet': bench_alexnet,
              'inception_bn': bench_inception_bn,
+             'googlenet': bench_googlenet,
              'mnist_tta': bench_mnist_tta}
     mode = sys.argv[1] if len(sys.argv) > 1 else 'alexnet'
     if mode not in modes:

@@ -7,9 +7,12 @@ reference acceptance configs (BASELINE.md):
 * ``lenet_conf``  — example/MNIST/MNIST_CONV.conf conv net
 * ``alexnet_conf``— example/ImageNet/ImageNet.conf single-tower AlexNet
   (grouped convs, LRN, dropout)
+* ``googlenet_conf`` — original GoogLeNet (inception v1, LRN + two
+  grad_scale=0.3 auxiliary softmax heads -> multi-loss training graphs)
 * ``inception_bn_conf`` — GoogLeNet-family Inception with BatchNorm (the
   reference has no in-tree conf; built from its conv/ch_concat/batch_norm
   layers following the cxxnet-era model-zoo Inception-BN arrangement)
 """
 
-from .builders import alexnet_conf, inception_bn_conf, lenet_conf, mlp_conf
+from .builders import (alexnet_conf, googlenet_conf, inception_bn_conf,
+                       lenet_conf, mlp_conf)
