@@ -183,14 +183,10 @@ def inception_bn_conf(num_class: int = 1000) -> str:
     BN-Inception arrangement, cxxnet-era model zoo)."""
     lines = ['netconfig=start']
     top = _conv_bn_relu(lines, '0', 'conv1', 'conv1', 64, 7, stride=2, pad=3)
-    lines.append(f'layer[{top}->pool1] = max_pooling')
-    lines.append('  kernel_size = 3')
-    lines.append('  stride = 2')
+    _pool(lines, top, 'pool1', 'max_pooling', 3, 2)
     top = _conv_bn_relu(lines, 'pool1', 'conv2r', 'conv2r', 64, 1)
     top = _conv_bn_relu(lines, top, 'conv2', 'conv2', 192, 3, pad=1)
-    lines.append(f'layer[{top}->pool2] = max_pooling')
-    lines.append('  kernel_size = 3')
-    lines.append('  stride = 2')
+    _pool(lines, top, 'pool2', 'max_pooling', 3, 2)
     top = 'pool2'
     top = _inception(lines, top, 'in3a', 64, 64, 64, 64, 96, 32)
     top = _inception(lines, top, 'in3b', 64, 64, 96, 64, 96, 64)
@@ -217,8 +213,9 @@ def inception_bn_conf(num_class: int = 1000) -> str:
     return '\n'.join(lines) + '\n'
 
 
-def _conv_relu(lines, src, dst, name, nch, ksize, stride=1, pad=0):
-    lines.append(f'layer[{src}->{dst}] = conv:{name}')
+def _conv_relu(lines, src, dst, nch, ksize, stride=1, pad=0):
+    """conv:{dst} + relu; the layer is named after its output node."""
+    lines.append(f'layer[{src}->{dst}] = conv:{dst}')
     lines.append(f'  nchannel = {nch}')
     lines.append(f'  kernel_size = {ksize}')
     if stride != 1:
@@ -229,23 +226,28 @@ def _conv_relu(lines, src, dst, name, nch, ksize, stride=1, pad=0):
     return dst
 
 
+def _pool(lines, src, dst, kind, ksize, stride, pad=0):
+    lines.append(f'layer[{src}->{dst}] = {kind}')
+    lines.append(f'  kernel_size = {ksize}')
+    lines.append(f'  stride = {stride}')
+    if pad:
+        lines.append(f'  pad = {pad}')
+    return dst
+
+
 def _inception_v1(lines, src, prefix, n1, n3r, n3, n5r, n5, proj):
     """Original GoogLeNet inception module: 1x1 / 3x3 / 5x5 / pool-proj
     branches, channel-concatenated (4 inputs, the reference ch_concat
     maximum)."""
-    b1 = _conv_relu(lines, src, f'{prefix}_1x1', f'{prefix}_1x1', n1, 1)
-    b3r = _conv_relu(lines, src, f'{prefix}_3x3r', f'{prefix}_3x3r', n3r, 1)
-    b3 = _conv_relu(lines, b3r, f'{prefix}_3x3', f'{prefix}_3x3', n3, 3,
+    b1 = _conv_relu(lines, src, f'{prefix}_1x1', n1, 1)
+    b3r = _conv_relu(lines, src, f'{prefix}_3x3r', n3r, 1)
+    b3 = _conv_relu(lines, b3r, f'{prefix}_3x3', n3, 3,
                     pad=1)
-    b5r = _conv_relu(lines, src, f'{prefix}_5x5r', f'{prefix}_5x5r', n5r, 1)
-    b5 = _conv_relu(lines, b5r, f'{prefix}_5x5', f'{prefix}_5x5', n5, 5,
+    b5r = _conv_relu(lines, src, f'{prefix}_5x5r', n5r, 1)
+    b5 = _conv_relu(lines, b5r, f'{prefix}_5x5', n5, 5,
                     pad=2)
-    bp = f'{prefix}_pool'
-    lines.append(f'layer[{src}->{bp}] = max_pooling')
-    lines.append('  kernel_size = 3')
-    lines.append('  stride = 1')
-    lines.append('  pad = 1')
-    bpp = _conv_relu(lines, bp, f'{prefix}_proj', f'{prefix}_proj', proj, 1)
+    bp = _pool(lines, src, f'{prefix}_pool', 'max_pooling', 3, 1, pad=1)
+    bpp = _conv_relu(lines, bp, f'{prefix}_proj', proj, 1)
     dst = f'{prefix}_out'
     lines.append(f'layer[{b1},{b3},{b5},{bpp}->{dst}] = ch_concat')
     return dst
@@ -255,11 +257,8 @@ def _aux_head(lines, src, prefix, num_class):
     """GoogLeNet auxiliary classifier: avgpool5/3 -> 1x1 conv -> fc1024 ->
     dropout 0.7 -> fc -> softmax with grad_scale 0.3 (training-time
     regularizer; its loss adds to the main softmax's)."""
-    lines.append(f'layer[{src}->{prefix}_pool] = avg_pooling')
-    lines.append('  kernel_size = 5')
-    lines.append('  stride = 3')
-    top = _conv_relu(lines, f'{prefix}_pool', f'{prefix}_conv',
-                     f'{prefix}_conv', 128, 1)
+    _pool(lines, src, f'{prefix}_pool', 'avg_pooling', 5, 3)
+    top = _conv_relu(lines, f'{prefix}_pool', f'{prefix}_conv', 128, 1)
     lines.append(f'layer[{top}->{prefix}_flat] = flatten')
     lines.append(f'layer[{prefix}_flat->{prefix}_fc1] = fullc:{prefix}_fc1')
     lines.append('  nhidden = 1024')
@@ -277,24 +276,18 @@ def googlenet_conf(num_class: int = 1000, aux_heads: bool = True) -> str:
     auxiliary softmax classifiers (grad_scale 0.3) feeding the summed
     training loss — exercising the framework's multi-loss graphs."""
     lines = ['netconfig=start']
-    top = _conv_relu(lines, '0', 'conv1', 'conv1', 64, 7, stride=2, pad=3)
-    lines.append(f'layer[{top}->pool1] = max_pooling')
-    lines.append('  kernel_size = 3')
-    lines.append('  stride = 2')
+    top = _conv_relu(lines, '0', 'conv1', 64, 7, stride=2, pad=3)
+    _pool(lines, top, 'pool1', 'max_pooling', 3, 2)
     lines.append('layer[pool1->pool1] = lrn')
     lines.append('  local_size = 5')
-    top = _conv_relu(lines, 'pool1', 'conv2r', 'conv2r', 64, 1)
-    top = _conv_relu(lines, top, 'conv2', 'conv2', 192, 3, pad=1)
+    top = _conv_relu(lines, 'pool1', 'conv2r', 64, 1)
+    top = _conv_relu(lines, top, 'conv2', 192, 3, pad=1)
     lines.append(f'layer[{top}->{top}] = lrn')
     lines.append('  local_size = 5')
-    lines.append(f'layer[{top}->pool2] = max_pooling')
-    lines.append('  kernel_size = 3')
-    lines.append('  stride = 2')
+    _pool(lines, top, 'pool2', 'max_pooling', 3, 2)
     top = _inception_v1(lines, 'pool2', 'in3a', 64, 96, 128, 16, 32, 32)
     top = _inception_v1(lines, top, 'in3b', 128, 128, 192, 32, 96, 64)
-    lines.append(f'layer[{top}->pool3] = max_pooling')
-    lines.append('  kernel_size = 3')
-    lines.append('  stride = 2')
+    _pool(lines, top, 'pool3', 'max_pooling', 3, 2)
     top = _inception_v1(lines, 'pool3', 'in4a', 192, 96, 208, 16, 48, 64)
     if aux_heads:
         _aux_head(lines, top, 'aux1', num_class)
@@ -304,14 +297,10 @@ def googlenet_conf(num_class: int = 1000, aux_heads: bool = True) -> str:
     if aux_heads:
         _aux_head(lines, top, 'aux2', num_class)
     top = _inception_v1(lines, top, 'in4e', 256, 160, 320, 32, 128, 128)
-    lines.append(f'layer[{top}->pool4] = max_pooling')
-    lines.append('  kernel_size = 3')
-    lines.append('  stride = 2')
+    _pool(lines, top, 'pool4', 'max_pooling', 3, 2)
     top = _inception_v1(lines, 'pool4', 'in5a', 256, 160, 320, 32, 128, 128)
     top = _inception_v1(lines, top, 'in5b', 384, 192, 384, 48, 128, 128)
-    lines.append(f'layer[{top}->gpool] = avg_pooling')
-    lines.append('  kernel_size = 7')
-    lines.append('  stride = 1')
+    _pool(lines, top, 'gpool', 'avg_pooling', 7, 1)
     lines.append('layer[gpool->gpool_flat] = flatten')
     lines.append('layer[gpool_flat->gpool_flat] = dropout')
     lines.append('  threshold = 0.4')

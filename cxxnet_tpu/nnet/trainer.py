@@ -129,7 +129,11 @@ class NetTrainer:
             if name == 'metric':
                 field, node = 'label', ''
             else:
-                inner = name[len('metric['):].rstrip(']')
+                # strip exactly one outer bracket: the node part may itself
+                # end in one (metric[extra,top[-1]])
+                inner = name[len('metric['):]
+                if inner.endswith(']'):
+                    inner = inner[:-1]
                 field, _, node = inner.partition(',')
             self.metric.add_metric(val, field)
             self.train_metric.add_metric(val, field)

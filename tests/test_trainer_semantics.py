@@ -1,0 +1,129 @@
+"""Trainer semantics: LR schedules, multi-label graphs, extract, rec@n."""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.updater.updaters import UpdaterHyper
+from cxxnet_tpu.utils.config import parse_config_string
+from cxxnet_tpu.utils.metric import create_metric
+
+
+def _hyper(**params):
+    h = UpdaterHyper()
+    for k, v in params.items():
+        h.set_param(k, str(v))
+    return h
+
+
+class TestSchedules:
+    """Closed-form checks of ``ScheduleEpoch`` (reference param.h:76-94)."""
+
+    def test_expdecay(self):
+        h = _hyper(eta=0.1, **{'lr:schedule': 'expdecay', 'lr:gamma': 0.5,
+                               'lr:step': 100})
+        lr, _ = h.schedule(200)
+        assert np.isclose(float(lr), 0.1 * 0.5 ** 2.0)
+        lr, _ = h.schedule(50)       # fractional exponent (continuous decay)
+        assert np.isclose(float(lr), 0.1 * 0.5 ** 0.5)
+
+    def test_polydecay(self):
+        h = _hyper(eta=0.1, **{'lr:schedule': 'polydecay', 'lr:gamma': 2.0,
+                               'lr:alpha': 0.5, 'lr:step': 10})
+        lr, _ = h.schedule(35)       # floor(35/10)=3 -> (1+3*2)^-0.5
+        assert np.isclose(float(lr), 0.1 * (1 + 3 * 2.0) ** -0.5)
+
+    def test_factor_with_minimum(self):
+        h = _hyper(eta=0.1, **{'lr:schedule': 'factor', 'lr:factor': 0.1,
+                               'lr:step': 10, 'lr:minimum_lr': 5e-4})
+        assert np.isclose(float(h.schedule(0)[0]), 0.1)
+        assert np.isclose(float(h.schedule(25)[0]), 0.1 * 0.01)
+        assert np.isclose(float(h.schedule(99)[0]), 5e-4)   # clamped
+
+    def test_tag_scoped_override(self):
+        from cxxnet_tpu.updater.updaters import create_updater_hyper
+        defcfg = [('eta', '0.1'), ('wd', '0.001'), ('bias:wd', '0.0')]
+        wmat = create_updater_hyper('sgd', 'wmat', defcfg, [])
+        bias = create_updater_hyper('sgd', 'bias', defcfg, [])
+        assert wmat.wd == pytest.approx(0.001)
+        assert bias.wd == pytest.approx(0.0)
+
+
+MULTILABEL_CONF = """
+netconfig = start
+layer[0->1] = fullc:fc1
+  nhidden = 16
+layer[1->2] = sigmoid
+layer[2->cls_out] = fullc:cls
+  nhidden = 4
+layer[cls_out->cls_out] = softmax
+layer[2->reg_out] = fullc:reg
+  nhidden = 2
+layer[reg_out->reg_out] = l2_loss
+  target = extra
+netconfig = end
+input_shape = 1,1,8
+batch_size = 16
+input_flat = 1
+dev = cpu
+eta = 0.1
+momentum = 0.9
+label_vec[0,1) = label
+label_vec[1,3) = extra
+metric[label,cls_out] = error
+metric[extra,reg_out] = rmse
+"""
+
+
+def _multilabel_batch(rng, n=16):
+    x = rng.rand(n, 1, 1, 8).astype(np.float32)
+    cls = rng.randint(0, 4, (n, 1)).astype(np.float32)
+    reg = (x.reshape(n, 8)[:, :2] * 2.0).astype(np.float32)
+    return DataBatch(x, np.concatenate([cls, reg], axis=1))
+
+
+def test_multilabel_two_heads_train():
+    """label_vec splits the label matrix into named fields consumed by
+    different loss heads (softmax on 'label', l2 on 'extra'); metrics are
+    per-field (``nnet_impl:271-285``, ``metric.h:175-236``)."""
+    rng = np.random.RandomState(0)
+    tr = NetTrainer(parse_config_string(MULTILABEL_CONF))
+    tr.init_model()
+    batches = [_multilabel_batch(rng) for _ in range(20)]
+    first = None
+    for r in range(8):
+        tr.start_round(r)
+        for b in batches:
+            tr.update(b)
+        res = tr.evaluate(iter(batches[:5]), 'v')
+        rmse = float(res.split('v-rmse[extra]:')[-1])
+        err = float(res.split('v-error:')[-1].split('\t')[0])
+        if first is None:
+            first = (err, rmse)
+    assert rmse < first[1], 'regression head did not improve'
+    assert err <= first[0], 'classification head did not improve'
+
+
+def test_extract_topk_and_named_node():
+    rng = np.random.RandomState(0)
+    tr = NetTrainer(parse_config_string(MULTILABEL_CONF))
+    tr.init_model()
+    b = _multilabel_batch(rng)
+    feat = tr.extract_feature(b, 'top[-1]')      # final node (reg head)
+    assert feat.shape[-1] == 2
+    named = tr.extract_feature(b, 'cls_out')      # named node
+    assert named.shape[-1] == 4
+    hidden = tr.extract_feature(b, '2')           # node named by index
+    assert hidden.reshape(16, -1).shape == (16, 16)
+
+
+def test_rec_at_n():
+    m = create_metric('rec@2')
+    pred = np.array([[0.1, 0.5, 0.4], [0.9, 0.05, 0.6]])
+    label = np.array([[2.0], [1.0]])      # top2 = {1,2} hit; {0,2} miss
+    m.add_eval(pred, label)
+    assert m.get() == pytest.approx(0.5)
+    with pytest.raises(ValueError):
+        bad = create_metric('rec@5')
+        bad.add_eval(pred, label)
