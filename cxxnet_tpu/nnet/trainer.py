@@ -77,6 +77,7 @@ class NetTrainer:
         self.max_round = 1
         self.tensor_parallel = 1
         self.test_on_server = 0
+        self.nan_action = 'none'
         self.compute_dtype = jnp.float32
         self.devices: List[int] = []
         self.metric = MetricSet()
@@ -113,6 +114,10 @@ class NetTrainer:
             self.tensor_parallel = int(val)
         if name == 'test_on_server':
             self.test_on_server = int(val)
+        if name == 'nan_action':
+            if val not in ('none', 'skip'):
+                raise ValueError(f'nan_action must be none|skip, got {val}')
+            self.nan_action = val
         if name == 'use_pallas':
             # process-wide switch read by ops.pallas_kernels.pallas_enabled
             os.environ['CXXNET_PALLAS'] = val
@@ -228,11 +233,20 @@ class NetTrainer:
                                        extra_data=extra)
             return loss, [values[i] for i in eval_ids]
 
+        nan_skip = self.nan_action == 'skip'
+
         @partial(jax.jit, static_argnames=('do_update',), donate_argnums=(0, 1, 2))
         def train_step(params, opt_state, grad_acc, data, label, extra, rng,
                        epoch, rnd, do_update):
             (loss, evals), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, data, label, extra, rng, rnd)
+            if nan_skip:
+                # failure detection beyond the reference's NaN-zeroing clip
+                # (sgd_updater-inl.hpp:15-22): a non-finite loss poisons the
+                # whole gradient; drop this batch's contribution entirely
+                ok = jnp.isfinite(loss)
+                grads = jax.tree.map(
+                    lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads)
             grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
             if do_update:
                 params, opt_state = apply_updates(
@@ -302,12 +316,16 @@ class NetTrainer:
                                 self.epoch_counter, self.round,
                                 do_update=do_update)
         if self.eval_train and len(self.train_metric):
-            label_info = _HostLabelInfo(np.asarray(batch.label),
-                                        self.net_cfg.label_name_map,
-                                        self.net_cfg.label_range)
-            n = batch.batch_size - batch.num_batch_padd
-            self.train_metric.add_eval(
-                [np.asarray(e)[:n] for e in evals], label_info.slice(n))
+            if self.nan_action == 'skip' and not np.isfinite(float(loss)):
+                pass    # poisoned batch: its NaN outputs would wreck the
+                        # round's train metrics along with the weights
+            else:
+                label_info = _HostLabelInfo(np.asarray(batch.label),
+                                            self.net_cfg.label_name_map,
+                                            self.net_cfg.label_range)
+                n = batch.batch_size - batch.num_batch_padd
+                self.train_metric.add_eval(
+                    [np.asarray(e)[:n] for e in evals], label_info.slice(n))
         if do_update:
             self.epoch_counter += 1
         self.sample_counter += 1
