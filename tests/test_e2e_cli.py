@@ -222,3 +222,18 @@ metric[label] = error
         capture_output=True, text=True, timeout=300, env=env)
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert _final_eval(r.stderr, 'test') < 0.1
+
+
+def test_transformer_example_runs(tmp_path):
+    """The composed-parallelism LM example must run (and reduce loss) on
+    the virtual CPU mesh."""
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'example', 'transformer',
+                                      'train_lm.py'),
+         '--steps', '6', '--seq', '32', '--batch', '4'],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    losses = re.findall(r'loss ([0-9.]+)', r.stdout)
+    assert len(losses) >= 2 and float(losses[-1]) < float(losses[0])
