@@ -3,11 +3,23 @@
 
 Default (what the driver runs) — AlexNet batch 256, prints ONE JSON line:
   {"metric": "alexnet_images_per_sec_per_chip", "value": N,
-   "unit": "images/sec", "vs_baseline": N}
+   "unit": "images/sec", "vs_baseline": N, "mfu": F, "tflops": T}
 
 Extra modes for the BASELINE.md ledger (same JSON shape):
   python bench.py inception_bn     # Inception-BN batch 128 throughput
-  python bench.py mnist_tta        # MNIST MLP time-to-2%-test-error (sec)
+  python bench.py googlenet        # GoogLeNet v1 batch 128 throughput
+  python bench.py mnist_tta        # MNIST conv time-to-2%-test-error (sec)
+
+Robustness: the axon tunnel that fronts the TPU chip can wedge or report
+UNAVAILABLE transiently (it recovers by waiting).  Before importing jax in
+this process we probe the backend in short-lived subprocesses with
+exponential backoff (budget: $CXXNET_BENCH_BACKEND_WAIT sec, default 900).
+On permanent failure the output is still ONE structured JSON line with an
+"error" field — never a bare traceback.
+
+MFU: flops per optimizer step come from the compiled executable's own
+cost analysis (trainer.train_step_flops); peak chip flops from the device
+kind (override with $CXXNET_PEAK_TFLOPS).
 
 Baseline: the reference repo publishes no numbers (BASELINE.md).  We use
 500 images/sec as the stand-in for cxxnet-CUDA AlexNet on a 2015-era
@@ -17,7 +29,11 @@ ledger) until a measured reference figure exists.
 
 from __future__ import annotations
 
+import gzip
 import json
+import os
+import struct
+import subprocess
 import sys
 import time
 
@@ -27,6 +43,71 @@ BASELINE_IMAGES_PER_SEC = 500.0          # AlexNet stand-in (see docstring)
 BASELINE_INCEPTION_IMAGES_PER_SEC = 130.0  # Inception-BN stand-in, same era
 BASELINE_GOOGLENET_IMAGES_PER_SEC = 150.0  # GoogLeNet v1 stand-in, same era
 BASELINE_MNIST_TTA_SEC = 30.0            # reference MNIST.conf CPU run
+
+# bf16 peak TFLOP/s by TPU generation (marketing peak; MFU denominators)
+_PEAK_BF16_TFLOPS = (
+    ('v6', 918.0), ('v5p', 459.0), ('v5', 197.0), ('v4', 275.0),
+)
+
+
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj))
+
+
+class BackendUnavailable(RuntimeError):
+    pass
+
+
+def _ensure_backend() -> None:
+    """Wait out axon tunnel wedges: probe ``jax.devices()`` in fresh
+    subprocesses (a wedged probe hangs forever, so each gets a hard
+    timeout) with exponential backoff until the backend answers."""
+    plats = [p.strip() for p in
+             os.environ.get('JAX_PLATFORMS', '').split(',') if p.strip()]
+    if plats and all(p == 'cpu' for p in plats):
+        return                           # explicit CPU-only run: no wait
+    budget = float(os.environ.get('CXXNET_BENCH_BACKEND_WAIT', '900'))
+    deadline = time.time() + budget
+    delay, last_err = 10.0, ''
+    while True:
+        try:
+            r = subprocess.run(
+                [sys.executable, '-c',
+                 'import jax; d = jax.devices(); print(d[0].platform)'],
+                capture_output=True, text=True, timeout=180)
+            if r.returncode == 0:
+                plat = (r.stdout or '').strip().splitlines()[-1:]
+                if plat and plat[0] != 'cpu':
+                    return
+                # jax silently fell back to CPU: the accelerator is NOT
+                # up; a CPU number must never pass as per-chip throughput
+                last_err = 'jax fell back to CPU (accelerator plugin down)'
+            else:
+                tail = (r.stderr or '').strip().splitlines()
+                last_err = tail[-1] if tail else f'probe rc={r.returncode}'
+        except subprocess.TimeoutExpired:
+            last_err = 'backend probe hung >180s (tunnel wedge)'
+        if time.time() + delay > deadline:
+            raise BackendUnavailable(
+                f'TPU backend unavailable after {budget:.0f}s: {last_err}')
+        time.sleep(delay)
+        delay = min(delay * 1.7, 120.0)
+
+
+def _peak_flops() -> float:
+    """Peak bf16 FLOP/s of one chip, for the MFU denominator."""
+    env = os.environ.get('CXXNET_PEAK_TFLOPS')
+    if env:
+        return float(env) * 1e12
+    import jax
+    dev = jax.devices()[0]
+    if dev.platform == 'cpu':
+        return 0.0
+    kind = getattr(dev, 'device_kind', '').lower().replace(' ', '')
+    for key, tflops in _PEAK_BF16_TFLOPS:
+        if key in kind:
+            return tflops * 1e12
+    return 197e12                        # v5e-class default
 
 
 def _throughput(conf: str, batch_size: int, shape, metric: str,
@@ -43,7 +124,7 @@ def _throughput(conf: str, batch_size: int, shape, metric: str,
     # step (device-side cast/normalize + fwd + bwd + optimizer) per chip.
     # The dev-harness host link (a ~26MB/s tunnel to the remote chip) is
     # excluded — in production the input pipeline double-buffers H2D behind
-    # compute (utils/thread_buffer + update_on_device).
+    # compute (utils/thread_buffer + trainer.update's async staging).
     rng = np.random.RandomState(0)
     dev_batches = []
     for i in range(4):
@@ -57,6 +138,7 @@ def _throughput(conf: str, batch_size: int, shape, metric: str,
     for i in range(3):
         trainer.update_on_device(*dev_batches[i % 4])
     jax.device_get(trainer.params[last_key]['bias'])
+    step_flops = trainer.train_step_flops(*dev_batches[0])
 
     steps = 30
     t0 = time.perf_counter()
@@ -67,12 +149,17 @@ def _throughput(conf: str, batch_size: int, shape, metric: str,
     dt = time.perf_counter() - t0
 
     ips = steps * batch_size / dt
-    print(json.dumps({
+    achieved = step_flops * steps / dt
+    peak = _peak_flops()
+    measured = step_flops > 0            # 0 = backend has no cost model
+    _emit({
         'metric': metric,
         'value': round(ips, 1),
         'unit': 'images/sec',
         'vs_baseline': round(ips / baseline, 3),
-    }))
+        'tflops': round(achieved / 1e12, 2) if measured else None,
+        'mfu': round(achieved / peak, 4) if measured and peak else None,
+    })
     return 0
 
 
@@ -142,11 +229,155 @@ compute_type = bfloat16
                        last_key=str(name_to_idx['loss3_fc']))
 
 
+# --- MNIST time-to-accuracy ------------------------------------------------
+
+_MNIST_FILES = ('train-images-idx3-ubyte.gz', 'train-labels-idx1-ubyte.gz',
+                't10k-images-idx3-ubyte.gz', 't10k-labels-idx1-ubyte.gz')
+_MNIST_URL = 'https://storage.googleapis.com/cvdf-datasets/mnist/'
+
+
+def _read_idx(path: str) -> np.ndarray:
+    with gzip.open(path, 'rb') as f:
+        magic, = struct.unpack('>i', f.read(4))
+        ndim = magic & 0xff
+        dims = struct.unpack('>' + 'i' * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(dims)
+
+
+def _locate_mnist() -> str | None:
+    """Find (or fetch) REAL MNIST; None -> caller uses the surrogate."""
+    ddir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'example', 'MNIST', 'data')
+    def complete() -> bool:
+        try:
+            return all(os.path.exists(os.path.join(ddir, f))
+                       for f in _MNIST_FILES) and \
+                _read_idx(os.path.join(ddir, _MNIST_FILES[0])).shape[0] >= 60000
+        except Exception:
+            return False
+    if complete():
+        return ddir
+    os.makedirs(ddir, exist_ok=True)
+    try:
+        import urllib.request
+        for f in _MNIST_FILES:
+            dst = os.path.join(ddir, f)
+            if not os.path.exists(dst):
+                # bounded timeout (silent-drop egress filters would hang
+                # forever) + atomic rename (a truncated file would lock
+                # every later run into the surrogate path)
+                with urllib.request.urlopen(_MNIST_URL + f,
+                                            timeout=30) as r, \
+                        open(dst + '.part', 'wb') as w:
+                    while True:
+                        chunk = r.read(1 << 20)
+                        if not chunk:
+                            break
+                        w.write(chunk)
+                os.replace(dst + '.part', dst)
+        if complete():
+            return ddir
+    except Exception:
+        pass
+    return None
+
+
+_MNIST_CONV_NET = """
+netconfig=start
+layer[+1:cv1] = conv:cv1
+  kernel_size = 5
+  pad = 2
+  nchannel = 32
+layer[+1:ac1] = relu
+layer[+1:mp1] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[+1:cv2] = conv:cv2
+  kernel_size = 5
+  pad = 2
+  nchannel = 64
+layer[+1:ac2] = relu
+layer[+1:mp2] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[+1:fl] = flatten
+layer[+1:fc1] = fullc:fc1
+  nhidden = 256
+layer[+1:ac3] = relu
+layer[+1:fc2] = fullc:fc2
+  nhidden = 10
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,28,28
+batch_size = 100
+random_type = xavier
+eta = 0.05
+momentum = 0.9
+wd = 0.0
+metric = error
+eval_train = 0
+"""
+
+
 def bench_mnist_tta() -> int:
-    """Time to 2% test error on synthetic-free real MNIST shapes is not
-    possible offline; use the standard quadrant-blob surrogate (same
-    tensor shapes/batch as MNIST.conf) and report wall-clock to 2% eval
-    error including compile."""
+    """Wall-clock (incl. compile) to 2% test error on REAL MNIST with a
+    LeNet-style conv net, through the framework's own data+trainer path.
+    Falls back to the quadrant-blob surrogate (MNIST shapes, MLP) when the
+    real data is absent and cannot be fetched; the JSON says which ran."""
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    ddir = _locate_mnist()
+    if ddir is None:
+        return _mnist_tta_surrogate()
+
+    imgs = _read_idx(os.path.join(ddir, _MNIST_FILES[0]))
+    labels = _read_idx(os.path.join(ddir, _MNIST_FILES[1]))
+    timgs = _read_idx(os.path.join(ddir, _MNIST_FILES[2]))
+    tlabels = _read_idx(os.path.join(ddir, _MNIST_FILES[3]))
+
+    # normalize once, outside the timed loop; rounds only reshuffle indices
+    imgs_f = (imgs.astype(np.float32) / 255.0)[:, None]
+    labels_f = labels.astype(np.float32).reshape(-1, 1)
+    timgs_f = (timgs.astype(np.float32) / 255.0)[:, None]
+    tlabels_f = tlabels.astype(np.float32).reshape(-1, 1)
+
+    def batches(x, y, bs, rng=None):
+        idx = np.arange(len(x))
+        if rng is not None:
+            rng.shuffle(idx)
+        return [DataBatch(x[idx[i:i + bs]], y[idx[i:i + bs]])
+                for i in range(0, len(idx) - bs + 1, bs)]
+
+    trainer = NetTrainer(parse_config_string(_MNIST_CONV_NET))
+    trainer.init_model()
+    rng = np.random.RandomState(0)
+    test = batches(timgs_f, tlabels_f, 100)
+
+    t0 = time.perf_counter()
+    err, rounds = 1.0, 0
+    while err > 0.02 and rounds < 15:
+        trainer.start_round(rounds)
+        for b in batches(imgs_f, labels_f, 100, rng):
+            trainer.update(b)
+        res = trainer.evaluate(iter(test), 'test')
+        err = float(res.split(':')[-1])
+        rounds += 1
+    dt = time.perf_counter() - t0
+    _emit({
+        'metric': 'mnist_time_to_2pct_error',
+        'value': round(dt, 2),
+        'unit': 'sec',
+        'vs_baseline': round(BASELINE_MNIST_TTA_SEC / dt, 3),
+        'data': 'mnist',
+        'rounds': rounds,
+        'final_error': round(err, 4),
+    })
+    return 0 if err <= 0.02 else 1
+
+
+def _mnist_tta_surrogate() -> int:
     from cxxnet_tpu.io.data import DataBatch
     from cxxnet_tpu.nnet.trainer import NetTrainer
     from cxxnet_tpu.models import mlp_conf
@@ -182,26 +413,41 @@ eval_train = 0
         err = float(res.split(':')[-1])
         rounds += 1
     dt = time.perf_counter() - t0
-    print(json.dumps({
-        'metric': 'mnist_mlp_time_to_2pct_error',
+    _emit({
+        'metric': 'mnist_time_to_2pct_error',
         'value': round(dt, 2),
         'unit': 'sec',
         'vs_baseline': round(BASELINE_MNIST_TTA_SEC / dt, 3),
-    }))
+        'data': 'surrogate',
+        'rounds': rounds,
+        'final_error': round(err, 4),
+    })
     return 0 if err <= 0.02 else 1
 
 
+_MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
+          'inception_bn': ('inception_bn_images_per_sec_per_chip',
+                           bench_inception_bn),
+          'googlenet': ('googlenet_images_per_sec_per_chip',
+                        bench_googlenet),
+          'mnist_tta': ('mnist_time_to_2pct_error', bench_mnist_tta)}
+
+
 def main() -> int:
-    modes = {'alexnet': bench_alexnet,
-             'inception_bn': bench_inception_bn,
-             'googlenet': bench_googlenet,
-             'mnist_tta': bench_mnist_tta}
     mode = sys.argv[1] if len(sys.argv) > 1 else 'alexnet'
-    if mode not in modes:
+    if mode not in _MODES:
         print(f'unknown bench mode {mode!r}; choose from '
-              f'{sorted(modes)}', file=sys.stderr)
+              f'{sorted(_MODES)}', file=sys.stderr)
         return 2
-    return modes[mode]()
+    metric, fn = _MODES[mode]
+    try:
+        _ensure_backend()
+        return fn()
+    except BaseException as e:           # noqa: BLE001 — one JSON line, always
+        _emit({'metric': metric, 'value': None, 'unit': None,
+               'vs_baseline': None,
+               'error': f'{type(e).__name__}: {e}'})
+        return 1
 
 
 if __name__ == '__main__':

@@ -180,7 +180,8 @@ class Net:
             layer = self.layers[i]
             lctx = ForwardContext(is_train=ctx.is_train, rng=ctx.rng,
                                   layer_index=i, round=ctx.round,
-                                  max_round=ctx.max_round)
+                                  max_round=ctx.max_round,
+                                  compute_dtype=ctx.compute_dtype)
             lp = self._layer_params(params, i)
             ins = [values[j] for j in info.nindex_in]
             if isinstance(layer, LossLayerBase) and labels is not None:

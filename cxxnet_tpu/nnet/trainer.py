@@ -242,9 +242,12 @@ class NetTrainer:
                 loss_fn, has_aux=True)(params, data, label, extra, rng, rnd)
             if nan_skip:
                 # failure detection beyond the reference's NaN-zeroing clip
-                # (sgd_updater-inl.hpp:15-22): a non-finite loss poisons the
-                # whole gradient; drop this batch's contribution entirely
+                # (sgd_updater-inl.hpp:15-22): a non-finite loss — or a
+                # finite loss whose backward overflowed (0*inf etc.) —
+                # poisons the weights; drop this batch's contribution
                 ok = jnp.isfinite(loss)
+                for g in jax.tree.leaves(grads):
+                    ok &= jnp.all(jnp.isfinite(g))
                 grads = jax.tree.map(
                     lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads)
             grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
@@ -270,7 +273,9 @@ class NetTrainer:
         self.round = round_
         if self.test_on_server:
             bad = self.check_weight_consistency()
-            assert bad == 0, f'{bad} weight tensors diverged across replicas'
+            if bad:
+                raise RuntimeError(
+                    f'{bad} weight tensors diverged across replicas')
 
     def check_weight_consistency(self) -> int:
         """``test_on_server`` analog (``async_updater-inl.hpp:144-154``).
@@ -345,6 +350,23 @@ class NetTrainer:
         if do_update:
             self.epoch_counter += 1
         self.sample_counter += 1
+
+    def train_step_flops(self, data, label) -> float:
+        """HLO-estimated FLOPs of one full optimizer step (fwd + bwd +
+        update), from the compiled executable's cost analysis.  Used by
+        bench.py to report MFU; returns 0.0 when the backend exposes no
+        cost model."""
+        rng = jax.random.fold_in(self._rng, 0)
+        try:
+            lowered = self._train_step_fn.lower(
+                self.params, self.opt_state, self.grad_acc, data, label,
+                (), rng, self.epoch_counter, self.round, do_update=True)
+            cost = lowered.compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else None
+            return float(cost.get('flops', 0.0)) if cost else 0.0
+        except Exception:
+            return 0.0
 
     # --- evaluation / prediction ------------------------------------------
     def _forward_nodes(self, batch, node_ids: List[int]) -> List[np.ndarray]:

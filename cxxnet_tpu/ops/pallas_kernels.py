@@ -513,9 +513,18 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     Exact (online-softmax) attention; O(seq) memory — the score matrix
     stays in VMEM blocks.  Differentiable via blockwise Pallas backward
     kernels.  Oracle: ``parallel.sequence.attention_reference``.
+
+    ``causal=True`` uses TOP-LEFT mask alignment (position counted from
+    0 for both q and k), which only makes sense for ``sq == sk``; the
+    bottom-right (decode) convention is not implemented, so mismatched
+    lengths with ``causal`` are rejected.
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    if causal and sq != sk:
+        raise ValueError(
+            f'causal flash_attention requires q and k of equal length '
+            f'(top-left mask alignment); got sq={sq} sk={sk}')
 
     def to_bhsd(x, s):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, x.shape[-1])

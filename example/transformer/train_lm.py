@@ -51,8 +51,16 @@ def main() -> int:
                                                build_transformer_mesh,
                                                init_params, make_train_step)
 
+    if args.batch % args.dp:
+        ap.error(f'--batch {args.batch} must be divisible by --dp {args.dp}')
+    # GPipe microbatches must divide the per-data-rank batch; use the most
+    # the local batch allows, capped at the default of 4.  Stage count must
+    # equal the pipe axis size (each pipe rank owns exactly one stage).
+    local_batch = args.batch // args.dp
+    micro = max(m for m in (4, 3, 2, 1) if local_batch % m == 0)
     cfg = TransformerConfig(seq_len=args.seq, num_experts=args.experts,
-                            num_stages=max(args.pp, 2))
+                            num_stages=args.pp,
+                            num_microbatches=micro)
     mesh = build_transformer_mesh(n, args.pp, args.dp, args.sp, args.tp)
     print(f'mesh: {dict(mesh.shape)}  experts={args.experts}')
     params = init_params(np.random.RandomState(0), cfg)
