@@ -23,16 +23,22 @@ ConfigEntry = Tuple[str, str]
 class DataBatch:
     """One minibatch (``src/io/data.h:83-181``)."""
 
-    __slots__ = ('data', 'label', 'inst_index', 'num_batch_padd', 'extra_data')
+    __slots__ = ('data', 'label', 'inst_index', 'num_batch_padd',
+                 'pad_synthetic', 'extra_data')
 
     def __init__(self, data: np.ndarray, label: np.ndarray,
                  inst_index: Optional[np.ndarray] = None,
                  num_batch_padd: int = 0,
-                 extra_data: Optional[List[np.ndarray]] = None):
+                 extra_data: Optional[List[np.ndarray]] = None,
+                 pad_synthetic: bool = False):
         self.data = data                    # (b, c, y, x) float32
         self.label = label                  # (b, label_width) float32
         self.inst_index = inst_index        # (b,) uint32 or None
         self.num_batch_padd = num_batch_padd
+        # True when the padd rows are filler (round_batch=0 short tail) and
+        # must be masked out of gradients; False when they are real wrapped
+        # instances (round_batch=1) that the reference trains on
+        self.pad_synthetic = pad_synthetic
         self.extra_data = extra_data or []
 
     @property
@@ -130,6 +136,9 @@ def create_iterator(cfg: List[ConfigEntry]) -> IIterator:
                 if val == 'img':
                     from .iter_img import ImageIterator
                     src = ImageIterator()
+                elif val == 'imgbinx':
+                    from .iter_imbin import ImageBinXIterator
+                    src = ImageBinXIterator()
                 else:
                     from .iter_imbin import ImageBinIterator
                     src = ImageBinIterator()

@@ -77,3 +77,15 @@ class BatchAdaptIterator(IIterator):
                     raise RuntimeError('round_batch: source is empty')
             data, label, index = self._make_batch(buf + wrap)
             yield DataBatch(data, label, index, num_batch_padd=npadd)
+        elif buf:
+            # round_batch=0: emit the short final batch padded to full size
+            # with num_batch_padd = batch_size - top
+            # (iter_batch_proc-inl.hpp:101-103; the reference pads with stale
+            # rows of its reused buffer — here the last real instance is
+            # repeated, equally ignored downstream).  Consumers mask the pad
+            # rows out of grads/metrics/predictions; full-size batches keep
+            # jit shapes static on TPU.
+            npadd = bs - len(buf)
+            data, label, index = self._make_batch(buf + [buf[-1]] * npadd)
+            yield DataBatch(data, label, index, num_batch_padd=npadd,
+                            pad_synthetic=True)

@@ -1,19 +1,26 @@
-"""Binary-page image source (``src/io/iter_thread_imbin-inl.hpp:16-283``).
+"""Binary-page image sources (``imgbin`` and ``imgbinx``).
 
 Reads the reference's packed image format: a ``.bin`` stream of 64MB
 ``BinaryPage``s whose objects are encoded (JPEG/PNG) image blobs, paired
 record-for-record with a ``.lst`` file carrying ``index \\t labels...``.
-Features preserved:
 
+``imgbin`` (``src/io/iter_thread_imbin-inl.hpp:16-283``):
 * multi-part datasets via ``image_conf_prefix`` printf-style pattern +
   ``image_conf_ids = a-b`` (iter_thread_imbin:225-278),
 * distributed worker sharding: parts (or pages, for a single file) are
   round-robin split across workers by ``dist_num_worker`` /
   ``dist_worker_rank`` (``PS_RANK`` env respected, :189-220),
-* page-level shuffle (``shuffle=1``).
+* ``shuffle=1`` randomizes page order — pages are fixed 64MB records, so a
+  single ``.bin`` is random-access by page index (beyond the reference,
+  whose plain imgbin reads strictly sequentially and has no shuffle).
 
-Decode uses PIL; the page read-ahead runs behind a ThreadBuffer when the
-config wraps this source in ``iter = threadbuffer``.
+``imgbinx`` (``src/io/iter_thread_imbin_x-inl.hpp:18-397``): the two-stage
+pipeline — a page-loading stage behind a ThreadBuffer (page-order shuffle
+reseeded each epoch) feeding a decode stage behind a second, deeper
+ThreadBuffer that also randomizes instance order *within* each page; decode
+therefore overlaps page IO instead of serializing behind it.
+
+Decode uses native libjpeg when built, PIL otherwise.
 """
 
 from __future__ import annotations
@@ -24,8 +31,21 @@ import os
 import numpy as np
 
 from ..utils.io_stream import BinaryPage
+from ..utils.thread_buffer import ThreadBuffer
 from .data import DataInst, IIterator
 from .iter_img import parse_lst_line
+
+
+def scan_page_table(bin_path: str):
+    """Per-page object counts of a ``.bin`` file, read from the page
+    headers only (4 bytes at each 64MB boundary) — no payload IO."""
+    counts = []
+    size = os.path.getsize(bin_path)
+    with open(bin_path, 'rb') as f:
+        for off in range(0, size - BinaryPage.N_BYTES + 1, BinaryPage.N_BYTES):
+            f.seek(off)
+            counts.append(int.from_bytes(f.read(4), 'little'))
+    return counts
 
 
 class ImageBinIterator(IIterator):
@@ -82,8 +102,10 @@ class ImageBinIterator(IIterator):
             self._bins = [self.path_imgbin]
         self._single_shard = (nworker > 1 and not self.conf_prefix,
                               rank, nworker)
+        self._epoch = 0
+        self._tables: dict = {}
         if self.silent == 0:
-            print(f'ImageBinIterator: {len(self._bins)} part(s), '
+            print(f'{type(self).__name__}: {len(self._bins)} part(s), '
                   f'worker {rank}/{nworker}')
 
     def _iter_pages(self, bin_path):
@@ -113,26 +135,120 @@ class ImageBinIterator(IIterator):
                 arr = np.asarray(im.convert('RGB'), np.uint8)
         return np.transpose(arr.astype(np.float32), (2, 0, 1))
 
-    def __iter__(self):
+    def _load_lines(self, part):
+        with open(self._lists[part]) as f:
+            return [parse_lst_line(l) for l in f if l.strip()]
+
+    def _page_starts(self, part):
+        """(counts, starts): per-page object counts and the cumulative
+        .lst line offset of each page of this part."""
+        if part not in self._tables:
+            counts = scan_page_table(self._bins[part])
+            starts = [0]
+            for c in counts:
+                starts.append(starts[-1] + c)
+            self._tables[part] = (counts, starts)
+        return self._tables[part]
+
+    def _page_stream(self, part, page_order=None):
+        """Yield (page_idx, blobs); ``page_order=None`` streams the file
+        sequentially (native prefetch path), else seeks page-by-page —
+        pages are fixed 64MB records, hence random-access."""
+        if page_order is None:
+            yield from enumerate(self._iter_pages(self._bins[part]))
+            return
+        with open(self._bins[part], 'rb') as f:
+            for pidx in page_order:
+                f.seek(pidx * BinaryPage.N_BYTES)
+                page = BinaryPage()
+                if not page.load(f):
+                    raise RuntimeError('imgbin: truncated page '
+                                       f'{pidx} in {self._bins[part]}')
+                yield pidx, list(page)
+
+    def _make_inst(self, blob, line):
+        index, labels, _ = line
+        return DataInst(index, self._decode(blob),
+                        labels[:self.label_width]
+                        if self.label_width else labels)
+
+    def _epoch_rngs(self):
+        """Fresh deterministic RNGs for one epoch pass, seeded from
+        (seed_data, epoch ordinal) on the consumer thread — so producer
+        prefetch depth or an abandoned pass (round_batch wrap) cannot
+        desync later epochs, yet every epoch gets a new permutation.
+        Distinct page/instance streams mirror the reference imgbinx's
+        kRandMagic=121/111 samplers."""
+        e = self._epoch
+        self._epoch += 1
+        return (np.random.RandomState((self.seed_data + 121 + e * 7919)
+                                      % (2 ** 31)),
+                np.random.RandomState((self.seed_data + 111 + e * 104729)
+                                      % (2 ** 31)))
+
+    def _epoch_pages(self, rng_page):
+        """One epoch pass at page granularity: yields ``(blobs,
+        lines_slice)`` applying part-order shuffle, page-order shuffle
+        within each part (single-file datasets included — the fix for
+        ``shuffle=1`` being a no-op there), worker sharding, and .lst
+        pairing in one place.  Sharded shuffled passes filter the page
+        permutation *before* any IO, so each worker reads only its own
+        1/N of the pages."""
         sharded, rank, nworker = self._single_shard
         order = list(range(len(self._bins)))
-        rng = np.random.RandomState(self.seed_data) if self.shuffle else None
-        if rng is not None:
-            rng.shuffle(order)
+        if self.shuffle:
+            rng_page.shuffle(order)
         for part in order:
-            with open(self._lists[part]) as f:
-                lines = (parse_lst_line(l) for l in f if l.strip())
-                lines = iter(list(lines))
-            for page_idx, page in enumerate(self._iter_pages(self._bins[part])):
-                take = (not sharded) or (page_idx % nworker == rank)
-                for blob in page:
-                    try:
-                        index, labels, _ = next(lines)
-                    except StopIteration:
-                        raise RuntimeError(
-                            'imgbin: .lst shorter than .bin contents')
-                    if not take:
-                        continue
-                    yield DataInst(index, self._decode(blob),
-                                   labels[:self.label_width]
-                                   if self.label_width else labels)
+            lines = self._load_lines(part)
+            if self.shuffle:
+                counts, starts = self._page_starts(part)
+                if starts[-1] > len(lines):
+                    raise RuntimeError('imgbin: .lst shorter than .bin '
+                                       'contents')
+                page_order = [p for p in rng_page.permutation(len(counts))
+                              if not sharded or p % nworker == rank]
+                for pidx, blobs in self._page_stream(part, page_order):
+                    base = starts[pidx]
+                    yield blobs, lines[base:base + len(blobs)]
+            else:
+                base = 0
+                for pidx, blobs in self._page_stream(part):
+                    if base + len(blobs) > len(lines):
+                        raise RuntimeError('imgbin: .lst shorter than .bin '
+                                           'contents')
+                    if (not sharded) or pidx % nworker == rank:
+                        yield blobs, lines[base:base + len(blobs)]
+                    base += len(blobs)
+
+    def __iter__(self):
+        rng_page, _ = self._epoch_rngs()
+        for blobs, lines in self._epoch_pages(rng_page):
+            for blob, line in zip(blobs, lines):
+                yield self._make_inst(blob, line)
+
+
+class ImageBinXIterator(ImageBinIterator):
+    """Two-stage imgbinx pipeline (``iter_thread_imbin_x-inl.hpp:18-397``):
+    the page stage (``_epoch_pages``) runs behind a ThreadBuffer feeding a
+    decode stage behind a second, deeper ThreadBuffer.  ``shuffle=1``
+    randomizes part order, page order within each part, and instance order
+    *within* each page — the reference's SGD-quality shuffle for datasets
+    too big to permute globally — while decode overlaps page IO instead of
+    serializing behind it (buffer depths 2 pages / 256 instances,
+    reference :22-23)."""
+
+    PAGE_BUFFER = 2
+    INST_BUFFER = 256
+
+    def __iter__(self):
+        rng_page, rng_inst = self._epoch_rngs()
+
+        def insts():
+            for blobs, lines in ThreadBuffer(
+                    lambda: self._epoch_pages(rng_page), self.PAGE_BUFFER):
+                inst_order = (rng_inst.permutation(len(blobs))
+                              if self.shuffle else range(len(blobs)))
+                for k in inst_order:
+                    yield self._make_inst(blobs[k], lines[k])
+
+        return iter(ThreadBuffer(insts, self.INST_BUFFER))

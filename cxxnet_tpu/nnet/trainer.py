@@ -92,6 +92,8 @@ class NetTrainer:
         self._mesh: Optional[Mesh] = None
         self._train_step_fn = None
         self._forward_fn = None
+        self._pending_train_eval = None
+        self._ones_mask_cache: Dict[int, object] = {}
         if cfg:
             for name, val in cfg:
                 self.set_param(name, val)
@@ -224,22 +226,23 @@ class NetTrainer:
 
         compute_dtype = self.compute_dtype
 
-        def loss_fn(params, data, label, extra, rng, rnd):
+        def loss_fn(params, data, label, extra, mask, rng, rnd):
             ctx = ForwardContext(is_train=True, rng=rng, round=rnd,
                                  max_round=self.max_round,
                                  compute_dtype=compute_dtype)
             values, loss = net.forward(params, data, ctx,
                                        labels=net.make_label_info(label),
-                                       extra_data=extra)
+                                       loss_mask=mask, extra_data=extra)
             return loss, [values[i] for i in eval_ids]
 
         nan_skip = self.nan_action == 'skip'
 
         @partial(jax.jit, static_argnames=('do_update',), donate_argnums=(0, 1, 2))
-        def train_step(params, opt_state, grad_acc, data, label, extra, rng,
-                       epoch, rnd, do_update):
+        def train_step(params, opt_state, grad_acc, data, label, extra, mask,
+                       rng, epoch, rnd, do_update):
             (loss, evals), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, data, label, extra, rng, rnd)
+                loss_fn, has_aux=True)(params, data, label, extra, mask,
+                                       rng, rnd)
             if nan_skip:
                 # failure detection beyond the reference's NaN-zeroing clip
                 # (sgd_updater-inl.hpp:15-22): a non-finite loss — or a
@@ -315,25 +318,57 @@ class NetTrainer:
         data = self._shard_batch(batch.data)
         label = self._shard_batch(batch.label, cast=False)
         extra = tuple(self._shard_batch(e) for e in batch.extra_data)
+        # synthetic pad rows of a short tail batch (round_batch=0) carry
+        # zero loss-mask so they contribute nothing to grads; real rows —
+        # including round_batch=1 wrapped instances, which the reference
+        # trains on (nnet_impl:141-170) — keep the reference's per-instance
+        # 1/batch_size weight
+        bs = batch.batch_size
+        if batch.num_batch_padd and getattr(batch, 'pad_synthetic', False):
+            mask = np.ones(bs, np.float32)
+            mask[bs - batch.num_batch_padd:] = 0.0
+            mask = self._shard_batch(mask, cast=False)
+        else:
+            mask = self._ones_mask(bs)
+        old_pending = self._pending_train_eval
+        self._pending_train_eval = None
         (self.params, self.opt_state, self.grad_acc, loss, evals) = \
             self._train_step_fn(self.params, self.opt_state, self.grad_acc,
-                                data, label, extra, rng,
+                                data, label, extra, mask, rng,
                                 self.epoch_counter, self.round,
                                 do_update=do_update)
         if self.eval_train and len(self.train_metric):
-            if self.nan_action == 'skip' and not np.isfinite(float(loss)):
-                pass    # poisoned batch: its NaN outputs would wreck the
-                        # round's train metrics along with the weights
-            else:
-                label_info = _HostLabelInfo(np.asarray(batch.label),
-                                            self.net_cfg.label_name_map,
-                                            self.net_cfg.label_range)
-                n = batch.batch_size - batch.num_batch_padd
-                self.train_metric.add_eval(
-                    [np.asarray(e)[:n] for e in evals], label_info.slice(n))
+            # defer this step's metric readback one step: by the next
+            # update() (or evaluate()) the values are already on host, so
+            # no per-step device sync — the analogue of the reference's
+            # reuse of already-copied eval nodes (nnet_impl:174-180)
+            label_info = _HostLabelInfo(np.asarray(batch.label),
+                                        self.net_cfg.label_name_map,
+                                        self.net_cfg.label_range)
+            self._pending_train_eval = (
+                loss, evals, label_info, bs - batch.num_batch_padd)
+        if old_pending is not None:
+            self._drain_train_eval(old_pending)
         if do_update:
             self.epoch_counter += 1
         self.sample_counter += 1
+
+    def _ones_mask(self, bs: int):
+        """Cached on-device all-ones loss mask — the no-pad common case
+        costs no per-step H2D transfer."""
+        cached = self._ones_mask_cache.get(bs)
+        if cached is None:
+            cached = self._shard_batch(np.ones(bs, np.float32), cast=False)
+            self._ones_mask_cache[bs] = cached
+        return cached
+
+    def _drain_train_eval(self, pending) -> None:
+        loss, evals, label_info, n = pending
+        if self.nan_action == 'skip' and not np.isfinite(float(loss)):
+            return  # poisoned batch: its NaN outputs would wreck the
+                    # round's train metrics along with the weights
+        self.train_metric.add_eval(
+            [np.asarray(e)[:n] for e in evals], label_info.slice(n))
 
     def update_on_device(self, data, label) -> None:
         """One training step over batches already resident on device (jax
@@ -344,7 +379,7 @@ class NetTrainer:
                                  self.round)
         (self.params, self.opt_state, self.grad_acc, _, _) = \
             self._train_step_fn(self.params, self.opt_state, self.grad_acc,
-                                data, label, (), rng,
+                                data, label, (), None, rng,
                                 self.epoch_counter, self.round,
                                 do_update=do_update)
         if do_update:
@@ -360,7 +395,8 @@ class NetTrainer:
         try:
             lowered = self._train_step_fn.lower(
                 self.params, self.opt_state, self.grad_acc, data, label,
-                (), rng, self.epoch_counter, self.round, do_update=True)
+                (), None, rng, self.epoch_counter, self.round,
+                do_update=True)
             cost = lowered.compile().cost_analysis()
             if isinstance(cost, (list, tuple)):
                 cost = cost[0] if cost else None
@@ -382,6 +418,9 @@ class NetTrainer:
         (and cleared) when ``eval_train`` is set; ``data_iter=None``
         returns just the train part."""
         ret = ''
+        if self._pending_train_eval is not None:
+            pending, self._pending_train_eval = self._pending_train_eval, None
+            self._drain_train_eval(pending)
         if self.eval_train and len(self.train_metric):
             ret += self.train_metric.print('train')
             self.train_metric.clear()

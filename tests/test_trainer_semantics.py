@@ -118,6 +118,93 @@ def test_extract_topk_and_named_node():
     assert hidden.reshape(16, -1).shape == (16, 16)
 
 
+TAIL_CONF = """
+netconfig = start
+layer[0->1] = fullc:fc1
+  nhidden = 8
+layer[1->2] = sigmoid
+layer[2->3] = fullc:cls
+  nhidden = 4
+layer[3->3] = softmax
+netconfig = end
+input_shape = 1,1,6
+batch_size = 100
+input_flat = 1
+dev = cpu
+eta = 0.1
+metric = error
+"""
+
+
+def _padded_batches(x, y, bs, pad_fill):
+    """Split (n, ...) arrays into full batches; pad the short tail with
+    ``pad_fill`` rows and set num_batch_padd — the shape the batch adapter
+    emits for round_batch=0."""
+    n = x.shape[0]
+    out = []
+    for s in range(0, n, bs):
+        xb, yb = x[s:s + bs], y[s:s + bs]
+        npadd = bs - xb.shape[0]
+        if npadd:
+            xb = np.concatenate([xb, np.full((npadd,) + x.shape[1:],
+                                             pad_fill, x.dtype)])
+            yb = np.concatenate([yb, np.full((npadd, y.shape[1]),
+                                             pad_fill, y.dtype)])
+        out.append(DataBatch(xb, yb, num_batch_padd=npadd,
+                             pad_synthetic=bool(npadd)))
+    return out
+
+
+def test_tail_batch_trains_and_evals_all_instances():
+    """A 250-instance dataset at batch 100 trains/evals all 250 — the pad
+    rows of the short tail batch (num_batch_padd=50) are masked out of
+    gradients and metrics (reference: iter_batch_proc-inl.hpp:101-103 emits
+    the tail; nnet_impl-inl.hpp:239 excludes pads from eval)."""
+    rng = np.random.RandomState(3)
+    x = rng.rand(250, 1, 1, 6).astype(np.float32)
+    y = rng.randint(0, 4, (250, 1)).astype(np.float32)
+
+    # two trainers, identical seed, fed the same real rows but tail pads
+    # filled with wildly different garbage: masked pads => identical params
+    results = []
+    for pad_fill in (0.0, 1e6):
+        tr = NetTrainer(parse_config_string(TAIL_CONF))
+        tr.init_model()
+        tr.start_round(0)
+        for b in _padded_batches(x, y, 100, pad_fill):
+            tr.update(b)
+        import jax
+        results.append(jax.device_get(tr.params))
+    for (ka, va), (kb, vb) in zip(sorted(results[0].items()),
+                                  sorted(results[1].items())):
+        for f in va:
+            np.testing.assert_array_equal(va[f], vb[f]), (ka, f)
+    assert all(np.all(np.isfinite(v[f])) for v in results[1].values()
+               for f in v), 'garbage pad rows leaked into gradients'
+
+    # eval counts exactly 250 instances, pads excluded
+    tr = NetTrainer(parse_config_string(TAIL_CONF))
+    tr.init_model()
+    tr.evaluate(iter(_padded_batches(x, y, 100, 1e6)), 'v')
+    assert tr.metric.evals[0].cnt_inst == 250
+
+
+def test_train_metric_counts_tail_instances():
+    """eval_train metrics over an epoch with a padded tail count every real
+    instance once (250, not 300 or 200)."""
+    rng = np.random.RandomState(4)
+    x = rng.rand(250, 1, 1, 6).astype(np.float32)
+    y = rng.randint(0, 4, (250, 1)).astype(np.float32)
+    tr = NetTrainer(parse_config_string(TAIL_CONF))
+    tr.init_model()
+    tr.start_round(0)
+    for b in _padded_batches(x, y, 100, 0.0):
+        tr.update(b)
+    pending, tr._pending_train_eval = tr._pending_train_eval, None
+    tr._drain_train_eval(pending)   # the last step's deferred readback
+    assert tr.train_metric.evals[0].cnt_inst == 250
+
+
 def test_rec_at_n():
     m = create_metric('rec@2')
     pred = np.array([[0.1, 0.5, 0.4], [0.9, 0.05, 0.6]])
