@@ -210,14 +210,25 @@ class ImageBinIterator(IIterator):
                 for pidx, blobs in self._page_stream(part, page_order):
                     base = starts[pidx]
                     yield blobs, lines[base:base + len(blobs)]
+            elif sharded:
+                # unshuffled but sharded: seek past non-owned pages instead
+                # of reading and discarding them (1/N of the IO per worker)
+                counts, starts = self._page_starts(part)
+                if starts[-1] > len(lines):
+                    raise RuntimeError('imgbin: .lst shorter than .bin '
+                                       'contents')
+                owned = [p for p in range(len(counts))
+                         if p % nworker == rank]
+                for pidx, blobs in self._page_stream(part, owned):
+                    yield blobs, lines[starts[pidx]:
+                                       starts[pidx] + len(blobs)]
             else:
                 base = 0
                 for pidx, blobs in self._page_stream(part):
                     if base + len(blobs) > len(lines):
                         raise RuntimeError('imgbin: .lst shorter than .bin '
                                            'contents')
-                    if (not sharded) or pidx % nworker == rank:
-                        yield blobs, lines[base:base + len(blobs)]
+                    yield blobs, lines[base:base + len(blobs)]
                     base += len(blobs)
 
     def __iter__(self):

@@ -84,6 +84,14 @@ def get_layer_type(type_str: str) -> int:
         return kPairTestGap * get_layer_type(master) + get_layer_type(slave)
     if type_str in _NAME2TYPE:
         return _NAME2TYPE[type_str]
+    if type_str == 'caffe':
+        # reference plugin enum 20 (plugin/caffe_adapter-inl.hpp): wraps
+        # live caffe::Layer objects — rejected scope on a TPU stack (see
+        # PARITY.md), reported distinctly from a typo'd layer name
+        raise ValueError(
+            "layer type 'caffe' (reference plugin enum 20) is an "
+            'unsupported plugin: it adapts in-process caffe::Layer objects '
+            'and has no TPU equivalent')
     raise ValueError(f'unknown layer type: "{type_str}"')
 
 

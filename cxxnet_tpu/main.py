@@ -4,7 +4,8 @@ Equivalent of the reference driver (``src/cxxnet_main.cpp:16-478``)::
 
     python -m cxxnet_tpu.main config.conf [k=v ...]
 
-Tasks (``task=``): ``train`` (default), ``finetune``, ``pred``, ``extract``.
+Tasks (``task=``): ``train`` (default), ``finetune``, ``pred``,
+``pred_raw``, ``extract``.
 Counter/checkpoint choreography preserved: model files are
 ``model_dir/%04d.model`` with an int ``net_type`` prefix; ``continue=1``
 scans forward from ``start_counter`` to resume from the newest checkpoint
@@ -142,13 +143,13 @@ class LearnTask:
                 continue
             if name == 'iter' and val == 'end':
                 assert flag != 0, 'wrong configuration file'
-                if flag == 1 and self.task != 'pred':
+                if flag == 1 and self.task not in ('pred', 'pred_raw'):
                     assert self.itr_train is None, 'can only have one data'
                     self.itr_train = create_iterator(itcfg)
-                if flag == 2 and self.task != 'pred':
+                if flag == 2 and self.task not in ('pred', 'pred_raw'):
                     self.itr_evals.append(create_iterator(itcfg))
                     self.eval_names.append(evname)
-                if flag == 3 and self.task in ('pred', 'extract'):
+                if flag == 3 and self.task in ('pred', 'pred_raw', 'extract'):
                     assert self.itr_pred is None, 'only one pred section'
                     self.itr_pred = create_iterator(itcfg)
                 flag = 0
@@ -247,6 +248,21 @@ class LearnTask:
                     fo.write(f'{v:g}\n')
         print(f'finished prediction, write into {self.name_pred}')
 
+    def task_predict_raw(self) -> None:
+        """``task=pred_raw``: the final node's raw score vector per
+        instance, one space-separated line each — the format
+        ``make_submission.py`` consumes.  (The reference gates the pred
+        iterator on this task name, ``cxxnet_main.cpp:242``, but its Run()
+        never dispatches it — here it works.)"""
+        assert self.itr_pred is not None, 'must specify a pred iterator'
+        print('start predicting (raw scores)...')
+        with open(self.name_pred, 'w') as fo:
+            for batch in self.itr_pred:
+                out = self.net_trainer.extract_feature(batch, 'top[-1]')
+                for row in out.reshape(out.shape[0], -1):
+                    fo.write(' '.join(f'{v:g}' for v in row) + '\n')
+        print(f'finished prediction, write into {self.name_pred}')
+
     def task_extract(self) -> None:
         assert self.itr_pred is not None, 'must specify a pred iterator'
         node = self.extract_node_name or 'top[-1]'
@@ -277,6 +293,8 @@ class LearnTask:
             self.task_train()
         elif self.task == 'pred':
             self.task_predict()
+        elif self.task == 'pred_raw':
+            self.task_predict_raw()
         elif self.task == 'extract':
             self.task_extract()
         return 0

@@ -58,6 +58,7 @@ class TransformerConfig:
     seq_len: int = 64
     num_experts: int = 0         # 0 = dense FFN; >0 = switch-MoE FFN
     capacity_factor: float = 2.0
+    balance_loss_weight: float = 0.01   # Switch aux-loss weight (MoE only)
     attn: str = 'ring'           # 'ring' | 'local'
     causal: bool = True
     num_microbatches: int = 4
@@ -135,7 +136,9 @@ def _stage_fn(p, x, *, cfg: TransformerConfig, tp: int, sp: int):
     """One transformer block on the local activation shard.
     x: (mb_local, s_local, D).  p: this stage's params (leading dim
     squeezed).  Collectives: 'seq' (ring attention), 'model' (psum for
-    row-parallel projections), 'data' (MoE all_to_all)."""
+    row-parallel projections), 'data' (MoE all_to_all).
+    Returns (y, aux): aux carries the MoE balance loss / drop fraction
+    (zeros for dense FFN) and is accumulated by the pipeline loop."""
     mb, s_loc, d = x.shape
     h_local = cfg.num_heads // tp        # heads owned by this model rank
     hd = d // cfg.num_heads
@@ -163,34 +166,43 @@ def _stage_fn(p, x, *, cfg: TransformerConfig, tp: int, sp: int):
     y = _layer_norm(x, p['ln2_scale'], p['ln2_bias'])
     if cfg.num_experts:
         yf = y.reshape(mb * s_loc, d)
-        ff = moe_ffn_local(yf, p['gate'], p['w1'], p['w2'],
-                           axis_name='data',
-                           capacity_factor=cfg.capacity_factor)
+        ff, aux = moe_ffn_local(yf, p['gate'], p['w1'], p['w2'],
+                                axis_name='data',
+                                capacity_factor=cfg.capacity_factor)
         ff = ff.reshape(mb, s_loc, d)
     else:
         ff = jax.nn.relu(y @ p['w1']) @ p['w2']
         if tp > 1:
             ff = lax.psum(ff, 'model')
-    return x + ff
+        aux = {'balance_loss': jnp.float32(0.0),
+               'drop_frac': jnp.float32(0.0)}
+    return x + ff, aux
 
 
 def _loss_local(params, tokens, labels, *, cfg, tp, sp):
-    """Local shard loss: embed -> pipelined blocks -> head -> mean NLL."""
+    """Local shard loss: embed -> pipelined blocks -> head -> mean NLL
+    (+ weighted MoE balance loss).  Returns (loss, aux)."""
     h = jnp.take(params['embed'], tokens, axis=0)        # (b, s, D)
     xs = split_microbatches(h, cfg.num_microbatches)
     stage = functools.partial(_stage_fn, cfg=cfg, tp=tp, sp=sp)
-    hs = pipeline_stage_loop(stage, params['stages'], xs,
-                             axis_name='pipe', num_stages=cfg.num_stages)
+    hs, aux = pipeline_stage_loop(stage, params['stages'], xs,
+                                  axis_name='pipe',
+                                  num_stages=cfg.num_stages, has_aux=True)
     h = hs.reshape(h.shape)
     logits = (h @ params['head']).astype(jnp.float32)     # (b, s, V)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
-    return nll.mean()
+    loss = nll.mean()
+    if cfg.num_experts:
+        loss = loss + cfg.balance_loss_weight * aux['balance_loss']
+    return loss, aux
 
 
 def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 0.1):
     """Jitted full train step: (params, tokens, labels) ->
-    (new_params, loss).  tokens/labels are global (B, seq_len) int32."""
+    (new_params, loss, aux).  tokens/labels are global (B, seq_len) int32;
+    aux reports ``balance_loss`` (unweighted) and ``drop_frac`` summed over
+    MoE blocks (zeros for dense FFN)."""
     tp = mesh.shape['model']
     sp = mesh.shape['seq']
     if cfg.num_heads % tp:
@@ -211,9 +223,9 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 0.1):
         return tuple(a for a in AXES if a not in used)
 
     def body(params, tokens, labels):
-        loss, grads = jax.value_and_grad(
-            functools.partial(_loss_local, cfg=cfg, tp=tp, sp=sp))(
-                params, tokens, labels)
+        (loss, aux), grads = jax.value_and_grad(
+            functools.partial(_loss_local, cfg=cfg, tp=tp, sp=sp),
+            has_aux=True)(params, tokens, labels)
         # Per-rank autodiff yields d(sum of every rank's local loss)/
         # d(local shard) — collective transposes already crossed ranks.
         # Tie replicas back together: sum each leaf's gradient over the
@@ -229,11 +241,13 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 0.1):
         grads = _map_with_specs(tie, grads, specs)
         new_params = jax.tree.map(
             lambda w, g: (w - lr * g).astype(w.dtype), params, grads)
-        return new_params, lax.pmean(loss, AXES)
+        aux = jax.tree.map(lambda v: lax.pmean(v, AXES), aux)
+        return new_params, lax.pmean(loss, AXES), aux
 
     fn = shard_map(body, mesh=mesh,
                    in_specs=(specs, tok_spec, tok_spec),
-                   out_specs=(specs, P()),
+                   out_specs=(specs, P(), {'balance_loss': P(),
+                                           'drop_frac': P()}),
                    check_vma=False)
     return jax.jit(fn)
 
@@ -250,8 +264,10 @@ def build_transformer_mesh(n_devices: int,
 
 
 def reference_loss(params, tokens, labels, cfg: TransformerConfig):
-    """Single-device oracle: same math, no mesh, sequential stages."""
+    """Single-device oracle: same math, no mesh, sequential stages —
+    including the weighted MoE balance loss the distributed step adds."""
     h = jnp.take(params['embed'], tokens, axis=0)
+    balance = jnp.float32(0.0)
     for i in range(cfg.num_stages):
         p = jax.tree.map(lambda a: a[i], params['stages'])
         mb, s, d = h.shape
@@ -268,12 +284,16 @@ def reference_loss(params, tokens, labels, cfg: TransformerConfig):
         y = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
         if cfg.num_experts:
             from ..parallel.moe import moe_ffn_reference
-            ff = moe_ffn_reference(y.reshape(mb * s, d), p['gate'],
-                                   p['w1'], p['w2'],
-                                   capacity_factor=cfg.capacity_factor)
+            ff, aux = moe_ffn_reference(y.reshape(mb * s, d), p['gate'],
+                                        p['w1'], p['w2'],
+                                        capacity_factor=cfg.capacity_factor)
             h = h + ff.reshape(mb, s, d)
+            balance = balance + aux['balance_loss']
         else:
             h = h + jax.nn.relu(y @ p['w1']) @ p['w2']
     logits = (h @ params['head']).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+    if cfg.num_experts:
+        nll = nll + cfg.balance_loss_weight * balance
+    return nll

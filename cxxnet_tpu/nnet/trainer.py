@@ -353,6 +353,14 @@ class NetTrainer:
             self.epoch_counter += 1
         self.sample_counter += 1
 
+    def flush_train_metrics(self) -> None:
+        """Force the one-step-deferred train-metric readback (see
+        ``update``); after this, ``train_metric`` reflects every update so
+        far.  ``evaluate`` calls it implicitly."""
+        if self._pending_train_eval is not None:
+            pending, self._pending_train_eval = self._pending_train_eval, None
+            self._drain_train_eval(pending)
+
     def _ones_mask(self, bs: int):
         """Cached on-device all-ones loss mask — the no-pad common case
         costs no per-step H2D transfer."""
@@ -418,9 +426,7 @@ class NetTrainer:
         (and cleared) when ``eval_train`` is set; ``data_iter=None``
         returns just the train part."""
         ret = ''
-        if self._pending_train_eval is not None:
-            pending, self._pending_train_eval = self._pending_train_eval, None
-            self._drain_train_eval(pending)
+        self.flush_train_metrics()
         if self.eval_train and len(self.train_metric):
             ret += self.train_metric.print('train')
             self.train_metric.clear()

@@ -30,7 +30,15 @@ from jax import lax
 
 def switch_gate(x, gate_w, capacity: int):
     """Top-1 gating with capacity.  x:(T,D), gate_w:(D,E) ->
-    dispatch:(T,E,C) 0/1, combine:(T,E,C) = dispatch * gate_prob."""
+    dispatch:(T,E,C) 0/1, combine:(T,E,C) = dispatch * gate_prob,
+    aux: {'balance_loss', 'drop_frac'}.
+
+    ``balance_loss`` is the Switch auxiliary load-balancing loss
+    ``E * sum_e f_e * P_e`` (f_e = routed token fraction, P_e = mean router
+    probability; minimum 1.0 at uniform routing) — differentiable through
+    P_e, so training pressure spreads the experts.  ``drop_frac`` is the
+    fraction of tokens lost to the capacity bound (metric only,
+    stop-gradient)."""
     logits = x @ gate_w.astype(x.dtype)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     expert = jnp.argmax(probs, axis=-1)                      # (T,)
@@ -43,7 +51,15 @@ def switch_gate(x, gate_w, capacity: int):
                 [:, None, :] * (sel * keep)[:, :, None])     # (T,E,C)
     gate_prob = (probs * sel).sum(-1, keepdims=True)         # (T,1)
     combine = dispatch * gate_prob[:, :, None]
-    return dispatch, combine
+    num_experts = gate_w.shape[1]
+    f = sel.mean(axis=0)                                     # (E,)
+    p = probs.mean(axis=0)                                   # (E,)
+    aux = {
+        'balance_loss': num_experts * jnp.sum(f * p),
+        'drop_frac': lax.stop_gradient(
+            1.0 - dispatch.sum() / jnp.float32(x.shape[0])),
+    }
+    return dispatch, combine, aux
 
 
 def moe_ffn_local(x, gate_w, w1, w2, *, axis_name=None,
@@ -53,14 +69,17 @@ def moe_ffn_local(x, gate_w, w1, w2, *, axis_name=None,
     otherwise (w1/w2 hold all experts).
 
     x: (T, D) local tokens; w1: (E_local, D, F); w2: (E_local, F, D);
-    gate_w: (D, E_global).  Returns (T, D).
+    gate_w: (D, E_global).  Returns (out (T, D), aux dict); aux values
+    are means over the ``axis_name`` group when given.
     """
     n = 1 if axis_name is None else lax.psum(1, axis_name)
     e_local = w1.shape[0]
     e_global = e_local * n
     t = x.shape[0]
     capacity = max(1, int(capacity_factor * t / e_global))
-    dispatch, combine = switch_gate(x, gate_w, capacity)
+    dispatch, combine, aux = switch_gate(x, gate_w, capacity)
+    if axis_name is not None:
+        aux = {k: lax.pmean(v, axis_name) for k, v in aux.items()}
     xf = x.astype(jnp.float32)
     buf = jnp.einsum('td,tec->ecd', xf, dispatch)            # (E, C, D)
     if axis_name is not None:
@@ -76,11 +95,12 @@ def moe_ffn_local(x, gate_w, w1, w2, *, axis_name=None,
         y = lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0,
                            tiled=True)
     out = jnp.einsum('ecd,tec->td', y, combine)
-    return out.astype(x.dtype)
+    return out.astype(x.dtype), aux
 
 
 def moe_ffn_reference(x, gate_w, w1, w2, capacity_factor: float = 2.0):
     """Single-device oracle: same routing/capacity semantics, dense loop
-    over all experts.  w1: (E, D, F), w2: (E, F, D)."""
+    over all experts.  w1: (E, D, F), w2: (E, F, D).
+    Returns (out, aux) like moe_ffn_local."""
     return moe_ffn_local(x, gate_w, w1, w2, axis_name=None,
                          capacity_factor=capacity_factor)

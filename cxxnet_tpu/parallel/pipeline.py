@@ -29,16 +29,22 @@ from jax import lax
 
 
 def pipeline_stage_loop(stage_fn: Callable, stage_params, xs,
-                        *, axis_name: str, num_stages: int):
+                        *, axis_name: str, num_stages: int,
+                        has_aux: bool = False):
     """Run microbatches through the pipeline.  Call INSIDE shard_map.
 
     stage_fn(params, x_mb) -> y_mb with ``y_mb.shape == x_mb.shape``
-    (homogeneous stages — the transformer-block case).
+    (homogeneous stages — the transformer-block case); with
+    ``has_aux=True`` it returns ``(y_mb, aux)`` where aux is a pytree of
+    scalars (e.g. MoE balance loss / drop stats).
     stage_params: local shard of the stacked params — leaves have leading
     dim 1 (the stage owned by this device); passed to stage_fn squeezed.
     xs: (M, mb, ...) microbatches, replicated over ``axis_name``.
-    Returns (M, mb, ...) outputs, replicated over ``axis_name`` (the last
-    stage's result is broadcast with a masked psum).
+    Returns (M, mb, ...) outputs replicated over ``axis_name`` (the last
+    stage's result is broadcast with a masked psum); with ``has_aux=True``
+    returns ``(outs, aux)`` where each aux leaf is summed over stages and
+    averaged over microbatches — bubble ticks (a stage running on garbage
+    before/after its live window) are masked out of the average.
     """
     S = num_stages
     idx = lax.axis_index(axis_name)
@@ -49,25 +55,43 @@ def pipeline_stage_loop(stage_fn: Callable, stage_params, xs,
     perm = [(i, i + 1) for i in range(S - 1)]
 
     def tick(carry, t):
-        state, outs = carry
+        state, outs, aux_acc = carry
         inj = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, M - 1), 0,
                                        keepdims=False)
         x_in = jnp.where(idx == 0, inj, state)
-        y = stage_fn(p_local, x_in)
+        if has_aux:
+            y, aux = stage_fn(p_local, x_in)
+            # stage idx processes live microbatch m = t - idx
+            valid = jnp.logical_and(t >= idx, t < idx + M).astype(jnp.float32)
+            aux_acc = jax.tree.map(lambda a, v: a + valid * v, aux_acc, aux)
+        else:
+            y = stage_fn(p_local, x_in)
         widx = jnp.clip(t - (S - 1), 0, M - 1)
         old = lax.dynamic_index_in_dim(outs, widx, 0, keepdims=False)
         write = jnp.logical_and(idx == S - 1, t >= S - 1)
         outs = lax.dynamic_update_index_in_dim(
             outs, jnp.where(write, y, old), widx, 0)
         state = lax.ppermute(y, axis_name, perm) if perm else y
-        return (state, outs), None
+        return (state, outs, aux_acc), None
 
     state0 = jnp.zeros_like(xs[0])
     outs0 = jnp.zeros_like(xs)
-    (_, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(T))
+    if has_aux:
+        _, aux_shape = jax.eval_shape(stage_fn, p_local, xs[0])
+        aux0 = jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32),
+                            aux_shape)
+    else:
+        aux0 = ()
+    (_, outs, aux_acc), _ = lax.scan(tick, (state0, outs0, aux0),
+                                     jnp.arange(T))
     # broadcast the last stage's outputs to every pipe rank
-    return lax.psum(jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)),
+    outs = lax.psum(jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)),
                     axis_name)
+    if not has_aux:
+        return outs
+    # per-stage mean over its M live microbatches, summed across stages
+    aux_out = jax.tree.map(lambda a: lax.psum(a / M, axis_name), aux_acc)
+    return outs, aux_out
 
 
 def split_microbatches(x, num_microbatches: int):

@@ -1,0 +1,50 @@
+#!/usr/bin/env python3
+"""Build a cxxnet .lst (``index \\t label \\t path``) from the NDSB folder
+layout (reference ``example/kaggle_bowl/gen_img_list.py``).
+
+Usage::
+
+    python gen_img_list.py train sample_submission.csv train_folder/ img.lst
+    python gen_img_list.py test  sample_submission.csv test_folder/  test.lst
+
+Class ids follow the column order of sample_submission.csv (the order the
+submission file must use); the list is shuffled with a fixed seed.
+"""
+
+import csv
+import os
+import random
+import sys
+
+
+def main():
+    if len(sys.argv) < 5:
+        print('Usage: gen_img_list.py train/test sample_submission.csv '
+              'image_folder img.lst')
+        return 1
+    task, sub_csv, folder, out = sys.argv[1:5]
+    rng = random.Random(888)
+    with open(sub_csv, newline='') as f:
+        head = next(csv.reader(f))[1:]       # class names, submission order
+
+    img_lst = []
+    if task == 'train':
+        for cls_id, cls in enumerate(head):
+            cls_dir = os.path.join(folder, cls)
+            for img in sorted(os.listdir(cls_dir)):
+                img_lst.append((len(img_lst), cls_id,
+                                os.path.join(cls_dir, img)))
+    else:
+        for img in sorted(os.listdir(folder)):
+            img_lst.append((len(img_lst), 0, os.path.join(folder, img)))
+
+    rng.shuffle(img_lst)
+    with open(out, 'w', newline='') as f:
+        w = csv.writer(f, delimiter='\t', lineterminator='\n')
+        for item in img_lst:
+            w.writerow(item)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

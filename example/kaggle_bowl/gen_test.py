@@ -1,0 +1,29 @@
+#!/usr/bin/env python3
+"""Resize the flat NDSB test folder to 48x48 (reference
+``example/kaggle_bowl/gen_test.py``; PIL instead of ImageMagick).
+
+Usage::
+
+    python gen_test.py input_folder/ output_folder/
+"""
+
+import os
+import sys
+
+from PIL import Image
+
+
+def main():
+    if len(sys.argv) < 3:
+        print('Usage: python gen_test.py input_folder output_folder')
+        return 1
+    src, dst = sys.argv[1], sys.argv[2]
+    os.makedirs(dst, exist_ok=True)
+    for img in sorted(os.listdir(src)):
+        with Image.open(os.path.join(src, img)) as im:
+            im.resize((48, 48), Image.BILINEAR).save(os.path.join(dst, img))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

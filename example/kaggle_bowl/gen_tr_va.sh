@@ -1,0 +1,5 @@
+#!/bin/sh
+# split a shuffled img.lst into train/validation lists
+# (reference example/kaggle_bowl/gen_tr_va.sh)
+sed -n '1,20000p' "$1" > tr.lst
+sed -n '20000,40000p' "$1" > va.lst

@@ -1,0 +1,38 @@
+#!/usr/bin/env python3
+"""Resize the NDSB train folder tree to 48x48 (reference
+``example/kaggle_bowl/gen_train.py``, which shelled out to ImageMagick
+``convert -resize 48x48!``; PIL here — no external tool needed).
+
+Usage::
+
+    python gen_train.py input_folder/ output_folder/
+"""
+
+import os
+import sys
+
+from PIL import Image
+
+
+def resize_tree(src, dst, size=(48, 48)):
+    for cls in sorted(os.listdir(src)):
+        sdir = os.path.join(src, cls)
+        if not os.path.isdir(sdir):
+            continue
+        ddir = os.path.join(dst, cls)
+        os.makedirs(ddir, exist_ok=True)
+        for img in os.listdir(sdir):
+            with Image.open(os.path.join(sdir, img)) as im:
+                im.resize(size, Image.BILINEAR).save(os.path.join(ddir, img))
+
+
+def main():
+    if len(sys.argv) < 3:
+        print('Usage: python gen_train.py input_folder output_folder')
+        return 1
+    resize_tree(sys.argv[1], sys.argv[2])
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""Assemble the Kaggle submission csv from ``task=pred_raw`` output
+(reference ``example/kaggle_bowl/make_submission.py``).
+
+Usage::
+
+    python make_submission.py sample_submission.csv test.lst test.txt out.csv
+
+``test.txt`` is the pred_raw output: one space-separated probability row
+per instance, in ``test.lst`` order.
+"""
+
+import csv
+import os
+import sys
+
+
+def main():
+    if len(sys.argv) < 5:
+        print('Usage: python make_submission.py sample_submission.csv '
+              'test.lst test.txt out.csv')
+        return 1
+    sub_csv, lst_path, scores_path, out_path = sys.argv[1:5]
+    with open(sub_csv, newline='') as f:
+        head = next(csv.reader(f))
+    names = []
+    with open(lst_path, newline='') as f:
+        for line in csv.reader(f, delimiter='\t'):
+            names.append(os.path.basename(line[-1]))
+    with open(out_path, 'w', newline='') as fo:
+        w = csv.writer(fo, lineterminator='\n')
+        w.writerow(head)
+        with open(scores_path) as fi:
+            for idx, line in enumerate(fi):
+                w.writerow([names[idx]] + line.split())
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -74,9 +74,12 @@ def main() -> int:
 
     t0 = time.time()
     for i in range(args.steps):
-        params, loss = step(params, tokens, labels)
+        params, loss, aux = step(params, tokens, labels)
         if i % 10 == 0 or i == args.steps - 1:
-            print(f'step {i:4d}  loss {float(loss):.4f}  '
+            moe = (f'  balance {float(aux["balance_loss"]):.3f}'
+                   f'  drop {float(aux["drop_frac"]):.3f}'
+                   if args.experts else '')
+            print(f'step {i:4d}  loss {float(loss):.4f}{moe}  '
                   f'({time.time() - t0:.1f}s)')
     return 0
 

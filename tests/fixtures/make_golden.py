@@ -1,0 +1,81 @@
+"""Generate the committed golden checkpoint fixtures.
+
+Run from the repo root (CPU):
+
+    JAX_PLATFORMS=cpu python tests/fixtures/make_golden.py
+
+``golden_v2`` covers every risky branch of ``checkpoint.to_disk_layout`` /
+``from_disk_layout``: grouped-conv im2col round-trip, batch_norm and prelu
+tensor-only records, the no_bias fullc zero bias slot, and a ``share[tag]``
+net (shared layers must not duplicate their record in the blob).  The
+fixture bytes are generated ONCE and committed; the stability test only
+loads them — regenerating after a format change defeats the guarantee.
+"""
+
+import os
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+from cxxnet_tpu.nnet.trainer import NetTrainer                  # noqa: E402
+from cxxnet_tpu.io.data import DataBatch                        # noqa: E402
+from cxxnet_tpu.utils.config import parse_config_string         # noqa: E402
+
+GOLDEN_V2_CONF = """
+netconfig = start
+layer[0->1] = conv:c1
+  nchannel = 8
+  kernel_size = 3
+  ngroup = 2
+  pad = 1
+layer[1->2] = batch_norm:bn1
+layer[2->3] = prelu:pr1
+layer[3->4] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[4->5] = flatten
+layer[5->6] = fullc:fs
+  nhidden = 128
+layer[6->7] = sigmoid
+layer[7->8] = share[fs]
+layer[8->9] = fullc:out
+  nhidden = 3
+  no_bias = 1
+layer[9->9] = softmax
+netconfig = end
+input_shape = 4,8,8
+batch_size = 4
+dev = cpu
+seed = 11
+"""
+
+
+def main():
+    here = os.path.dirname(os.path.abspath(__file__))
+    tr = NetTrainer(parse_config_string(GOLDEN_V2_CONF))
+    tr.init_model()
+    tr.epoch_counter = 7
+    with open(os.path.join(here, 'golden_v2.model'), 'wb') as f:
+        f.write(struct.pack('<i', 0))        # net_type prefix
+        tr.save_model(f)
+    rng = np.random.RandomState(3)
+    x = rng.rand(4, 4, 8, 8).astype(np.float32)
+    np.save(os.path.join(here, 'golden_v2_input.npy'), x)
+    batch = DataBatch(x, np.zeros((4, 1), np.float32))
+    pred = tr.predict(batch)
+    np.save(os.path.join(here, 'golden_v2_pred.npy'), pred)
+    # raw softmax scores: catches weight-layout scrambles that happen to
+    # preserve the argmax
+    scores = tr.extract_feature(batch, 'top[-1]')
+    np.save(os.path.join(here, 'golden_v2_scores.npy'), scores)
+    w = np.asarray(tr.params['0']['wmat'])
+    print('conv wmat shape', w.shape, 'sum', repr(float(w.sum())))
+    print('pred', pred)
+    print('scores[0]', scores.reshape(4, -1)[0])
+
+
+if __name__ == '__main__':
+    main()

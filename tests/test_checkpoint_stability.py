@@ -54,6 +54,76 @@ def test_golden_checkpoint_loads():
     np.testing.assert_array_equal(got, want)
 
 
+GOLDEN_V2_CONF = """
+netconfig = start
+layer[0->1] = conv:c1
+  nchannel = 8
+  kernel_size = 3
+  ngroup = 2
+  pad = 1
+layer[1->2] = batch_norm:bn1
+layer[2->3] = prelu:pr1
+layer[3->4] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[4->5] = flatten
+layer[5->6] = fullc:fs
+  nhidden = 128
+layer[6->7] = sigmoid
+layer[7->8] = share[fs]
+layer[8->9] = fullc:out
+  nhidden = 3
+  no_bias = 1
+layer[9->9] = softmax
+netconfig = end
+input_shape = 4,8,8
+batch_size = 4
+dev = cpu
+"""
+
+
+def test_golden_v2_risky_layouts_load():
+    """golden_v2.model pins the risky disk layouts: grouped-conv im2col
+    round-trip (checkpoint.to_disk_layout conv branch), batch_norm and
+    prelu tensor-only records, the no_bias fullc zero bias slot, and a
+    share[tag] net (shared layer contributes no blob record).  Loading
+    must stay bit-exact across refactors."""
+    tr = NetTrainer(parse_config_string(GOLDEN_V2_CONF))
+    with open(os.path.join(FIXTURES, 'golden_v2.model'), 'rb') as f:
+        assert int.from_bytes(f.read(4), 'little', signed=True) == 0
+        tr.load_model(f)
+    assert tr.epoch_counter == 7
+    w = np.asarray(tr.params['0']['wmat'])
+    assert w.shape == (3, 3, 2, 8)             # HWIO, grouped: cin_g=4/2
+    np.testing.assert_allclose(float(w.sum()), -0.14391812682151794,
+                               rtol=1e-6)
+    assert set(tr.params['1']) == {'wmat', 'bias'}    # BN gamma/beta
+    assert set(tr.params['2']) == {'bias'}            # prelu slope
+    assert 'bias' not in tr.params['8']               # no_bias fullc
+    assert '7' not in tr.params                       # share[fs] aliases 5
+    x = np.load(os.path.join(FIXTURES, 'golden_v2_input.npy'))
+    batch = DataBatch(x, np.zeros((4, 1), np.float32))
+    want = np.load(os.path.join(FIXTURES, 'golden_v2_pred.npy'))
+    np.testing.assert_array_equal(tr.predict(batch), want)
+    want_scores = np.load(os.path.join(FIXTURES, 'golden_v2_scores.npy'))
+    got_scores = tr.extract_feature(batch, 'top[-1]')
+    np.testing.assert_allclose(got_scores, want_scores, rtol=1e-5)
+
+
+def test_golden_v2_blob_roundtrip_bitexact():
+    """save(load(golden)) reproduces the golden bytes exactly — every
+    to_disk_layout branch is the inverse of its from_disk_layout."""
+    import io as _io
+    tr = NetTrainer(parse_config_string(GOLDEN_V2_CONF))
+    with open(os.path.join(FIXTURES, 'golden_v2.model'), 'rb') as f:
+        golden = f.read()
+    tr.load_model(_io.BytesIO(golden[4:]))
+    out = _io.BytesIO()
+    out.write((0).to_bytes(4, 'little'))
+    tr.save_model(out)
+    assert out.getvalue() == golden
+
+
 NAN_CONF = """
 netconfig = start
 layer[0->1] = fullc:f1

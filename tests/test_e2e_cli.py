@@ -237,3 +237,114 @@ def test_transformer_example_runs(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr)
     losses = re.findall(r'loss ([0-9.]+)', r.stdout)
     assert len(losses) >= 2 and float(losses[-1]) < float(losses[0])
+
+
+def test_partition_maker_multipart_dataset(tmp_path):
+    """imgbin_partition_maker splits + packs; the multi-part dataset reads
+    back through image_conf_prefix/image_conf_ids (both imgbin and the
+    two-stage imgbinx)."""
+    make_quadrant_images(str(tmp_path), 24)
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    subprocess.check_call(
+        [sys.executable, os.path.join(REPO, 'tools',
+                                      'imgbin_partition_maker.py'),
+         '--img_list', 'train.lst', '--img_root', './',
+         '--prefix', 'part%02d', '--out', 'parts',
+         '--partition_size', '1', '--shuffle', '1', '--pack'],
+        cwd=str(tmp_path), env=env)
+    parts = sorted(os.listdir(tmp_path / 'parts'))
+    nbin = sum(p.endswith('.bin') for p in parts)
+    assert nbin >= 1
+    assert (tmp_path / 'Gen.mk').exists()
+    from cxxnet_tpu.io.data import create_iterator
+    for kind in ('imgbin', 'imgbinx'):
+        cfg = [('iter', kind),
+               ('image_conf_prefix', str(tmp_path / 'parts' / 'part%02d')),
+               ('image_conf_ids', f'1-{nbin}'),
+               ('input_shape', '3,24,24'), ('batch_size', '4'),
+               ('silent', '1')]
+        it = create_iterator(cfg)
+        it.init()
+        seen = [int(i) for b in it
+                for i in b.inst_index[:b.batch_size - b.num_batch_padd]]
+        assert sorted(seen) == list(range(24)), kind
+
+
+def test_kaggle_bowl_workflow(tmp_path):
+    """The full kaggle_bowl predict workflow: gen_img_list over a class
+    folder tree -> im2bin -> train -> task=pred_raw raw probability rows ->
+    make_submission.py csv (reference example/kaggle_bowl)."""
+    import csv
+    bowl = os.path.join(REPO, 'example', 'kaggle_bowl')
+    rng = np.random.RandomState(1)
+    # class folder tree + sample_submission head
+    classes = ['acantharia', 'copepod', 'diatom']
+    for ci, cls in enumerate(classes):
+        d = tmp_path / 'train' / cls
+        d.mkdir(parents=True)
+        for k in range(6):
+            img = np.zeros((24, 24, 3), np.uint8)
+            img[ci * 8:(ci + 1) * 8, :, :] = rng.randint(130, 255, (8, 24, 3))
+            Image.fromarray(img).save(d / f'{cls}{k}.png')
+    with open(tmp_path / 'sample_submission.csv', 'w') as f:
+        f.write('image,' + ','.join(classes) + '\n')
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+
+    def run(script, *args):
+        subprocess.check_call([sys.executable, os.path.join(bowl, script),
+                               *args], cwd=str(tmp_path), env=env)
+
+    run('gen_img_list.py', 'train', 'sample_submission.csv',
+        str(tmp_path / 'train'), 'img.lst')
+    subprocess.check_call(
+        [sys.executable, os.path.join(REPO, 'tools', 'im2bin.py'),
+         'img.lst', './', 'train.bin'], cwd=str(tmp_path), env=env)
+    conf = tmp_path / 'bowl_mini.conf'
+    conf.write_text("""
+data = train
+iter = imgbin
+  image_list = img.lst
+  image_bin = train.bin
+iter = end
+netconfig = start
+layer[0->1] = flatten
+layer[1->2] = fullc:f1
+  nhidden = 16
+layer[2->3] = relu
+layer[3->4] = fullc:f2
+  nhidden = 3
+layer[4->4] = softmax
+netconfig = end
+input_shape = 3,24,24
+batch_size = 6
+dev = cpu
+eta = 0.05
+momentum = 0.9
+num_round = 6
+metric = error
+divideby = 256
+""")
+    _run_cli(str(conf), str(tmp_path))
+    pred_conf = tmp_path / 'predraw.conf'
+    pred_conf.write_text(conf.read_text().replace(
+        'data = train', 'pred = test.txt', 1)
+        + '\ntask = pred_raw\nmodel_in = ./models/0006.model\n')
+    _run_cli(str(pred_conf), str(tmp_path))
+    rows = np.loadtxt(tmp_path / 'test.txt')
+    assert rows.shape == (18, 3)
+    np.testing.assert_allclose(rows.sum(axis=1), 1.0, atol=1e-4)
+    run('make_submission.py', 'sample_submission.csv', 'img.lst',
+        'test.txt', 'out.csv')
+    with open(tmp_path / 'out.csv', newline='') as f:
+        got = list(csv.reader(f))
+    assert got[0] == ['image'] + classes
+    assert len(got) == 19
+    # predictions should have learned the class structure: argmax matches
+    # the lst labels for most rows
+    lst = {os.path.basename(l.rstrip('\n').split('\t')[2]):
+           int(l.split('\t')[1]) for l in open(tmp_path / 'img.lst')}
+    hits = sum(int(np.argmax([float(v) for v in row[1:]])) == lst[row[0]]
+               for row in got[1:])
+    assert hits >= 14, hits

@@ -31,3 +31,34 @@ def test_example_conf_builds(conf):
     # final node exists and has positive size
     last = cfg.layers[-1].nindex_out[-1]
     assert net.node_specs[last].flat_size > 0
+
+
+# The north-star compatibility claim: the LITERAL, unmodified reference
+# example confs parse, build a net graph, and shape-infer in this framework
+# (BASELINE.json: "driven by the unmodified example/ .conf files").  A
+# parser regression cannot silently break verbatim-conf compatibility.
+REFERENCE_EXAMPLES = sorted(
+    p for p in glob.glob('/root/reference/example/*/*.conf')
+    if _is_net_conf(p))
+
+
+@pytest.mark.skipif(not REFERENCE_EXAMPLES,
+                    reason='reference tree not present')
+@pytest.mark.parametrize('conf', REFERENCE_EXAMPLES,
+                         ids=[p.split('/example/')[-1]
+                              for p in REFERENCE_EXAMPLES])
+def test_reference_conf_builds_verbatim(conf):
+    pairs = parse_config_file(conf)
+    cfg = NetConfig()
+    cfg.configure(pairs)
+    assert cfg.num_layers > 0
+    net = Net(cfg)
+    last = cfg.layers[-1].nindex_out[-1]
+    assert net.node_specs[last].flat_size > 0
+    # the known layer counts of the reference model zoo, pinned so a
+    # grammar change that silently drops layers is caught
+    expected = {'ImageNet.conf': 24, 'MNIST.conf': 4, 'MNIST_CONV.conf': 8,
+                'bowl.conf': 17, 'pred.conf': 17}
+    name = os.path.basename(conf)
+    if name in expected:
+        assert cfg.num_layers == expected[name], name

@@ -357,3 +357,23 @@ def test_io_throughput_imgbin_vs_imgbinx(tmp_path):
         assert cnt == 128
     print(f'test_io throughput inst/s: {rates}')
     assert rates['imgbinx'] > 0.3 * rates['imgbin']
+
+
+def test_imgbin_worker_sharding_partitions_pages(tmp_path, small_pages):
+    """dist_num_worker=N on a single file: workers own disjoint pages
+    covering the whole dataset, shuffled or not (the sharded paths seek
+    only owned pages)."""
+    lst, binp = _write_bin_dataset(str(tmp_path), n=30)
+    for shuffle in ('0', '1'):
+        per_worker = []
+        for rank in (0, 1):
+            cfg = [('iter', 'imgbin'), ('image_list', lst),
+                   ('image_bin', binp), ('input_shape', '3,6,6'),
+                   ('batch_size', '1'), ('shuffle', shuffle),
+                   ('dist_num_worker', '2'), ('dist_worker_rank', str(rank)),
+                   ('silent', '1')]
+            it = create_iterator(cfg)
+            it.init()
+            per_worker.append({int(i) for b in it for i in b.inst_index})
+        assert per_worker[0].isdisjoint(per_worker[1]), shuffle
+        assert per_worker[0] | per_worker[1] == set(range(30)), shuffle

@@ -200,8 +200,7 @@ def test_train_metric_counts_tail_instances():
     tr.start_round(0)
     for b in _padded_batches(x, y, 100, 0.0):
         tr.update(b)
-    pending, tr._pending_train_eval = tr._pending_train_eval, None
-    tr._drain_train_eval(pending)   # the last step's deferred readback
+    tr.flush_train_metrics()        # the last step's deferred readback
     assert tr.train_metric.evals[0].cnt_inst == 250
 
 

@@ -114,13 +114,15 @@ def test_moe_all_to_all_matches_reference():
                           capacity_factor=cf),
         mesh=mesh,
         in_specs=(P('data'), P(), P('data'), P('data')),
-        out_specs=P('data'), check_vma=False)
-    got = fn(x, gate_w, w1, w2)
+        out_specs=(P('data'), {'balance_loss': P(), 'drop_frac': P()}),
+        check_vma=False)
+    got, got_aux = fn(x, gate_w, w1, w2)
+    assert float(got_aux['drop_frac']) == 0.0
     # oracle shard-by-shard (capacity is per-shard in the sharded run)
     # same per-expert capacity as the sharded run: capacity is computed
     # from local token count and GLOBAL expert count in both cases
     refs = [moe_ffn_reference(x[i * t:(i + 1) * t], gate_w, w1, w2,
-                              capacity_factor=cf)
+                              capacity_factor=cf)[0]
             for i in range(n)]
     np.testing.assert_allclose(np.asarray(got),
                                np.asarray(jnp.concatenate(refs)),
@@ -134,9 +136,12 @@ def test_moe_drops_over_capacity():
     gate_w = jnp.zeros((d, 2), jnp.float32).at[:, 0].set(1.0)
     w1 = jnp.ones((2, d, f), jnp.float32)
     w2 = jnp.ones((2, f, d), jnp.float32)
-    out = moe_ffn_reference(x, gate_w, w1, w2, capacity_factor=1.0 / 3)
+    out, aux = moe_ffn_reference(x, gate_w, w1, w2, capacity_factor=1.0 / 3)
     nonzero_rows = (np.abs(np.asarray(out)).sum(-1) > 0).sum()
     assert nonzero_rows == 1
+    # 5 of 6 tokens dropped; all routed to expert 0 of 2 -> balance = 2*1*1
+    np.testing.assert_allclose(float(aux['drop_frac']), 5.0 / 6, atol=1e-6)
+    assert float(aux['balance_loss']) > 1.5
 
 
 # --- composed transformer step -------------------------------------------
@@ -161,7 +166,11 @@ def test_transformer_step_matches_oracle(pp, dp, sp, tp, experts):
         vocab_size=32, d_model=16, num_heads=4, d_ff=32,
         num_stages=pp, seq_len=16, num_experts=experts,
         num_microbatches=2, attn='ring',
-        capacity_factor=float(max(experts, 1) * 8))
+        capacity_factor=float(max(experts, 1) * 8),
+        # the sharded run computes the balance loss per shard, the oracle
+        # over the whole batch — only the weight-0 loss is exactly equal;
+        # the aux-loss path has its own dedicated tests below
+        balance_loss_weight=0.0)
     mesh = tfm.build_transformer_mesh(8, pp, dp, sp, tp,
                                       devices=_devices(8))
     rng = np.random.RandomState(4)
@@ -170,7 +179,10 @@ def test_transformer_step_matches_oracle(pp, dp, sp, tp, experts):
     tokens, labels = _make_inputs(cfg, batch)
 
     step = tfm.make_train_step(cfg, mesh, lr=0.1)
-    new_params, loss = step(params, tokens, labels)
+    new_params, loss, aux = step(params, tokens, labels)
+    if experts:
+        assert float(aux['balance_loss']) >= 0.99   # >= 1 at uniform
+        assert 0.0 <= float(aux['drop_frac']) <= 1.0
 
     ref_loss = tfm.reference_loss(params, tokens, labels, cfg)
     np.testing.assert_allclose(float(loss), float(ref_loss),
@@ -200,7 +212,7 @@ def test_transformer_loss_decreases():
     step = tfm.make_train_step(cfg, mesh, lr=0.2)
     losses = []
     for _ in range(10):
-        params, loss = step(params, tokens, labels)
+        params, loss, _aux = step(params, tokens, labels)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, losses
 
@@ -210,3 +222,35 @@ def test_local_attn_rejected_on_seq_mesh():
     mesh = tfm.build_transformer_mesh(8, 2, 2, 2, 1, devices=_devices(8))
     with pytest.raises(ValueError, match='block-diagonal'):
         tfm.make_train_step(cfg, mesh)
+
+
+def test_moe_balance_loss_fights_collapse():
+    """With the Switch aux loss weighted in, a gate initialized to send
+    every token to one expert spreads out; with weight 0 it stays
+    collapsed (single-device oracle, differentiable-through-P_e check)."""
+    d, f, e, t = 8, 16, 4, 64
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(t, d).astype(np.float32))
+    x = x.at[:, 0].set(jnp.abs(x[:, 0]) + 1.0)   # feature 0 always positive
+    w1 = jnp.asarray(rng.randn(e, d, f).astype(np.float32) * 0.2)
+    w2 = jnp.asarray(rng.randn(e, f, d).astype(np.float32) * 0.2)
+    gate0 = jnp.zeros((d, e), jnp.float32).at[0, 0].set(4.0)
+
+    def max_route_frac(gate_w):
+        probs = jax.nn.softmax(x @ gate_w, axis=-1)
+        sel = jax.nn.one_hot(jnp.argmax(probs, -1), e)
+        return float(sel.mean(0).max())
+
+    def run(weight):
+        gate_w = gate0
+        for _ in range(50):
+            def loss(gw):
+                out, aux = moe_ffn_reference(x, gw, w1, w2,
+                                             capacity_factor=2.0)
+                return (out ** 2).mean() + weight * aux['balance_loss']
+            gate_w = gate_w - 1.0 * jax.grad(loss)(gate_w)
+        return max_route_frac(gate_w)
+
+    assert max_route_frac(gate0) == 1.0          # starts collapsed
+    assert run(0.0) > 0.9, 'control: no pressure, stays collapsed'
+    assert run(1.0) < 0.6, 'aux loss failed to spread experts'

@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Partitioned imgbin dataset packer.
+
+Port of ``/root/reference/tools/imgbin-partition-maker.py``: splits a big
+``.lst`` into size-bounded partitions named ``(prefix % i)`` and emits a
+Makefile whose rules pack each partition with im2bin — the multi-part
+layout consumed by ``image_conf_prefix`` / ``image_conf_ids``
+(``iter_thread_imbin-inl.hpp:225-278``).  ``--pack`` additionally runs the
+in-tree packer directly so no ``make`` step is needed.
+
+Example::
+
+    python tools/imgbin_partition_maker.py --img_list train.lst \\
+        --img_root ./images/ --prefix part%02d --out ./parts \\
+        --partition_size 256 --shuffle 1 --pack
+
+Then in the conf::
+
+    image_conf_prefix = ./parts/part%02d
+    image_conf_ids = 1-8
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import shlex
+import subprocess
+import sys
+
+
+def split_partitions(lines, img_root, part_bytes):
+    """Greedy split: a new partition starts when adding the next image
+    would exceed the size budget (file bytes + BinaryPage header growth,
+    like the reference's ``sz + 10240`` guard)."""
+    parts, cur, sz = [], [], 0
+    for item in lines:
+        path = item.rstrip('\n').split('\t')[2]
+        fsz = os.path.getsize(os.path.join(img_root, path))
+        if cur and sz + fsz + 10240 > part_bytes:
+            parts.append(cur)
+            cur, sz = [], 0
+        cur.append(item)
+        sz += fsz + (len(cur) + 2) * 4
+    if cur:
+        parts.append(cur)
+    return parts
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Generate partitioned .lst files + a Makefile (or pack '
+                    'directly with --pack) for multi-part imgbin datasets')
+    ap.add_argument('--img_list', required=True,
+                    help='path to the list of all images')
+    ap.add_argument('--img_root', required=True,
+                    help='prefix path of the file paths in img_list')
+    ap.add_argument('--im2bin', default=' '.join(shlex.quote(p) for p in (
+        sys.executable, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), 'im2bin.py'))),
+        help='im2bin command for the generated Makefile rules '
+             '(shell-quoted)')
+    ap.add_argument('--partition_size', default='256',
+                    help='max size of a single bin file, MB')
+    ap.add_argument('--shuffle', default='0',
+                    help='shuffle the list before splitting (1/0)')
+    ap.add_argument('--prefix', required=True,
+                    help='printf-style partition name, e.g. part%%02d')
+    ap.add_argument('--out', required=True,
+                    help='output folder for the partition lists/bins')
+    ap.add_argument('--makefile', default='Gen.mk',
+                    help='name of the generated Makefile')
+    ap.add_argument('--pack', action='store_true',
+                    help='run im2bin on every partition now instead of '
+                         'only emitting the Makefile')
+    ap.add_argument('--seed', type=int, default=888)
+    args = ap.parse_args(argv)
+
+    with open(args.img_list) as f:
+        lines = f.readlines()
+    if args.shuffle == '1':
+        random.Random(args.seed).shuffle(lines)
+
+    os.makedirs(args.out, exist_ok=True)
+    parts = split_partitions(lines, args.img_root,
+                             int(args.partition_size) << 20)
+    rules, bins = [], []
+    for i, part in enumerate(parts, start=1):
+        stem = os.path.join(args.out, args.prefix % i)
+        with open(stem + '.lst', 'w') as fw:
+            fw.writelines(part)
+        bins.append(stem + '.bin')
+        q = shlex.quote
+        rules.append(f'{stem}.bin: {stem}.lst\n\t{args.im2bin} '
+                     f'{q(stem + ".lst")} {q(args.img_root)} '
+                     f'{q(stem + ".bin")}')
+    with open(args.makefile, 'w') as fo:
+        fo.write('all: ' + ' '.join(bins) + '\n\n')
+        fo.write('\n\n'.join(rules) + '\n')
+    print(f'{len(parts)} partition list(s) under {args.out}; '
+          f'Makefile: {args.makefile}')
+    print(f'conf: image_conf_prefix = {os.path.join(args.out, args.prefix)}')
+    print(f'      image_conf_ids = 1-{len(parts)}')
+
+    if args.pack:
+        for b in bins:
+            stem = b[:-4]
+            subprocess.check_call(shlex.split(args.im2bin) +
+                                  [stem + '.lst', args.img_root, b])
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
