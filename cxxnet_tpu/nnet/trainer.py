@@ -413,11 +413,16 @@ class NetTrainer:
             return 0.0
 
     # --- evaluation / prediction ------------------------------------------
-    def _forward_nodes(self, batch, node_ids: List[int]) -> List[np.ndarray]:
+    def _forward_nodes_async(self, batch, node_ids: List[int]):
+        """Launch the forward pass; returns device arrays (no readback)."""
         extra = tuple(self._shard_batch(e) for e in batch.extra_data)
         values = self._forward_fn(self.params, self._shard_batch(batch.data),
                                   extra, self.round)
-        return [np.asarray(values[i]) for i in node_ids]
+        return [values[i] for i in node_ids]
+
+    def _forward_nodes(self, batch, node_ids: List[int]) -> List[np.ndarray]:
+        return [np.asarray(v)
+                for v in self._forward_nodes_async(batch, node_ids)]
 
     def evaluate(self, data_iter, name: str) -> str:
         """Run metrics over an iterator; returns the reference's stderr
@@ -433,13 +438,28 @@ class NetTrainer:
         if data_iter is None:
             return ret
         self.metric.clear()
+        # one-batch software pipeline: batch i+1's forward is enqueued
+        # before batch i's outputs are read back, so the device computes
+        # while the host blocks on the transfer (the reference's
+        # eval-request copies overlap the same way, nnet_impl:232-241)
+        pending = None
+
+        def _consume(p):
+            outs, label_info, n = p
+            self.metric.add_eval([np.asarray(o)[:n] for o in outs],
+                                 label_info.slice(n))
+
         for batch in data_iter:
-            outs = self._forward_nodes(batch, self._eval_node_ids)
+            outs = self._forward_nodes_async(batch, self._eval_node_ids)
             n = batch.batch_size - batch.num_batch_padd
             label_info = _HostLabelInfo(np.asarray(batch.label),
                                         self.net_cfg.label_name_map,
                                         self.net_cfg.label_range)
-            self.metric.add_eval([o[:n] for o in outs], label_info.slice(n))
+            prev, pending = pending, (outs, label_info, n)
+            if prev is not None:
+                _consume(prev)
+        if pending is not None:
+            _consume(pending)
         return ret + self.metric.print(name)
 
     def predict(self, batch) -> np.ndarray:

@@ -172,10 +172,27 @@ lrn_pallas.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)
 
 # --- tiled matmul (fullc) -------------------------------------------------
 
-def _matmul_kernel(a_ref, b_ref, o_ref):
+def _matmul_kernel_wholek(a_ref, b_ref, o_ref):
+    """Scratch-free whole-K tile: the fallback when TPU memory spaces are
+    unavailable (interpret-mode CPU installs without pallas.tpu)."""
     o_ref[:] = jnp.dot(a_ref[:], b_ref[:],
-                       preferred_element_type=jnp.float32
-                       ).astype(o_ref.dtype)
+                       preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref):
+    """Grid (m, n, k): K is innermost so the f32 accumulator tile stays in
+    VMEM scratch across K steps (keeping whole K per tile VMEM-OOMs at
+    AlexNet's 9216-wide fc6)."""
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(a_ref[:], b_ref[:],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
 
 
 @jax.custom_vjp
@@ -198,22 +215,40 @@ def _matmul_vjp_bwd(res, g):
 pallas_matmul.defvjp(_matmul_vjp_fwd, _matmul_vjp_bwd)
 
 
-def _matmul_impl(a, b, tile_m: int = 256, tile_n: int = 256):
-    """K is kept whole per tile (fits VMEM for fullc-sized layers)."""
+def _matmul_impl(a, b, tile_m: int = 256, tile_n: int = 256,
+                 tile_k: int = 512):
     m, k = a.shape
     k2, n = b.shape
     assert k == k2
-    pm, pn = (-m) % tile_m, (-n) % tile_n
-    ap = jnp.pad(a, ((0, pm), (0, 0))) if pm else a
-    bp = jnp.pad(b, ((0, 0), (0, pn))) if pn else b
-    mm, nn = ap.shape[0], bp.shape[1]
+    if pltpu is None:
+        # no TPU memory spaces (exotic CPU-only install): scratch-free
+        # whole-K kernel — VMEM limits don't exist in interpret mode
+        pm, pn = (-m) % tile_m, (-n) % tile_n
+        ap = jnp.pad(a, ((0, pm), (0, 0))) if pm else a
+        bp = jnp.pad(b, ((0, 0), (0, pn))) if pn else b
+        mm, nn = ap.shape[0], bp.shape[1]
+        out = pl.pallas_call(
+            _matmul_kernel_wholek,
+            out_shape=jax.ShapeDtypeStruct((mm, nn), a.dtype),
+            grid=(mm // tile_m, nn // tile_n),
+            in_specs=[_block_spec((tile_m, k), lambda i, j: (i, 0)),
+                      _block_spec((k, tile_n), lambda i, j: (0, j))],
+            out_specs=_block_spec((tile_m, tile_n), lambda i, j: (i, j)),
+            interpret=_interpret(),
+        )(ap, bp)
+        return out[:m, :n]
+    pm, pn, pk = (-m) % tile_m, (-n) % tile_n, (-k) % tile_k
+    ap = jnp.pad(a, ((0, pm), (0, pk))) if pm or pk else a
+    bp = jnp.pad(b, ((0, pk), (0, pn))) if pk or pn else b
+    mm, nn, kk = ap.shape[0], bp.shape[1], ap.shape[1]
     out = pl.pallas_call(
         _matmul_kernel,
         out_shape=jax.ShapeDtypeStruct((mm, nn), a.dtype),
-        grid=(mm // tile_m, nn // tile_n),
-        in_specs=[_block_spec((tile_m, k), lambda i, j: (i, 0)),
-                  _block_spec((k, tile_n), lambda i, j: (0, j))],
-        out_specs=_block_spec((tile_m, tile_n), lambda i, j: (i, j)),
+        grid=(mm // tile_m, nn // tile_n, kk // tile_k),
+        in_specs=[_block_spec((tile_m, tile_k), lambda i, j, t: (i, t)),
+                  _block_spec((tile_k, tile_n), lambda i, j, t: (t, j))],
+        out_specs=_block_spec((tile_m, tile_n), lambda i, j, t: (i, j)),
+        scratch_shapes=[_scratch((tile_m, tile_n))],
         interpret=_interpret(),
     )(ap, bp)
     return out[:m, :n]
@@ -406,7 +441,9 @@ def _flash_blocks(seq, block):
 
 def _scratch(shape, dtype=jnp.float32):
     if pltpu is None:          # pragma: no cover - exotic installs only
-        raise RuntimeError('flash_attention needs pallas TPU memory spaces')
+        raise RuntimeError(
+            'this pallas kernel needs TPU memory spaces '
+            '(jax.experimental.pallas.tpu unavailable)')
     return pltpu.VMEM(shape, dtype)
 
 

@@ -21,6 +21,15 @@ Sharding rules for the 2-D mesh ``(data, model)``:
   (disabled for grouped conv where channel locality matters),
 * everything else replicated.
 
+Scope note: this CNN tensor parallelism is **weight-sharding only** —
+activations stay replicated, so every sharded layer boundary implies an
+all-gather that XLA inserts.  That is deliberate: for the CNN zoo (AlexNet
+era, model fits one chip many times over) TP is a capability demo exercised
+by the multichip dryrun, not a perf path — data parallelism is the
+production axis.  The fully sharded-activation design (row/column parallel
+pairs with psum, sequence/expert axes) lives in ``models/transformer.py``,
+where model scale actually demands it.
+
 Optimizer state and gradient accumulators inherit the param sharding, so
 the optimizer update runs fully sharded — the TPU equivalent of the
 reference's ``update_on_server`` without a server.

@@ -348,3 +348,56 @@ divideby = 256
     hits = sum(int(np.argmax([float(v) for v in row[1:]])) == lst[row[0]]
                for row in got[1:])
     assert hits >= 14, hits
+
+
+def test_cli_rec_at_5_on_1000_classes(tmp_path):
+    """rec@1/rec@5 metrics through the CLI on synthetic 1000-class data
+    (the ImageNet metric pair, utils/metric.h:147-171): a memorizing net
+    must reach rec@5 ~ 1.0 on its train set while an untrained net sits
+    near 5/1000."""
+    rng = np.random.RandomState(9)
+    lines = []
+    for i in range(40):
+        img = rng.randint(0, 255, (12, 12, 3), np.uint8)
+        Image.fromarray(img).save(tmp_path / f'i{i}.png')
+        lines.append(f'{i}\t{rng.randint(0, 1000)}\ti{i}.png')
+    (tmp_path / 'a.lst').write_text('\n'.join(lines) + '\n')
+    conf = tmp_path / 'rec.conf'
+    conf.write_text("""
+data = train
+iter = img
+  image_list = a.lst
+  image_root = ./
+iter = end
+eval = trainset
+iter = img
+  image_list = a.lst
+  image_root = ./
+iter = end
+netconfig = start
+layer[0->1] = flatten
+layer[1->2] = fullc:f1
+  nhidden = 128
+layer[2->3] = relu
+layer[3->4] = fullc:f2
+  nhidden = 1000
+layer[4->4] = softmax
+netconfig = end
+input_shape = 3,12,12
+batch_size = 8
+dev = cpu
+eta = 0.05
+momentum = 0.9
+num_round = 60
+metric[label] = rec@5
+metric[label] = rec@1
+divideby = 256
+silent = 1
+""")
+    r = _run_cli(str(conf), str(tmp_path))
+    rec5 = re.findall(r'trainset-rec@5:([0-9.eE+-]+)', r.stderr)
+    rec1 = re.findall(r'trainset-rec@1:([0-9.eE+-]+)', r.stderr)
+    assert rec5 and rec1, r.stderr
+    assert float(rec5[0]) < 0.3, 'untrained rec@5 should be near chance'
+    assert float(rec5[-1]) > 0.9, (rec5[0], rec5[-1])
+    assert float(rec1[-1]) <= float(rec5[-1]) + 1e-9

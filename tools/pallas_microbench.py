@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Per-op microbenchmark: Pallas kernels vs their XLA lowerings on the
+real chip, at the shapes the framework actually runs (AlexNet LRN/fullc,
+transformer attention).
+
+    python tools/pallas_microbench.py [--steps 50] [--json out.json]
+
+Each op is timed fwd-only and fwd+bwd (value_and_grad through the op),
+median of repeated timed loops after compile+warmup.  Results feed
+BASELINE.md's kernel table and decide the default `use_pallas` state
+(ops/pallas_kernels.py: pallas wins -> enabled by default).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax                                                     # noqa: E402
+import jax.numpy as jnp                                        # noqa: E402
+import numpy as np                                             # noqa: E402
+
+
+# one jit object: retraces only per distinct leaf shape/dtype, and the
+# warmup _sync in _time_fn absorbs that trace before anything is timed
+_FETCH_FIRST = jax.jit(lambda x: x.ravel()[0])
+
+
+def _sync(out) -> float:
+    """Force REAL completion: fetch one element to host.  Over the remote
+    (axon) tunnel, ``block_until_ready`` can acknowledge before the chip
+    finishes; a 4-byte device_get cannot."""
+    leaf = jax.tree.leaves(out)[0]
+    return float(np.asarray(_FETCH_FIRST(leaf)))
+
+
+def _time_fn(fn, args, steps: int, reps: int = 3) -> float:
+    """Median seconds per call over ``reps`` timed loops of ``steps``."""
+    out = fn(*args)                       # compile
+    _sync(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        _sync(out)
+        times.append((time.perf_counter() - t0) / steps)
+    return statistics.median(times)
+
+
+def _grad_sum(fn):
+    """fwd+bwd probe: grad of sum(fn) wrt the first array argument(s)."""
+    def loss(*args):
+        return jnp.sum(fn(*args).astype(jnp.float32))
+    return jax.grad(loss)
+
+
+def bench_pair(name, xla_fn, pallas_fn, args, steps, results):
+    for tag, wrap in (('fwd', jax.jit),
+                      ('fwd+bwd', lambda f: jax.jit(_grad_sum(f)))):
+        t_x = _time_fn(wrap(xla_fn), args, steps)
+        t_p = _time_fn(wrap(pallas_fn), args, steps)
+        speedup = t_x / t_p
+        results.append({'op': name, 'pass': tag,
+                        'xla_us': round(t_x * 1e6, 1),
+                        'pallas_us': round(t_p * 1e6, 1),
+                        'pallas_speedup': round(speedup, 3)})
+        print(f'{name:28s} {tag:8s} xla {t_x * 1e6:9.1f}us  '
+              f'pallas {t_p * 1e6:9.1f}us  speedup {speedup:6.3f}x',
+              flush=True)
+
+
+def lrn_xla(x, nsize, alpha, beta, knorm):
+    """The layer's default XLA path (layers/norm.py math)."""
+    sq = (x * x).astype(jnp.float32)
+    half_lo = (nsize - 1) // 2
+    half_hi = nsize - 1 - half_lo
+    win = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add, (1, 1, 1, nsize), (1, 1, 1, 1),
+        [(0, 0), (0, 0), (0, 0), (half_lo, half_hi)])
+    norm = knorm + (alpha / nsize) * win
+    return (x.astype(jnp.float32) * norm ** (-beta)).astype(x.dtype)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=30)
+    ap.add_argument('--json', default=None)
+    ap.add_argument('--dtype', default='bfloat16',
+                    choices=['bfloat16', 'float32'])
+    ap.add_argument('--only', default='',
+                    help='comma list of op groups: lrn,matmul,attn')
+    args = ap.parse_args()
+    only = set(args.only.split(',')) if args.only else None
+
+    def want(group):
+        return only is None or group in only
+
+    from cxxnet_tpu.ops.pallas_kernels import (flash_attention, lrn_pallas,
+                                               pallas_matmul)
+    from cxxnet_tpu.parallel.sequence import attention_reference
+
+    dev = jax.devices()[0]
+    print(f'device: {dev.device_kind} ({dev.platform})', flush=True)
+    dtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
+    rng = np.random.RandomState(0)
+    results = []
+
+    # --- LRN at AlexNet shapes (NHWC) ---------------------------------
+    for b, h, w, c in (((256, 27, 27, 96), (256, 13, 13, 256))
+                       if want('lrn') else ()):
+        x = jnp.asarray(rng.randn(b, h, w, c), dtype)
+        bench_pair(f'lrn {b}x{h}x{w}x{c}',
+                   functools.partial(lrn_xla, nsize=5, alpha=1e-4,
+                                     beta=0.75, knorm=1.0),
+                   lambda y: lrn_pallas(y, 5, 1e-4, 0.75, 1.0),
+                   (x,), args.steps, results)
+
+    # --- fullc matmuls at AlexNet shapes ------------------------------
+    for m, k, n in (((256, 9216, 4096), (256, 4096, 4096),
+                     (256, 4096, 1000)) if want('matmul') else ()):
+        a = jnp.asarray(rng.randn(m, k) * 0.05, dtype)
+        bmat = jnp.asarray(rng.randn(k, n) * 0.05, dtype)
+        bench_pair(f'matmul {m}x{k}x{n}',
+                   lambda p, q: jnp.dot(p, q), pallas_matmul,
+                   (a, bmat), args.steps, results)
+
+    # --- attention at transformer shapes ------------------------------
+    for b, s, heads, d in (((4, 1024, 8, 64), (2, 4096, 8, 64))
+                           if want('attn') else ()):
+        q = jnp.asarray(rng.randn(b, s, heads, d) * 0.1, dtype)
+        k = jnp.asarray(rng.randn(b, s, heads, d) * 0.1, dtype)
+        v = jnp.asarray(rng.randn(b, s, heads, d) * 0.1, dtype)
+        for causal in (False, True):
+            bench_pair(
+                f'attn b{b} s{s} h{heads} d{d}'
+                f'{" causal" if causal else ""}',
+                functools.partial(attention_reference, causal=causal),
+                functools.partial(flash_attention, causal=causal),
+                (q, k, v), args.steps, results)
+
+    if args.json:
+        with open(args.json, 'w') as f:
+            json.dump({'device': dev.device_kind, 'dtype': args.dtype,
+                       'results': results}, f, indent=1)
+        print(f'wrote {args.json}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
