@@ -152,10 +152,21 @@ class ImageBinIterator(IIterator):
 
     def _page_stream(self, part, page_order=None):
         """Yield (page_idx, blobs); ``page_order=None`` streams the file
-        sequentially (native prefetch path), else seeks page-by-page —
-        pages are fixed 64MB records, hence random-access."""
+        sequentially, else reads page-by-page in the given order — pages
+        are fixed 64MB records, hence random-access.  Both paths prefer
+        the native C++ prefetching reader."""
         if page_order is None:
             yield from enumerate(self._iter_pages(self._bins[part]))
+            return
+        page_order = list(page_order)
+        from ..runtime.native import NativePageReader, native_order_available
+        if native_order_available():
+            reader = NativePageReader(self._bins[part], order=page_order)
+            try:
+                for pidx, page in zip(page_order, reader.iter_pages()):
+                    yield pidx, page
+            finally:
+                reader.close()
             return
         with open(self._bins[part], 'rb') as f:
             for pidx in page_order:

@@ -47,6 +47,11 @@ def _load():
         return None
     lib.cxr_open.restype = ctypes.c_void_p
     lib.cxr_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    if hasattr(lib, 'cxr_open_order'):      # older prebuilt .so lacks it
+        lib.cxr_open_order.restype = ctypes.c_void_p
+        lib.cxr_open_order.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.c_int]
     lib.cxr_next_page.restype = ctypes.c_int
     lib.cxr_next_page.argtypes = [ctypes.c_void_p]
     lib.cxr_get_obj.restype = ctypes.c_void_p
@@ -65,15 +70,34 @@ def native_available() -> bool:
     return _load() is not None
 
 
-class NativePageReader:
-    """Iterates the blobs of a BinaryPage stream with C++-side prefetch."""
+def native_order_available() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, 'cxr_open_order')
 
-    def __init__(self, path: str, prefetch_pages: int = 2):
+
+class NativePageReader:
+    """Iterates the blobs of a BinaryPage stream with C++-side prefetch.
+
+    ``order`` (a sequence of page indices) switches the reader thread to
+    seek-based random access — the imgbinx shuffled-epoch path — still
+    prefetching ``prefetch_pages`` ahead."""
+
+    def __init__(self, path: str, prefetch_pages: int = 2, order=None):
         lib = _load()
         if lib is None:
             raise RuntimeError('native runtime not available')
         self._lib = lib
-        self._h = lib.cxr_open(path.encode(), prefetch_pages)
+        if order is not None:
+            if not hasattr(lib, 'cxr_open_order'):
+                raise RuntimeError('native runtime lacks cxr_open_order '
+                                   '(rebuild runtime/)')
+            arr = np.ascontiguousarray(order, dtype=np.int64)
+            self._h = lib.cxr_open_order(
+                path.encode(),
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(arr), prefetch_pages)
+        else:
+            self._h = lib.cxr_open(path.encode(), prefetch_pages)
         if not self._h:
             raise IOError(f'cannot open {path}')
 
@@ -83,6 +107,9 @@ class NativePageReader:
         lib = self._lib
         while True:
             n = lib.cxr_next_page(self._h)
+            if n == -2:
+                raise RuntimeError('imgbin: truncated page (ordered read '
+                                   'past end of .bin)')
             if n < 0:
                 return
             page = []

@@ -46,6 +46,9 @@ struct Page {
 };
 
 // Bounded-ring page prefetcher: one reader thread, consumer pops in order.
+// With a page-index order list (imgbinx shuffled epochs) the reader seeks
+// page-by-page — pages are fixed-size records, hence random-access — so
+// shuffle costs no extra IO and prefetch still runs ahead of decode.
 struct PageStream {
   FILE* fp = nullptr;
   std::thread reader;
@@ -56,12 +59,20 @@ struct PageStream {
   bool eof = false;
   bool stop = false;
   std::unique_ptr<Page> current;
+  bool use_order = false;      // explicit: order=[] means read NOTHING
+  std::vector<int64_t> order;
+  size_t order_pos = 0;        // reader thread only
+  bool read_error = false;     // short read mid-order: error, not EOF
 
   ~PageStream() { Close(); }
 
-  bool Open(const char* path, int prefetch) {
+  bool Open(const char* path, int prefetch, const int64_t* idx, int n) {
     fp = fopen(path, "rb");
     if (!fp) return false;
+    if (idx) {
+      use_order = true;
+      if (n > 0) order.assign(idx, idx + n);
+    }
     max_ready = prefetch > 0 ? static_cast<size_t>(prefetch) : 2;
     reader = std::thread([this] { ReadLoop(); });
     return true;
@@ -69,11 +80,28 @@ struct PageStream {
 
   void ReadLoop() {
     for (;;) {
+      bool ok;
+      if (use_order && order_pos >= order.size()) {
+        std::lock_guard<std::mutex> lk(mu);
+        eof = true;
+        cv_get.notify_all();
+        return;
+      }
       auto page = std::make_unique<Page>();
-      size_t got = fread(page->buf.data(), 1, kPageBytes, fp);
-      bool ok = got == kPageBytes;
+      if (use_order) {
+        int64_t idx = order[order_pos++];
+        ok = fseeko(fp, static_cast<off_t>(idx) *
+                            static_cast<off_t>(kPageBytes), SEEK_SET) == 0 &&
+             fread(page->buf.data(), 1, kPageBytes, fp) == kPageBytes;
+      } else {
+        ok = fread(page->buf.data(), 1, kPageBytes, fp) == kPageBytes;
+      }
       std::unique_lock<std::mutex> lk(mu);
       if (!ok) {
+        // sequential mode ends at the first short read (tail) — that is
+        // the normal EOF; an ordered read that comes up short points past
+        // the file and must surface as an error, not silent truncation
+        read_error = use_order;
         eof = true;
         cv_get.notify_all();
         return;
@@ -85,11 +113,11 @@ struct PageStream {
     }
   }
 
-  // returns object count, or -1 at end of stream
+  // returns object count, -1 at end of stream, -2 on read error
   int NextPage() {
     std::unique_lock<std::mutex> lk(mu);
     cv_get.wait(lk, [this] { return !ready.empty() || eof || stop; });
-    if (ready.empty()) return -1;
+    if (ready.empty()) return read_error ? -2 : -1;
     current = std::move(ready.front());
     ready.pop_front();
     cv_put.notify_one();
@@ -128,7 +156,18 @@ extern "C" {
 
 void* cxr_open(const char* path, int prefetch_pages) {
   auto* s = new PageStream();
-  if (!s->Open(path, prefetch_pages)) {
+  if (!s->Open(path, prefetch_pages, nullptr, 0)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+// Open reading only the given page indices, in that order (seek-based).
+void* cxr_open_order(const char* path, const int64_t* order, int n,
+                     int prefetch_pages) {
+  auto* s = new PageStream();
+  if (!s->Open(path, prefetch_pages, order, n)) {
     delete s;
     return nullptr;
   }

@@ -78,3 +78,34 @@ def test_imgbin_iterator_uses_native_jpeg(tmp_path):
     assert len(batches) == 2
     assert batches[0].data.shape == (3, 3, 20, 20)
 
+
+def test_native_ordered_page_reader(tmp_path):
+    """cxr_open_order reads pages by index with seeks — arbitrary order,
+    repeats included (the imgbinx shuffled-epoch access pattern)."""
+    from cxxnet_tpu.runtime.native import native_order_available
+    if not native_order_available():
+        pytest.skip('runtime .so predates cxr_open_order')
+    pages = [[b'page0-a', b'page0-b'], [b'page1-a'], [b'page2-a', b'x' * 999]]
+    path = make_bin(tmp_path, pages)
+    order = [2, 0, 1, 0]
+    reader = NativePageReader(path, order=order)
+    got = list(reader.iter_pages())
+    reader.close()
+    assert got == [pages[i] for i in order]
+
+
+def test_native_ordered_reader_edge_cases(tmp_path):
+    from cxxnet_tpu.runtime.native import native_order_available
+    if not native_order_available():
+        pytest.skip('runtime .so predates cxr_open_order')
+    pages = [[b'p0'], [b'p1']]
+    path = make_bin(tmp_path, pages)
+    # empty order reads NOTHING (sharded worker owning no pages)
+    reader = NativePageReader(path, order=[])
+    assert list(reader.iter_pages()) == []
+    reader.close()
+    # an index past EOF is an error, not silent truncation
+    reader = NativePageReader(path, order=[0, 7])
+    with pytest.raises(RuntimeError, match='truncated'):
+        list(reader.iter_pages())
+    reader.close()

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Per-layer time breakdown of the AlexNet train step on the real chip.
+
+    python tools/alexnet_breakdown.py [--batch 256] [--json out.json]
+
+The jax profiler cannot trace through the remote (axon) tunnel, so this
+tool derives the MFU breakdown directly: it times the full optimizer step,
+the forward pass, and each parameterized/pooling/LRN layer in isolation
+(jitted at its exact activation shape, fwd and fwd+bwd), forcing real
+completion with 1-element fetches (block_until_ready acks early over the
+tunnel).  Layer times are lower bounds (isolated kernels skip fusion
+opportunities) but name where the step's time goes — the evidence the
+MFU-0.27 question needs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax                                                     # noqa: E402
+import jax.numpy as jnp                                        # noqa: E402
+import numpy as np                                             # noqa: E402
+
+_FETCH = jax.jit(lambda x: x.ravel()[0])
+
+
+def _sync(out):
+    return float(np.asarray(_FETCH(jax.tree.leaves(out)[0])))
+
+
+def _time(fn, args, steps=10, reps=3):
+    out = fn(*args)
+    _sync(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        _sync(out)
+        ts.append((time.perf_counter() - t0) / steps)
+    return statistics.median(ts)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--batch', type=int, default=256)
+    ap.add_argument('--json', default=None)
+    args = ap.parse_args()
+
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.layers import ForwardContext
+    from cxxnet_tpu.models import alexnet_conf
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    bs = args.batch
+    conf = alexnet_conf() + f"""
+batch_size = {bs}
+eta = 0.01
+momentum = 0.9
+metric = error
+eval_train = 0
+random_type = xavier
+compute_type = bfloat16
+"""
+    tr = NetTrainer(parse_config_string(conf))
+    tr.init_model()
+    rng = np.random.RandomState(0)
+    data = tr._shard_batch(
+        rng.randint(0, 256, (bs, 3, 227, 227), dtype=np.uint8))
+    label = tr._shard_batch(
+        rng.randint(0, 1000, (bs, 1)).astype(np.float32), cast=False)
+
+    # --- whole step & forward-only ------------------------------------
+    def full_step(d, l):
+        tr.update_on_device(d, l)
+        return tr.params['16']['bias']
+
+    t_step = _time(full_step, (data, label))
+    fwd = tr._forward_fn
+    t_fwd = _time(lambda d: fwd(tr.params, d, (), 0), (data,))
+    step_flops = tr.train_step_flops(data, label)
+    print(f'full train step: {t_step * 1e3:8.2f} ms   '
+          f'({step_flops / t_step / 1e12:.1f} TFLOP/s achieved)')
+    print(f'forward only:    {t_fwd * 1e3:8.2f} ms')
+
+    # --- per-layer isolation ------------------------------------------
+    net = tr.net
+    host = jax.device_get(tr.params)
+    rows = []
+    for i, info in enumerate(net.cfg.layers):
+        layer = net.layers[i]
+        if layer.type_name in ('relu', 'flatten', 'dropout', 'softmax'):
+            continue                      # elementwise: fused in practice
+        spec_in = [net.node_specs[j] for j in info.nindex_in]
+        xs = []
+        for sp in spec_in:
+            shape = ((bs, sp.flat_size) if sp.is_mat
+                     else (bs, sp.y, sp.x, sp.c))
+            xs.append(jnp.asarray(rng.randn(*shape) * 0.1, jnp.bfloat16))
+        lp = {k: jnp.asarray(v) for k, v in
+              host.get(str(net.layer_primary[i]), {}).items()}
+        ctx = ForwardContext(is_train=True, rng=jax.random.PRNGKey(0),
+                             layer_index=i, compute_dtype=jnp.bfloat16)
+
+        def f(*inputs, _layer=layer, _lp=lp, _ctx=ctx):
+            return _layer.forward(_lp, list(inputs), _ctx)[0]
+
+        def g(*inputs, _layer=layer, _lp=lp, _ctx=ctx):
+            def loss(lp_, ins):
+                out = _layer.forward(lp_, list(ins), _ctx)[0]
+                return jnp.sum(out.astype(jnp.float32))
+            if _lp:
+                return jax.grad(loss)(_lp, inputs)
+            return jax.grad(lambda ins: loss(_lp, ins))(inputs)
+
+        t_f = _time(jax.jit(f), tuple(xs))
+        t_g = _time(jax.jit(g), tuple(xs))
+        name = f'{i:2d} {layer.type_name}:{info.name or ""}'
+        rows.append({'layer': name.strip(), 'fwd_us': round(t_f * 1e6, 1),
+                     'fwd_bwd_us': round(t_g * 1e6, 1),
+                     'pct_of_step': round(100 * t_g / t_step, 1)})
+        print(f'{name:26s} fwd {t_f * 1e6:9.1f}us   '
+              f'fwd+bwd {t_g * 1e6:9.1f}us   {100 * t_g / t_step:5.1f}% '
+              f'of step', flush=True)
+
+    covered = sum(r['fwd_bwd_us'] for r in rows) / 1e6
+    print(f'sum of isolated layers (fwd+bwd): {covered * 1e3:.2f} ms '
+          f'of {t_step * 1e3:.2f} ms step '
+          f'({100 * covered / t_step:.0f}% — rest is fusion overlap, '
+          f'elementwise, optimizer, dispatch)')
+    if args.json:
+        with open(args.json, 'w') as f:
+            json.dump({'batch': bs, 'step_ms': round(t_step * 1e3, 2),
+                       'fwd_ms': round(t_fwd * 1e3, 2),
+                       'achieved_tflops':
+                           round(step_flops / t_step / 1e12, 2),
+                       'layers': rows}, f, indent=1)
+        print(f'wrote {args.json}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
