@@ -34,7 +34,17 @@ class DataIter:
     """Config-driven data iterator with the reference's cursor protocol."""
 
     def __init__(self, cfg: str):
-        self._it = create_iterator(parse_config_string(cfg))
+        pairs = parse_config_string(cfg)
+        self._it = create_iterator(pairs)
+        # pairs after `iter = end` are section defaults (batch_size,
+        # input_shape, ...) applied to the whole chain — how the reference
+        # wrapper confs are written (example/MNIST/mnist.py)
+        seen_end = False
+        for name, val in pairs:
+            if name == 'iter' and val == 'end':
+                seen_end = True
+            elif seen_end:
+                self._it.set_param(name, val)
         self._it.init()
         self._cursor: Optional[Iterator] = None
         self._batch: Optional[DataBatch] = None

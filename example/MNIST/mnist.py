@@ -1,0 +1,99 @@
+#!/usr/bin/env python3
+"""Python-API MNIST walkthrough (reference ``example/MNIST/mnist.py``):
+train via the wrapper, inspect weights, predict from a DataIter and from a
+raw numpy batch, extract features, evaluate manually, keep training.
+
+Run ``./run.sh`` first to fetch the data, then::
+
+    python mnist.py
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.append('../..')
+from cxxnet_tpu import wrapper as cxxnet  # noqa: E402
+
+data = cxxnet.DataIter("""
+iter = mnist
+    path_img = "./data/train-images-idx3-ubyte.gz"
+    path_label = "./data/train-labels-idx1-ubyte.gz"
+    shuffle = 1
+iter = end
+input_shape = 1,1,784
+batch_size = 100
+""")
+print('init data iter')
+
+deval = cxxnet.DataIter("""
+iter = mnist
+    path_img = "./data/t10k-images-idx3-ubyte.gz"
+    path_label = "./data/t10k-labels-idx1-ubyte.gz"
+iter = end
+input_shape = 1,1,784
+batch_size = 100
+""")
+
+cfg = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+  init_sigma = 0.01
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,784
+batch_size = 100
+random_type = gaussian
+"""
+
+param = {'eta': 0.1, 'dev': 'cpu', 'momentum': 0.9,
+         'metric[label]': 'error'}
+
+net = cxxnet.train_iter(cfg, data, 1, param, eval_data=deval)
+
+# weight access by layer name + tag (reference on-disk layouts)
+weights = [(layer, tag, net.get_weight(layer, tag))
+           for layer in ('fc1', 'fc2') for tag in ('wmat', 'bias')]
+for layer, tag, w in weights:
+    print(f'{layer}.{tag}: {w.shape}')
+
+data.before_first()
+data.next()
+print('predict')
+pred = net.predict(data)                      # from the iterator's batch
+dbatch = data.get_data()
+print(dbatch.shape)
+pred2 = net.predict(dbatch)                   # from a raw numpy batch
+print('iter-vs-raw predict diff:', np.sum(np.abs(pred - pred2)))
+print('iter-vs-raw extract diff:',
+      np.sum(np.abs(net.extract(data, 'sg1') - net.extract(dbatch, 'sg1'))))
+
+# manual evaluation loop
+deval.before_first()
+werr = wcnt = 0
+while deval.next():
+    label = deval.get_label()
+    pred = net.predict(deval)
+    werr += np.sum(label[:, 0] != pred[:])
+    wcnt += len(label[:, 0])
+print('eval-error=%f' % (float(werr) / wcnt))
+
+# keep training with raw batches
+data.before_first()
+while data.next():
+    net.update(data.get_data(), data.get_label())
+
+deval.before_first()
+werr = wcnt = 0
+while deval.next():
+    label = deval.get_label()
+    pred = net.predict(deval)
+    werr += np.sum(label[:, 0] != pred[:])
+    wcnt += len(label[:, 0])
+print('eval-error-after=%f' % (float(werr) / wcnt))

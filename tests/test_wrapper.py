@@ -100,3 +100,39 @@ def test_model_file_roundtrip(tmp_path):
     np.testing.assert_allclose(net.get_weight('fc1', 'wmat'),
                                net2.get_weight('fc1', 'wmat'), rtol=1e-6)
     np.testing.assert_array_equal(net.predict(x), net2.predict(x))
+
+
+def test_mnist_wrapper_example_runs(tmp_path):
+    """example/MNIST/mnist.py (the reference's Python-API walkthrough)
+    runs end-to-end against synthetic idx data."""
+    import gzip
+    import struct
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rng = np.random.RandomState(0)
+    d = tmp_path / 'data'
+    d.mkdir()
+    for name, n in (('train', 300), ('t10k', 100)):
+        img = np.zeros((n, 28, 28), np.uint8)
+        y = rng.randint(0, 10, n).astype(np.uint8)
+        for i in range(n):
+            img[i, y[i] * 2:(y[i] + 1) * 2, :] = 200
+        with gzip.open(d / f'{name}-images-idx3-ubyte.gz', 'wb') as f:
+            f.write(struct.pack('>iiii', 2051, n, 28, 28))
+            f.write(img.tobytes())
+        with gzip.open(d / f'{name}-labels-idx1-ubyte.gz', 'wb') as f:
+            f.write(struct.pack('>ii', 2049, n))
+            f.write(y.tobytes())
+    env = dict(os.environ)
+    env['PYTHONPATH'] = repo + os.pathsep + env.get('PYTHONPATH', '')
+    env['JAX_PLATFORMS'] = 'cpu'
+    r = subprocess.run(
+        [_sys.executable, os.path.join(repo, 'example', 'MNIST', 'mnist.py')],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=240)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert 'eval-error=' in r.stdout and 'eval-error-after=' in r.stdout
+    first = float(r.stdout.split('eval-error=')[1].splitlines()[0])
+    after = float(r.stdout.split('eval-error-after=')[1].splitlines()[0])
+    assert after <= first

@@ -117,8 +117,12 @@ compute_type = bfloat16
             def loss(lp_, ins):
                 out = _layer.forward(lp_, list(ins), _ctx)[0]
                 return jnp.sum(out.astype(jnp.float32))
+            # differentiate wrt params AND inputs: training computes both
+            # dW and dX for every interior layer (skipping dX would let
+            # XLA dead-code-eliminate ~1/3 of a conv/fullc layer's
+            # backward FLOPs here)
             if _lp:
-                return jax.grad(loss)(_lp, inputs)
+                return jax.grad(loss, argnums=(0, 1))(_lp, inputs)
             return jax.grad(lambda ins: loss(_lp, ins))(inputs)
 
         t_f = _time(jax.jit(f), tuple(xs))
