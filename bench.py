@@ -8,6 +8,7 @@ Default (what the driver runs) — AlexNet batch 256, prints ONE JSON line:
 Extra modes for the BASELINE.md ledger (same JSON shape):
   python bench.py inception_bn     # Inception-BN batch 128 throughput
   python bench.py googlenet        # GoogLeNet v1 batch 128 throughput
+  python bench.py vgg16            # VGG-16 batch 64 throughput
   python bench.py e2e_alexnet      # AlexNet through the FULL data path
                                    #   (imgbin+decode+augment+H2D included)
   python bench.py mnist_tta        # MNIST conv time-to-2%-test-error (sec)
@@ -44,6 +45,7 @@ import numpy as np
 BASELINE_IMAGES_PER_SEC = 500.0          # AlexNet stand-in (see docstring)
 BASELINE_INCEPTION_IMAGES_PER_SEC = 130.0  # Inception-BN stand-in, same era
 BASELINE_GOOGLENET_IMAGES_PER_SEC = 150.0  # GoogLeNet v1 stand-in, same era
+BASELINE_VGG16_IMAGES_PER_SEC = 50.0       # VGG-16 stand-in, same era
 BASELINE_MNIST_TTA_SEC = 30.0            # reference MNIST.conf CPU run
 
 # bf16 peak TFLOP/s by TPU generation (marketing peak; MFU denominators)
@@ -184,10 +186,22 @@ compute_type = bfloat16
                        BASELINE_IMAGES_PER_SEC, last_key='16')
 
 
-def bench_inception_bn() -> int:
-    from cxxnet_tpu.models import inception_bn_conf
+def _layer_index(conf: str, name: str = None) -> str:
+    """Index (as str) of the named layer — or the last fullc — for the
+    bench sync read-back."""
     from cxxnet_tpu.nnet.net_config import NetConfig
     from cxxnet_tpu.utils.config import parse_config_string
+    cfg = NetConfig()
+    cfg.configure(parse_config_string(conf))
+    if name is not None:
+        return str(next(i for i, e in enumerate(cfg.layers)
+                        if e.name == name))
+    return str(max(i for i, e in enumerate(cfg.layers)
+                   if e.type == 1))  # kFullConnect
+
+
+def bench_inception_bn() -> int:
+    from cxxnet_tpu.models import inception_bn_conf
     batch_size = 128
     conf = inception_bn_conf() + f"""
 batch_size = {batch_size}
@@ -198,20 +212,14 @@ eval_train = 0
 random_type = xavier
 compute_type = bfloat16
 """
-    # find the final fullc layer index for the sync read-back
-    cfg = NetConfig()
-    cfg.configure(parse_config_string(conf))
-    last = max(i for i, e in enumerate(cfg.layers)
-               if e.type == 1)  # kFullConnect
     return _throughput(conf, batch_size, (3, 224, 224),
                        'inception_bn_images_per_sec_per_chip',
-                       BASELINE_INCEPTION_IMAGES_PER_SEC, last_key=str(last))
+                       BASELINE_INCEPTION_IMAGES_PER_SEC,
+                       last_key=_layer_index(conf))
 
 
 def bench_googlenet() -> int:
     from cxxnet_tpu.models import googlenet_conf
-    from cxxnet_tpu.nnet.net_config import NetConfig
-    from cxxnet_tpu.utils.config import parse_config_string
     batch_size = 128
     conf = googlenet_conf() + f"""
 batch_size = {batch_size}
@@ -222,13 +230,28 @@ eval_train = 0
 random_type = xavier
 compute_type = bfloat16
 """
-    cfg = NetConfig()
-    cfg.configure(parse_config_string(conf))
-    name_to_idx = {e.name: i for i, e in enumerate(cfg.layers) if e.name}
     return _throughput(conf, batch_size, (3, 224, 224),
                        'googlenet_images_per_sec_per_chip',
                        BASELINE_GOOGLENET_IMAGES_PER_SEC,
-                       last_key=str(name_to_idx['loss3_fc']))
+                       last_key=_layer_index(conf, 'loss3_fc'))
+
+
+def bench_vgg16() -> int:
+    from cxxnet_tpu.models import vgg16_conf
+    batch_size = 64
+    conf = vgg16_conf() + f"""
+batch_size = {batch_size}
+eta = 0.01
+momentum = 0.9
+metric = error
+eval_train = 0
+random_type = xavier
+compute_type = bfloat16
+"""
+    return _throughput(conf, batch_size, (3, 224, 224),
+                       'vgg16_images_per_sec_per_chip',
+                       BASELINE_VGG16_IMAGES_PER_SEC,
+                       last_key=_layer_index(conf, 'fc8'))
 
 
 def bench_e2e_alexnet() -> int:
@@ -539,6 +562,7 @@ _MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
                            bench_inception_bn),
           'googlenet': ('googlenet_images_per_sec_per_chip',
                         bench_googlenet),
+          'vgg16': ('vgg16_images_per_sec_per_chip', bench_vgg16),
           'e2e_alexnet': ('alexnet_e2e_images_per_sec_per_chip',
                           bench_e2e_alexnet),
           'mnist_tta': ('mnist_time_to_2pct_error', bench_mnist_tta)}

@@ -12,7 +12,9 @@ reference acceptance configs (BASELINE.md):
 * ``inception_bn_conf`` — GoogLeNet-family Inception with BatchNorm (the
   reference has no in-tree conf; built from its conv/ch_concat/batch_norm
   layers following the cxxnet-era model-zoo Inception-BN arrangement)
+* ``vgg16_conf`` — VGG-16 configuration D (no in-tree reference conf;
+  cxxnet-era model-zoo arrangement)
 """
 
 from .builders import (alexnet_conf, googlenet_conf, inception_bn_conf,
-                       lenet_conf, mlp_conf)
+                       lenet_conf, mlp_conf, vgg16_conf)

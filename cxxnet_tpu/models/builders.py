@@ -310,3 +310,36 @@ def googlenet_conf(num_class: int = 1000, aux_heads: bool = True) -> str:
     lines.append('netconfig=end')
     lines.append('input_shape = 3,224,224')
     return '\n'.join(lines) + '\n'
+
+
+def vgg16_conf(num_class: int = 1000) -> str:
+    """VGG-16 (configuration D, Simonyan & Zisserman 2014) — the era's
+    third headline ImageNet family alongside AlexNet and GoogLeNet.  The
+    reference ships no VGG conf; this follows the cxxnet-era model-zoo
+    arrangement: five 3x3-conv blocks (2-2-3-3-3) with 2x2 max pooling,
+    then fc4096-fc4096-fc{num_class} with dropout."""
+    blocks = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+    lines = ['netconfig=start']
+    for b, (reps, ch) in enumerate(blocks, start=1):
+        for r in range(1, reps + 1):
+            lines += [f'layer[+1] = conv:conv{b}_{r}',
+                      '  kernel_size = 3',
+                      '  pad = 1',
+                      f'  nchannel = {ch}',
+                      'layer[+1] = relu']
+        lines += ['layer[+1] = max_pooling',
+                  '  kernel_size = 2',
+                  '  stride = 2']
+    lines += ['layer[+1] = flatten']
+    for i, nh in ((6, 4096), (7, 4096)):
+        lines += [f'layer[+1] = fullc:fc{i}',
+                  f'  nhidden = {nh}',
+                  'layer[+1] = relu',
+                  'layer[+0] = dropout',
+                  '  threshold = 0.5']
+    lines += [f'layer[+1] = fullc:fc8',
+              f'  nhidden = {num_class}',
+              'layer[+0] = softmax',
+              'netconfig=end',
+              'input_shape = 3,224,224']
+    return '\n'.join(lines) + '\n'

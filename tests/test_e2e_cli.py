@@ -401,3 +401,90 @@ silent = 1
     assert float(rec5[0]) < 0.3, 'untrained rec@5 should be near chance'
     assert float(rec5[-1]) > 0.9, (rec5[0], rec5[-1])
     assert float(rec1[-1]) <= float(rec5[-1]) + 1e-9
+
+
+def test_cli_attachtxt_extra_data_trains(tmp_path):
+    """attachtxt side features flow into extra_data nodes (in_1) through
+    the CLI trainer: labels here are a function of the attached vector
+    ONLY, so reaching 0 error proves the extra input is consumed
+    (iter_attach_txt-inl.hpp:15-99, data.h extra_data contract)."""
+    rng = np.random.RandomState(11)
+    lines, rows = [], []
+    for i in range(15):
+        c = rng.randint(0, 4)
+        img = rng.randint(0, 255, (8, 8, 3), np.uint8)   # pure noise
+        Image.fromarray(img).save(tmp_path / f'x{i}.png')
+        vec = rng.rand(6) * 0.1
+        vec[c] += 2.0                                     # signal in extra
+        rows.append(' '.join(f'{v:.5f}' for v in vec))
+        lines.append(f'{i}\t{c}\tx{i}.png')
+    (tmp_path / 'a.lst').write_text('\n'.join(lines) + '\n')
+    (tmp_path / 'attach.txt').write_text('\n'.join(rows) + '\n')
+    conf = tmp_path / 'extra.conf'
+    conf.write_text("""
+data = train
+iter = img
+  image_list = a.lst
+  image_root = ./
+iter = attachtxt
+  attach_file = attach.txt
+iter = end
+eval = trainset
+iter = img
+  image_list = a.lst
+  image_root = ./
+iter = attachtxt
+  attach_file = attach.txt
+iter = end
+extra_data_num = 1
+extra_data_shape[0] = 1,1,6
+netconfig = start
+layer[in_1->2] = fullc:fx
+  nhidden = 4
+layer[2->2] = softmax
+netconfig = end
+input_shape = 3,8,8
+batch_size = 5
+dev = cpu
+eta = 0.5
+momentum = 0.9
+num_round = 30
+metric = error
+silent = 1
+""")
+    r = _run_cli(str(conf), str(tmp_path))
+    assert _final_eval(r.stderr, 'trainset') == 0.0, r.stderr[-500:]
+
+
+def test_cli_test_io_mode(tmp_path):
+    """test_io=1 pumps the data pipeline without compute
+    (cxxnet_main.cpp:98,362-375); with test_skipread=1 one cached batch is
+    re-served to bound max throughput (iter_batch_proc-inl.hpp:46,72-74)."""
+    make_quadrant_images(str(tmp_path), 12)
+    conf = tmp_path / 'io.conf'
+    conf.write_text("""
+data = train
+iter = img
+  image_list = train.lst
+  image_root = ./
+  test_skipread = 1
+iter = end
+netconfig = start
+layer[0->1] = flatten
+layer[1->2] = fullc:f1
+  nhidden = 4
+layer[2->2] = softmax
+netconfig = end
+input_shape = 3,24,24
+batch_size = 4
+dev = cpu
+num_round = 1
+test_io = 1
+metric = error
+""")
+    r = _run_cli(str(conf), str(tmp_path))
+    assert 'start I/O test' in r.stdout
+    assert 'error' not in r.stderr.lower()
+    # like the reference, the round-end SaveModel runs even in test_io
+    # mode (cxxnet_main.cpp TaskTrain saves unconditionally)
+    assert (tmp_path / 'models' / '0001.model').exists()

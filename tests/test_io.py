@@ -378,3 +378,19 @@ def test_imgbin_worker_sharding_partitions_pages(tmp_path, small_pages):
             per_worker.append({int(i) for b in it for i in b.inst_index})
         assert per_worker[0].isdisjoint(per_worker[1]), shuffle
         assert per_worker[0] | per_worker[1] == set(range(30)), shuffle
+
+
+def test_membuffer_caches_and_loops(tmp_path):
+    """membuffer caches the first max_nbatch batches and replays them
+    every epoch (iter_mem_buffer-inl.hpp:16-75)."""
+    pi, pl, img, y = write_mnist(str(tmp_path), n=64)
+    cfg = [('iter', 'mnist'), ('path_img', pi), ('path_label', pl),
+           ('input_flat', '1'), ('batch_size', '16'),
+           ('iter', 'membuffer'), ('max_nbatch', '2'), ('silent', '1')]
+    it = create_iterator(cfg)
+    it.init()
+    e1 = list(it)
+    e2 = list(it)
+    assert len(e1) == 2 and len(e2) == 2     # capped at max_nbatch
+    for a, b in zip(e1, e2):
+        np.testing.assert_array_equal(a.data, b.data)

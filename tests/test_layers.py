@@ -256,3 +256,61 @@ def test_maxout():
     specs, _, outs = run_layer(layer, [NodeSpec(1, 1, 4)], [x])
     assert specs[0].x == 2
     np.testing.assert_allclose(outs[0], [[5.0, 2.0]])
+
+
+def test_fixconn_fixed_sparse_projection(tmp_path):
+    """fixconn loads a 'nrow ncol nnz' + triples text file as a CONSTANT
+    (non-learned) projection (fixconn_layer-inl.hpp:42-57)."""
+    wf = tmp_path / 'w.txt'
+    wf.write_text('3 5 4\n0 0 1.5\n0 4 -2.0\n1 2 0.5\n2 3 1.0\n')
+    layer = make_layer('fixconn', {'nhidden': 3, 'fixconn_weight': str(wf)})
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 5).astype(np.float32)
+    specs, params, outs = run_layer(layer, [NodeSpec(1, 1, 5)], [x])
+    assert params == {} or not params, 'fixconn must not learn'
+    w = np.zeros((3, 5), np.float32)
+    w[0, 0], w[0, 4], w[1, 2], w[2, 3] = 1.5, -2.0, 0.5, 1.0
+    np.testing.assert_allclose(outs[0], x @ w.T, rtol=1e-5)
+    assert specs[0].flat_size == 3
+
+
+def test_bias_layer_adds_learned_offset():
+    layer = make_layer('bias')
+    rng = np.random.RandomState(1)
+    x = rng.rand(4, 6).astype(np.float32)
+    _, params, outs = run_layer(layer, [NodeSpec(1, 1, 6)], [x])
+    bias = np.asarray(list(params.values())[0]).reshape(-1)
+    np.testing.assert_allclose(outs[0], x + bias[None, :], rtol=1e-5)
+
+
+def test_softplus():
+    layer = make_layer('softplus')
+    x = np.linspace(-4, 4, 12, dtype=np.float32).reshape(3, 4)
+    _, _, outs = run_layer(layer, [NodeSpec(1, 1, 4)], [x])
+    np.testing.assert_allclose(outs[0], np.log1p(np.exp(x)), rtol=1e-5)
+
+
+def test_sum_pooling_matches_naive():
+    layer = make_layer('sum_pooling', {'kernel_size': 2, 'stride': 2})
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 4, 4, 3).astype(np.float32)     # NHWC
+    _, _, outs = run_layer(layer, [NodeSpec(3, 4, 4)], [x])
+    ref = x.reshape(2, 2, 2, 2, 2, 3).sum(axis=(2, 4))
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5)
+
+
+def test_insanity_pooling_eval_is_max_train_jitters_within_input():
+    """insanity_max_pooling == max pooling at eval; training picks values
+    that still come from the input (jittered reads, insanity_pooling_layer
+    -inl.hpp:112-214)."""
+    params = {'kernel_size': 2, 'stride': 2, 'keep': 0.6}
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 4, 4, 3).astype(np.float32)
+    layer = make_layer('insanity_max_pooling', params)
+    _, _, outs = run_layer(layer, [NodeSpec(3, 4, 4)], [x], is_train=False)
+    ref = x.reshape(2, 2, 2, 2, 2, 3).max(axis=(2, 4))
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5)
+    layer = make_layer('insanity_max_pooling', params)
+    _, _, outs_t = run_layer(layer, [NodeSpec(3, 4, 4)], [x], is_train=True)
+    assert np.all(np.isin(np.round(outs_t[0], 5), np.round(x, 5))), \
+        'train outputs must be actual input values'

@@ -113,14 +113,20 @@ compute_type = bfloat16
         def f(*inputs, _layer=layer, _lp=lp, _ctx=ctx):
             return _layer.forward(_lp, list(inputs), _ctx)[0]
 
-        def g(*inputs, _layer=layer, _lp=lp, _ctx=ctx):
+        is_input_layer = 0 in info.nindex_in
+
+        def g(*inputs, _layer=layer, _lp=lp, _ctx=ctx,
+              _input_layer=is_input_layer):
             def loss(lp_, ins):
                 out = _layer.forward(lp_, list(ins), _ctx)[0]
                 return jnp.sum(out.astype(jnp.float32))
-            # differentiate wrt params AND inputs: training computes both
-            # dW and dX for every interior layer (skipping dX would let
-            # XLA dead-code-eliminate ~1/3 of a conv/fullc layer's
-            # backward FLOPs here)
+            # interior layers: differentiate wrt params AND inputs —
+            # training computes both dW and dX there (skipping dX would
+            # let XLA dead-code-eliminate ~1/3 of a conv/fullc layer's
+            # backward FLOPs).  The input layer gets params-only, like
+            # the real step (no dX wrt the data batch).
+            if _lp and _input_layer:
+                return jax.grad(loss)(_lp, inputs)
             if _lp:
                 return jax.grad(loss, argnums=(0, 1))(_lp, inputs)
             return jax.grad(lambda ins: loss(_lp, ins))(inputs)
