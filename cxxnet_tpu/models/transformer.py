@@ -263,6 +263,17 @@ def build_transformer_mesh(n_devices: int,
     return Mesh(devs.reshape(pp, dp, sp, tp), AXES)
 
 
+def abstract_params(params, cfg: TransformerConfig, mesh: Mesh):
+    """Sharding-annotated ShapeDtypeStructs for ``params`` — the restore
+    target for sharded checkpoints (nnet/sharded_ckpt.py): orbax lays each
+    shard straight onto its mesh position, no full-replica host copy."""
+    from jax.sharding import NamedSharding
+    return _map_with_specs(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                          sharding=NamedSharding(mesh, s)),
+        params, param_specs(cfg))
+
+
 def reference_loss(params, tokens, labels, cfg: TransformerConfig):
     """Single-device oracle: same math, no mesh, sequential stages —
     including the weighted MoE balance loss the distributed step adds."""

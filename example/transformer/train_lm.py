@@ -33,6 +33,10 @@ def main() -> int:
     ap.add_argument('--steps', type=int, default=30)
     ap.add_argument('--seq', type=int, default=128)
     ap.add_argument('--batch', type=int, default=8)
+    ap.add_argument('--ckpt_dir', default='',
+                    help='sharded orbax checkpoint dir; resumes from the '
+                         'newest step when one exists')
+    ap.add_argument('--save_every', type=int, default=10)
     args = ap.parse_args()
     n = args.pp * args.dp * args.sp * args.tp
 
@@ -48,6 +52,7 @@ def main() -> int:
 
     import numpy as np
     from cxxnet_tpu.models.transformer import (TransformerConfig,
+                                               abstract_params,
                                                build_transformer_mesh,
                                                init_params, make_train_step)
 
@@ -65,6 +70,16 @@ def main() -> int:
     print(f'mesh: {dict(mesh.shape)}  experts={args.experts}')
     params = init_params(np.random.RandomState(0), cfg)
     step = make_train_step(cfg, mesh)
+    start_step = 0
+    if args.ckpt_dir:
+        from cxxnet_tpu.nnet.sharded_ckpt import (latest_step,
+                                                  restore_sharded,
+                                                  save_sharded)
+        if latest_step(args.ckpt_dir) is not None:
+            params, start_step = restore_sharded(
+                args.ckpt_dir, abstract_params(params, cfg, mesh))
+            start_step += 1
+            print(f'resumed from step {start_step - 1}')
 
     # synthetic copy-task data: predict the previous token
     rng = np.random.RandomState(1)
@@ -73,7 +88,7 @@ def main() -> int:
     labels = np.roll(tokens, -1, axis=1).astype(np.int32)
 
     t0 = time.time()
-    for i in range(args.steps):
+    for i in range(start_step, args.steps):
         params, loss, aux = step(params, tokens, labels)
         if i % 10 == 0 or i == args.steps - 1:
             moe = (f'  balance {float(aux["balance_loss"]):.3f}'
@@ -81,6 +96,9 @@ def main() -> int:
                    if args.experts else '')
             print(f'step {i:4d}  loss {float(loss):.4f}{moe}  '
                   f'({time.time() - t0:.1f}s)')
+        if args.ckpt_dir and ((i + 1) % args.save_every == 0
+                              or i == args.steps - 1):
+            save_sharded(args.ckpt_dir, i, params)
     return 0
 
 
