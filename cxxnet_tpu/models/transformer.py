@@ -263,11 +263,40 @@ def build_transformer_mesh(n_devices: int,
     return Mesh(devs.reshape(pp, dp, sp, tp), AXES)
 
 
+def param_shapes(cfg: TransformerConfig):
+    """ShapeDtypeStructs mirroring ``init_params`` — shapes without
+    allocating anything (test-pinned against init_params)."""
+    s, d, f, v = cfg.num_stages, cfg.d_model, cfg.d_ff, cfg.vocab_size
+
+    def sds(*shape):
+        return jax.ShapeDtypeStruct(shape, cfg.dtype)
+
+    stages = {
+        'ln1_scale': sds(s, d), 'ln1_bias': sds(s, d),
+        'wq': sds(s, d, d), 'wk': sds(s, d, d), 'wv': sds(s, d, d),
+        'wo': sds(s, d, d),
+        'ln2_scale': sds(s, d), 'ln2_bias': sds(s, d),
+    }
+    if cfg.num_experts:
+        e = cfg.num_experts
+        stages['gate'] = sds(s, d, e)
+        stages['w1'] = sds(s, e, d, f)
+        stages['w2'] = sds(s, e, f, d)
+    else:
+        stages['w1'] = sds(s, d, f)
+        stages['w2'] = sds(s, f, d)
+    return {'embed': sds(v, d), 'head': sds(d, v), 'stages': stages}
+
+
 def abstract_params(params, cfg: TransformerConfig, mesh: Mesh):
     """Sharding-annotated ShapeDtypeStructs for ``params`` — the restore
     target for sharded checkpoints (nnet/sharded_ckpt.py): orbax lays each
-    shard straight onto its mesh position, no full-replica host copy."""
+    shard straight onto its mesh position, no full-replica host copy.
+    ``params=None`` derives shapes from the config (``param_shapes``), so
+    resume never materializes a throwaway replica."""
     from jax.sharding import NamedSharding
+    if params is None:
+        params = param_shapes(cfg)
     return _map_with_specs(
         lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                           sharding=NamedSharding(mesh, s)),

@@ -77,14 +77,26 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
-def save_sharded(ckpt_dir: str, step: int, params) -> str:
+def save_sharded(ckpt_dir: str, step: int, params, block: bool = True) -> str:
     """Write ``params`` (a pytree of possibly-sharded jax.Arrays) at
-    ``step``; returns the checkpoint path."""
+    ``step``; returns the checkpoint path.  ``block=False`` lets the
+    commit overlap subsequent training steps (the previous pending save is
+    always completed first); callers must ``wait_for_saves()`` before
+    exit or before reading the checkpoint back."""
     path = _absolute(step_dir(ckpt_dir, step))
     ck = _shared_ck()
+    ck.wait_until_finished()          # at most one save in flight
     ck.save(path, params)
-    ck.wait_until_finished()
+    if block:
+        ck.wait_until_finished()
     return path
+
+
+def wait_for_saves() -> None:
+    """Block until every async ``save_sharded(..., block=False)`` commit
+    has landed."""
+    if _CK is not None:
+        _CK.wait_until_finished()
 
 
 def restore_sharded(ckpt_dir: str, like, step: Optional[int] = None):

@@ -56,6 +56,16 @@ def _block_spec(shape, index_map=None):
     return pl.BlockSpec(shape, index_map)
 
 
+def _compiler_params(*dimension_semantics):
+    """Mark grid dims 'parallel' (independent; Mosaic can pipeline) or
+    'arbitrary' (sequential — reduction dims carrying scratch state).
+    Interpret mode takes no TPU compiler params."""
+    if _interpret() or pltpu is None:
+        return {}
+    return {'compiler_params':
+            pltpu.CompilerParams(dimension_semantics=dimension_semantics)}
+
+
 def _band_matrix(c: int, nsize: int, dtype=jnp.float32):
     """(c, c) 0/1 band: column j sums channels in j's LRN window."""
     half_lo = (nsize - 1) // 2
@@ -118,6 +128,7 @@ def _lrn_call(kernel, outs, args, c, rows_padded, band_arg):
         out_specs=[row_spec] * len(outs) if isinstance(outs, list)
         else row_spec,
         interpret=_interpret(),
+        **_compiler_params('parallel'),
     )(*args)
 
 
@@ -250,6 +261,7 @@ def _matmul_impl(a, b, tile_m: int = 256, tile_n: int = 256,
         out_specs=_block_spec((tile_m, tile_n), lambda i, j, t: (i, j)),
         scratch_shapes=[_scratch((tile_m, tile_n))],
         interpret=_interpret(),
+        **_compiler_params('parallel', 'parallel', 'arbitrary'),
     )(ap, bp)
     return out[:m, :n]
 
@@ -471,6 +483,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
         scratch_shapes=[_scratch((bq, d)), _scratch((bq, 1)),
                         _scratch((bq, 1))],
         interpret=_interpret(),
+        **_compiler_params('parallel', 'parallel', 'arbitrary'),
     )(qp, kp, vp)
     return out[:, :sq], lse[:, :sq, 0]
 
@@ -516,6 +529,7 @@ def _flash_bhsd_bwd(causal, block_q, block_k, res, g):
         out_specs=_block_spec((1, bq, d), lambda i, j, t: (i, j, 0)),
         scratch_shapes=[_scratch((bq, d))],
         interpret=_interpret(),
+        **_compiler_params('parallel', 'parallel', 'arbitrary'),
     )(qp, kp, vp, gp, lse_p, delta_p)
 
     dkv_kernel = functools.partial(_flash_dkv_kernel, scale=scale,
@@ -535,6 +549,7 @@ def _flash_bhsd_bwd(causal, block_q, block_k, res, g):
                    _block_spec((1, bk, d), lambda i, t, j: (i, t, 0))],
         scratch_shapes=[_scratch((bk, d)), _scratch((bk, d))],
         interpret=_interpret(),
+        **_compiler_params('parallel', 'parallel', 'arbitrary'),
     )(qp, kp, vp, gp, lse_p, delta_p)
 
     return dq[:, :sq], dk[:, :sk], dv[:, :sk]

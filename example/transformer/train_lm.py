@@ -38,6 +38,8 @@ def main() -> int:
                          'newest step when one exists')
     ap.add_argument('--save_every', type=int, default=10)
     args = ap.parse_args()
+    if args.save_every <= 0:
+        ap.error('--save_every must be >= 1')
     n = args.pp * args.dp * args.sp * args.tp
 
     import jax
@@ -68,18 +70,22 @@ def main() -> int:
                             num_microbatches=micro)
     mesh = build_transformer_mesh(n, args.pp, args.dp, args.sp, args.tp)
     print(f'mesh: {dict(mesh.shape)}  experts={args.experts}')
-    params = init_params(np.random.RandomState(0), cfg)
     step = make_train_step(cfg, mesh)
-    start_step = 0
+    params, start_step = None, 0
     if args.ckpt_dir:
         from cxxnet_tpu.nnet.sharded_ckpt import (latest_step,
                                                   restore_sharded,
-                                                  save_sharded)
+                                                  save_sharded,
+                                                  wait_for_saves)
         if latest_step(args.ckpt_dir) is not None:
+            # shapes-only restore target: resume never materializes a
+            # throwaway full replica
             params, start_step = restore_sharded(
-                args.ckpt_dir, abstract_params(params, cfg, mesh))
+                args.ckpt_dir, abstract_params(None, cfg, mesh))
             start_step += 1
             print(f'resumed from step {start_step - 1}')
+    if params is None:
+        params = init_params(np.random.RandomState(0), cfg)
 
     # synthetic copy-task data: predict the previous token
     rng = np.random.RandomState(1)
@@ -98,7 +104,10 @@ def main() -> int:
                   f'({time.time() - t0:.1f}s)')
         if args.ckpt_dir and ((i + 1) % args.save_every == 0
                               or i == args.steps - 1):
-            save_sharded(args.ckpt_dir, i, params)
+            # async: the commit overlaps the next training steps
+            save_sharded(args.ckpt_dir, i, params, block=False)
+    if args.ckpt_dir:
+        wait_for_saves()
     return 0
 
 

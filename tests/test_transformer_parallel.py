@@ -287,3 +287,20 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
     p1, l1, _ = step(params, tokens, tokens)
     p2, l2, _ = step(restored, tokens, tokens)
     assert float(l1) == float(l2)
+
+
+def test_param_shapes_matches_init_params():
+    """param_shapes (the allocation-free resume target) must track
+    init_params exactly."""
+    for experts in (0, 4):
+        cfg = tfm.TransformerConfig(vocab_size=16, d_model=16, num_heads=2,
+                                    d_ff=32, num_stages=2, seq_len=8,
+                                    num_experts=experts)
+        live = tfm.init_params(np.random.RandomState(0), cfg)
+        shapes = tfm.param_shapes(cfg)
+        la = jax.tree.leaves_with_path(live)
+        lb = dict(jax.tree.leaves_with_path(shapes))
+        assert len(la) == len(lb)
+        for path, leaf in la:
+            assert lb[path].shape == leaf.shape, path
+            assert lb[path].dtype == leaf.dtype, path
