@@ -127,3 +127,41 @@ input_shape = 3,224,224
             f'{k} received no gradient — a loss head is disconnected'
     res = tr.evaluate(iter([batch]), 'fit')
     assert 'fit-error:' in res
+
+
+def test_tail_batch_mask_on_sharded_mesh():
+    """A synthetic-padded tail batch (num_batch_padd, pad_synthetic) must
+    produce the same update on an 8-device data-sharded mesh as on one
+    device — the loss mask shards with the batch (each of the 8 shards
+    holds one row here, so the 3 pad rows span shards 5-7) and the pads
+    contribute nothing anywhere."""
+    def make(dev_line):
+        conf = mlp_conf(num_class=4, input_dim=16, nhidden=32) + f"""
+batch_size = 8
+{dev_line}
+eta = 0.1
+momentum = 0.9
+metric = error
+"""
+        tr = NetTrainer(parse_config_string(conf))
+        tr.init_model()
+        return tr
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(8, 1, 1, 16).astype(np.float32)
+    y = rng.randint(0, 4, (8, 1)).astype(np.float32)
+    x[5:] = 1e6                     # garbage pad rows
+    batch = DataBatch(x, y, num_batch_padd=3, pad_synthetic=True)
+
+    results = []
+    for dev_line in ('dev = cpu', 'dev = tpu:0-7'):
+        tr = make(dev_line)
+        tr.update(batch)
+        results.append({k: {f: np.asarray(v) for f, v in d.items()}
+                        for k, d in tr.params.items()})
+    for k in results[0]:
+        for f in results[0][k]:
+            np.testing.assert_allclose(
+                results[0][k][f], results[1][k][f], rtol=2e-5, atol=1e-6,
+                err_msg=f'{k}/{f} diverged between 1-dev and 8-dev')
+            assert np.isfinite(results[1][k][f]).all()

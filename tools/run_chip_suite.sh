@@ -1,0 +1,31 @@
+#!/bin/sh
+# One-shot on-chip measurement suite: run when the TPU tunnel is up.
+# Produces the per-op Pallas receipts, the AlexNet per-layer breakdown,
+# and the BASELINE.md bench rows, each as JSON under $OUT (default
+# /tmp/chip_suite). Each step is independently timeout-bounded so a
+# tunnel wedge mid-suite still leaves the earlier results on disk.
+set -x
+OUT=${OUT:-/tmp/chip_suite}
+REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
+mkdir -p "$OUT"
+cd "$REPO" || exit 1
+
+timeout 900 python tools/pallas_microbench.py --steps 10 --only lrn \
+    --json "$OUT/micro_lrn.json"      > "$OUT/micro_lrn.log" 2>&1
+timeout 900 python tools/pallas_microbench.py --steps 10 --only matmul \
+    --json "$OUT/micro_matmul.json"   > "$OUT/micro_matmul.log" 2>&1
+timeout 1200 python tools/pallas_microbench.py --steps 10 --only attn \
+    --json "$OUT/micro_attn.json"     > "$OUT/micro_attn.log" 2>&1
+timeout 1200 python tools/alexnet_breakdown.py \
+    --json "$OUT/alexnet_breakdown.json" > "$OUT/alexnet_breakdown.log" 2>&1
+bench() {  # bench <mode> <outfile> [env]
+    f="$OUT/$2"
+    env $3 timeout 900 python bench.py "$1" > "$f" 2>"$OUT/$2.log" ||
+        [ -s "$f" ] || echo '{"metric":"'"$1"'","value":null,"error":"killed/timeout"}' > "$f"
+}
+bench alexnet     bench_alexnet.json
+bench alexnet     bench_alexnet_pallas.json CXXNET_PALLAS=1
+bench vgg16       bench_vgg16.json
+bench e2e_alexnet bench_e2e.json
+echo "chip suite done; results in $OUT"
+ls -la "$OUT"
