@@ -13,22 +13,29 @@ the compiler-native form of the reference's WFBP overlap.
 
 Sharding rules for the 2-D mesh ``(data, model)``:
 * batch:   P('data') on the leading axis,
-* fullc wmat ``(nin, nh)``: P(None, 'model') when nh divides the axis —
-  column-parallel dense layers (the 4096-wide AlexNet FCs are the case
-  where this pays),
-* fullc bias ``(nh,)``: P('model'),
-* conv wmat HWIO: P(None, None, None, 'model') sharding output channels
-  (disabled for grouped conv where channel locality matters),
+* TP-eligible layers (fullc; ungrouped conv) alternate Megatron-style
+  column/row parallelism along the topological order: a column-parallel
+  layer shards its OUTPUT features — fullc wmat ``(nin, nh)`` →
+  P(None, 'model'), conv HWIO → P(None, None, None, 'model'), bias
+  P('model') — leaving its activation sharded on ``model``; the next
+  eligible layer is row-parallel, sharding its INPUT features — fullc
+  P('model', None), conv P(None, None, 'model', None), bias replicated —
+  so it consumes the sharded activation in place and a single psum
+  restores the replicated activation.  Paired boundaries therefore cost
+  one all-reduce instead of the all-gather-per-layer of naive
+  output-sharding-everywhere (the AlexNet fc6→fc7→fc8 chain is the case
+  where this pays).  XLA's SPMD partitioner propagates the activation
+  shardings through the elementwise/pooling layers in between and inserts
+  the collectives; a layer whose feature axis does not divide ``tp``
+  falls back to the other orientation, then to replication.
 * everything else replicated.
 
-Scope note: this CNN tensor parallelism is **weight-sharding only** —
-activations stay replicated, so every sharded layer boundary implies an
-all-gather that XLA inserts.  That is deliberate: for the CNN zoo (AlexNet
-era, model fits one chip many times over) TP is a capability demo exercised
-by the multichip dryrun, not a perf path — data parallelism is the
-production axis.  The fully sharded-activation design (row/column parallel
-pairs with psum, sequence/expert axes) lives in ``models/transformer.py``,
-where model scale actually demands it.
+Scope note: for the CNN zoo (AlexNet era, model fits one chip many times
+over) TP remains a capability demo exercised by the multichip dryrun and
+the tp>1 oracle tests — data parallelism is the production axis.  The
+hand-laid-out sharded-activation design (row/column pairs with explicit
+psum, sequence/expert axes) lives in ``models/transformer.py``, where
+model scale actually demands it.
 
 Optimizer state and gradient accumulators inherit the param sharding, so
 the optimizer update runs fully sharded — the TPU equivalent of the
@@ -64,35 +71,64 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P('data'))
 
 
-def _leaf_spec(type_id: int, field: str, shape, num_group: int,
-               tp: int) -> P:
-    if tp <= 1:
-        return P()
-    if type_id == lbase.kFullConnect and field == 'wmat':
-        if shape[1] % tp == 0:
-            return P(None, 'model')
-    elif type_id == lbase.kFullConnect and field == 'bias':
-        if shape[0] % tp == 0:
-            return P('model')
-    elif type_id == lbase.kConv and field == 'wmat' and num_group == 1:
-        if shape[3] % tp == 0:
-            return P(None, None, None, 'model')
-    elif type_id == lbase.kConv and field == 'bias' and num_group == 1:
-        if shape[0] % tp == 0:
-            return P('model')
-    return P()
+def _layer_tp_mode(type_id: int, fields, num_group: int, tp: int,
+                   prefer: str) -> Optional[str]:
+    """Pick 'col' / 'row' / None for one layer: ``prefer`` first, the
+    other orientation if the preferred feature axis doesn't divide
+    ``tp``, None (replicate) if neither does."""
+    w = fields.get('wmat')
+    if w is None:
+        return None
+    if type_id == lbase.kFullConnect:
+        ok = {'col': w.shape[1] % tp == 0, 'row': w.shape[0] % tp == 0}
+    elif type_id == lbase.kConv and num_group == 1:
+        ok = {'col': w.shape[3] % tp == 0, 'row': w.shape[2] % tp == 0}
+    else:
+        return None
+    for mode in (prefer, 'row' if prefer == 'col' else 'col'):
+        if ok[mode]:
+            return mode
+    return None
+
+
+_TP_SPECS = {
+    # (type, mode) -> field -> PartitionSpec
+    (lbase.kFullConnect, 'col'): {'wmat': P(None, 'model'),
+                                  'bias': P('model')},
+    (lbase.kFullConnect, 'row'): {'wmat': P('model', None), 'bias': P()},
+    (lbase.kConv, 'col'): {'wmat': P(None, None, None, 'model'),
+                           'bias': P('model')},
+    (lbase.kConv, 'row'): {'wmat': P(None, None, 'model', None),
+                           'bias': P()},
+}
 
 
 def param_shardings(net, params, mesh: Mesh) -> Dict:
-    """Per-leaf NamedSharding pytree matching the params structure."""
+    """Per-leaf NamedSharding pytree matching the params structure.
+
+    With ``tp > 1``, eligible layers alternate column/row parallelism in
+    topological order (see module docstring); the parity advances only on
+    layers that actually got sharded, so an ineligible layer between a
+    col/row pair doesn't break the pairing."""
     tp = mesh.shape.get('model', 1)
     out = {}
-    for key, fields in params.items():
+    parity = 0
+    for key in sorted(params.keys(), key=int):
+        fields = params[key]
         i = int(key)
         info = net.cfg.layers[i]
         layer = net.layers[i]
-        out[key] = {
-            f: NamedSharding(mesh, _leaf_spec(info.type, f, v.shape,
-                                              layer.param.num_group, tp))
-            for f, v in fields.items()}
+        mode = None
+        if tp > 1:
+            mode = _layer_tp_mode(info.type, fields, layer.param.num_group,
+                                  tp, 'col' if parity % 2 == 0 else 'row')
+        if mode is None:
+            specs = {f: P() for f in fields}
+        else:
+            table = _TP_SPECS[(info.type, mode)]
+            # bias divisibility rides the wmat check for 'col' (same axis)
+            specs = {f: table.get(f, P()) for f in fields}
+            parity += 1
+        out[key] = {f: NamedSharding(mesh, specs[f])
+                    for f in fields}
     return out

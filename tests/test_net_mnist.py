@@ -169,3 +169,37 @@ metric = error
     batch = DataBatch(rng.randn(4, 1, 1, 16).astype(np.float32),
                       np.zeros((4, 1), np.float32))
     trainer.update(batch)  # must run without error
+
+
+def test_multi_step_scan_matches_sequential():
+    """compile_multi_step (the one-dispatch scanned hot loop benchmarks
+    time) must produce the same weights as N sequential update_on_device
+    steps over the same batch cycle — proving the scan measures the real
+    training computation, not a variant of it.  (Per-step RNG folding
+    differs between the paths, so the net here has no stochastic layers.)"""
+    batches = synth_batches(n_batches=2)
+    n_steps = 6
+
+    seq = NetTrainer(parse_config_string(MLP_CONF))
+    seq.init_model()
+    for t in range(n_steps):
+        b = batches[t % 2]
+        seq.update_on_device(seq._shard_batch(b.data),
+                             seq._shard_batch(b.label, cast=False))
+
+    scan = NetTrainer(parse_config_string(MLP_CONF))
+    scan.init_model()
+    dstack = scan.shard_batch_stack(
+        np.stack([b.data for b in batches]))
+    lstack = scan.shard_batch_stack(
+        np.stack([b.label for b in batches]), cast=False)
+    fn = scan.compile_multi_step(n_steps)
+    scan.update_n_on_device(fn, dstack, lstack, n_steps)
+
+    assert scan.epoch_counter == seq.epoch_counter == n_steps
+    for lk, fields in seq.params.items():
+        for fk, ref in fields.items():
+            got = scan.params[lk][fk]
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-6,
+                err_msg=f'layer {lk} field {fk} diverged')

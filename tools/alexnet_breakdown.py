@@ -4,13 +4,15 @@
     python tools/alexnet_breakdown.py [--batch 256] [--json out.json]
 
 The jax profiler cannot trace through the remote (axon) tunnel, so this
-tool derives the MFU breakdown directly: it times the full optimizer step,
-the forward pass, and each parameterized/pooling/LRN layer in isolation
-(jitted at its exact activation shape, fwd and fwd+bwd), forcing real
-completion with 1-element fetches (block_until_ready acks early over the
-tunnel).  Layer times are lower bounds (isolated kernels skip fusion
+tool derives the MFU breakdown directly: it times the full optimizer step
+(trainer.compile_multi_step — the whole K-step loop in one dispatch), the
+forward pass, and each parameterized/pooling/LRN layer in isolation
+(jitted at its exact activation shape, fwd and fwd+bwd).  All timings
+loop on-device inside one jit with the dispatch cost cancelled (see
+chiptime.py — per-dispatch timing bottoms out at the ~7 ms tunnel RTT).
+Layer times are lower bounds (isolated kernels skip fusion
 opportunities) but name where the step's time goes — the evidence the
-MFU-0.27 question needs.
+MFU question needs.
 """
 
 from __future__ import annotations
@@ -18,7 +20,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import statistics
 import sys
 import time
 
@@ -28,24 +29,30 @@ import jax                                                     # noqa: E402
 import jax.numpy as jnp                                        # noqa: E402
 import numpy as np                                             # noqa: E402
 
-_FETCH = jax.jit(lambda x: x.ravel()[0])
+from chiptime import time_op                                   # noqa: E402
 
 
-def _sync(out):
-    return float(np.asarray(_FETCH(jax.tree.leaves(out)[0])))
+def _time_step_scan(tr, dstack, lstack, iters=10, reps=3):
+    """Per-step seconds of the full optimizer step via the trainer's
+    scanned multi-step path (iters-vs-1 difference quotient)."""
+    m1 = tr.compile_multi_step(1)
+    mk = tr.compile_multi_step(iters)
 
+    def run(fn, n):
+        return float(np.asarray(tr.update_n_on_device(fn, dstack, lstack, n)))
 
-def _time(fn, args, steps=10, reps=3):
-    out = fn(*args)
-    _sync(out)
-    ts = []
+    run(m1, 1)
+    run(mk, iters)
+    t1s, tks = [], []
     for _ in range(reps):
         t0 = time.perf_counter()
-        for _ in range(steps):
-            out = fn(*args)
-        _sync(out)
-        ts.append((time.perf_counter() - t0) / steps)
-    return statistics.median(ts)
+        run(m1, 1)
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run(mk, iters)
+        tks.append(time.perf_counter() - t0)
+    # min at each endpoint rejects link jitter spikes (see chiptime.py)
+    return (min(tks) - min(t1s)) / (iters - 1)
 
 
 def main() -> int:
@@ -73,19 +80,17 @@ compute_type = bfloat16
     tr = NetTrainer(parse_config_string(conf))
     tr.init_model()
     rng = np.random.RandomState(0)
-    data = tr._shard_batch(
-        rng.randint(0, 256, (bs, 3, 227, 227), dtype=np.uint8))
-    label = tr._shard_batch(
-        rng.randint(0, 1000, (bs, 1)).astype(np.float32), cast=False)
+    dstack = tr.shard_batch_stack(
+        rng.randint(0, 256, (2, bs, 3, 227, 227), dtype=np.uint8))
+    lstack = tr.shard_batch_stack(
+        rng.randint(0, 1000, (2, bs, 1)).astype(np.float32), cast=False)
+    data, label = dstack[0], lstack[0]
 
     # --- whole step & forward-only ------------------------------------
-    def full_step(d, l):
-        tr.update_on_device(d, l)
-        return tr.params['16']['bias']
-
-    t_step = _time(full_step, (data, label))
+    t_step = _time_step_scan(tr, dstack, lstack)
     fwd = tr._forward_fn
-    t_fwd = _time(lambda d: fwd(tr.params, d, (), 0), (data,))
+    params = tr.params
+    t_fwd = time_op(lambda d: fwd(params, d, (), 0), (data,))
     step_flops = tr.train_step_flops(data, label)
     print(f'full train step: {t_step * 1e3:8.2f} ms   '
           f'({step_flops / t_step / 1e12:.1f} TFLOP/s achieved)')
@@ -131,8 +136,8 @@ compute_type = bfloat16
                 return jax.grad(loss, argnums=(0, 1))(_lp, inputs)
             return jax.grad(lambda ins: loss(_lp, ins))(inputs)
 
-        t_f = _time(jax.jit(f), tuple(xs))
-        t_g = _time(jax.jit(g), tuple(xs))
+        t_f = time_op(f, tuple(xs))
+        t_g = time_op(g, tuple(xs))
         name = f'{i:2d} {layer.type_name}:{info.name or ""}'
         rows.append({'layer': name.strip(), 'fwd_us': round(t_f * 1e6, 1),
                      'fwd_bwd_us': round(t_g * 1e6, 1),

@@ -5,10 +5,12 @@ transformer attention).
 
     python tools/pallas_microbench.py [--steps 50] [--json out.json]
 
-Each op is timed fwd-only and fwd+bwd (value_and_grad through the op),
-median of repeated timed loops after compile+warmup.  Results feed
-BASELINE.md's kernel table and decide the default `use_pallas` state
-(ops/pallas_kernels.py: pallas wins -> enabled by default).
+Each op is timed fwd-only and fwd+bwd (grad through the op), looped
+on-device inside one jit with the dispatch cost cancelled (see
+chiptime.py — per-dispatch timing bottoms out at the ~7 ms tunnel RTT and
+cannot rank kernels).  Results feed BASELINE.md's kernel table and decide
+the default `use_pallas` state (ops/pallas_kernels.py: pallas wins ->
+enabled by default).
 """
 
 from __future__ import annotations
@@ -17,9 +19,7 @@ import argparse
 import functools
 import json
 import os
-import statistics
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -27,53 +27,32 @@ import jax                                                     # noqa: E402
 import jax.numpy as jnp                                        # noqa: E402
 import numpy as np                                             # noqa: E402
 
-
-# one jit object: retraces only per distinct leaf shape/dtype, and the
-# warmup _sync in _time_fn absorbs that trace before anything is timed
-_FETCH_FIRST = jax.jit(lambda x: x.ravel()[0])
+from chiptime import grad_probe, time_op                       # noqa: E402
 
 
-def _sync(out) -> float:
-    """Force REAL completion: fetch one element to host.  Over the remote
-    (axon) tunnel, ``block_until_ready`` can acknowledge before the chip
-    finishes; a 4-byte device_get cannot."""
-    leaf = jax.tree.leaves(out)[0]
-    return float(np.asarray(_FETCH_FIRST(leaf)))
-
-
-def _time_fn(fn, args, steps: int, reps: int = 3) -> float:
-    """Median seconds per call over ``reps`` timed loops of ``steps``."""
-    out = fn(*args)                       # compile
-    _sync(out)
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = fn(*args)
-        _sync(out)
-        times.append((time.perf_counter() - t0) / steps)
-    return statistics.median(times)
-
-
-def _grad_sum(fn):
-    """fwd+bwd probe: grad of sum(fn) wrt the first array argument(s)."""
-    def loss(*args):
-        return jnp.sum(fn(*args).astype(jnp.float32))
-    return jax.grad(loss)
-
-
-def bench_pair(name, xla_fn, pallas_fn, args, steps, results):
-    for tag, wrap in (('fwd', jax.jit),
-                      ('fwd+bwd', lambda f: jax.jit(_grad_sum(f)))):
-        t_x = _time_fn(wrap(xla_fn), args, steps)
-        t_p = _time_fn(wrap(pallas_fn), args, steps)
+def bench_pair(name, xla_fn, pallas_fn, args, steps, results, flops=None):
+    del steps                            # loop length is adaptive (chiptime)
+    for tag, wrap in (('fwd', lambda f: f),
+                      ('fwd+bwd', grad_probe)):
+        t_x = time_op(wrap(xla_fn), args)
+        t_p = time_op(wrap(pallas_fn), args)
         speedup = t_x / t_p
-        results.append({'op': name, 'pass': tag,
-                        'xla_us': round(t_x * 1e6, 1),
-                        'pallas_us': round(t_p * 1e6, 1),
-                        'pallas_speedup': round(speedup, 3)})
+        row = {'op': name, 'pass': tag,
+               'xla_us': round(t_x * 1e6, 1),
+               'pallas_us': round(t_p * 1e6, 1),
+               'pallas_speedup': round(speedup, 3)}
+        note = ''
+        if flops is not None:
+            # physically-impossible sanity column: >peak means the timing
+            # (or a compiler simplification) is lying
+            fl = flops * (3.0 if tag == 'fwd+bwd' else 1.0)
+            row['xla_tflops'] = round(fl / max(t_x, 1e-9) / 1e12, 1)
+            row['pallas_tflops'] = round(fl / max(t_p, 1e-9) / 1e12, 1)
+            note = (f"  [{row['xla_tflops']:6.1f} vs "
+                    f"{row['pallas_tflops']:6.1f} TF/s]")
+        results.append(row)
         print(f'{name:28s} {tag:8s} xla {t_x * 1e6:9.1f}us  '
-              f'pallas {t_p * 1e6:9.1f}us  speedup {speedup:6.3f}x',
+              f'pallas {t_p * 1e6:9.1f}us  speedup {speedup:6.3f}x{note}',
               flush=True)
 
 
@@ -130,7 +109,7 @@ def main() -> int:
         bmat = jnp.asarray(rng.randn(k, n) * 0.05, dtype)
         bench_pair(f'matmul {m}x{k}x{n}',
                    lambda p, q: jnp.dot(p, q), pallas_matmul,
-                   (a, bmat), args.steps, results)
+                   (a, bmat), args.steps, results, flops=2.0 * m * k * n)
 
     # --- attention at transformer shapes ------------------------------
     for b, s, heads, d in (((4, 1024, 8, 64), (2, 4096, 8, 64))

@@ -10,11 +10,11 @@ REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
 mkdir -p "$OUT"
 cd "$REPO" || exit 1
 
-timeout 900 python tools/pallas_microbench.py --steps 10 --only lrn \
+timeout 900 python tools/pallas_microbench.py --steps 50 --only lrn \
     --json "$OUT/micro_lrn.json"      > "$OUT/micro_lrn.log" 2>&1
-timeout 900 python tools/pallas_microbench.py --steps 10 --only matmul \
+timeout 900 python tools/pallas_microbench.py --steps 50 --only matmul \
     --json "$OUT/micro_matmul.json"   > "$OUT/micro_matmul.log" 2>&1
-timeout 1200 python tools/pallas_microbench.py --steps 10 --only attn \
+timeout 1200 python tools/pallas_microbench.py --steps 50 --only attn \
     --json "$OUT/micro_attn.json"     > "$OUT/micro_attn.log" 2>&1
 timeout 1200 python tools/alexnet_breakdown.py \
     --json "$OUT/alexnet_breakdown.json" > "$OUT/alexnet_breakdown.log" 2>&1
