@@ -47,9 +47,12 @@ class LRNLayer(Layer):
 
     def forward(self, params, inputs, ctx):
         x = inputs[0]  # (b, y, x, c)
-        from ..ops.pallas_kernels import lrn_pallas, pallas_enabled
-        if pallas_enabled():
-            return [lrn_pallas(x, self.nsize, self.alpha, self.beta,
+        from ..ops.pallas_kernels import lrn_fwd_profitable, lrn_hybrid
+        if lrn_fwd_profitable(x.shape[-1]):
+            # Pallas forward / XLA backward hybrid: on by default at the
+            # shapes where the fused forward measured ahead
+            # (receipts/micro_lrn.json; ops/pallas_kernels.py)
+            return [lrn_hybrid(x, self.nsize, self.alpha, self.beta,
                                self.knorm)]
         x32 = x.astype(jnp.float32)
         n = self.nsize

@@ -215,17 +215,30 @@ class LearnTask:
                 print(f'update round {self.start_counter - 1}', flush=True)
             sample_counter = 0
             self.net_trainer.start_round(self.start_counter)
+            # one-batch host->device lookahead: batch i+1's transfers are
+            # enqueued (stage_batch, async) before batch i's step is
+            # dispatched, so the host link rides behind device compute —
+            # the H2D half of the reference's prefetch pipeline
+            # (iter_thread_buffer covers the disk->host half)
+            pending = None
             for batch in self.itr_train:
                 if self.test_io == 0:
-                    tracer.before_update(batch_counter)
-                    self.net_trainer.update(batch)
-                    batch_counter += 1
+                    staged = self.net_trainer.stage_batch(batch)
+                    if pending is not None:
+                        tracer.before_update(batch_counter)
+                        self.net_trainer.update_staged(pending)
+                        batch_counter += 1
+                    pending = staged
                 sample_counter += 1
                 if sample_counter % self.print_step == 0 and not self.silent:
                     elapsed = int(time.time() - start)
                     print(f'round {self.start_counter - 1:8d}:'
                           f'[{sample_counter:8d}] {elapsed} sec elapsed',
                           flush=True)
+            if pending is not None:
+                tracer.before_update(batch_counter)
+                self.net_trainer.update_staged(pending)
+                batch_counter += 1
             if self.test_io == 0:
                 sys.stderr.write(f'[{self.start_counter}]')
                 if not self.itr_evals:

@@ -121,8 +121,13 @@ class NetTrainer:
                 raise ValueError(f'nan_action must be none|skip, got {val}')
             self.nan_action = val
         if name == 'use_pallas':
-            # process-wide switch read by ops.pallas_kernels.pallas_enabled
-            os.environ['CXXNET_PALLAS'] = val
+            # process-wide tri-state read by ops.pallas_kernels.pallas_mode:
+            # 1 = force every pallas path, 0 = disable even the measured
+            # winners, auto (default) = per-op profitability gates
+            if val.strip().lower() == 'auto':
+                os.environ.pop('CXXNET_PALLAS', None)
+            else:
+                os.environ['CXXNET_PALLAS'] = val
         if name == 'compute_type':
             table = {'float32': jnp.float32, 'bfloat16': jnp.bfloat16,
                      'float16': jnp.float16}
@@ -297,9 +302,12 @@ class NetTrainer:
 
         Requires ``update_period == 1`` (each scan step applies the
         optimizer).  Returns ``fn(params, opt_state, data_stack,
-        label_stack, rng0, epoch0) -> (params, opt_state, last_loss)``;
-        drive it through :meth:`update_n_on_device` to keep trainer
-        counters coherent.
+        label_stack, rng0, epoch0, mask_stack, rnd) -> (params, opt_state,
+        last_loss)`` with the compiled step count attached as
+        ``fn.n_steps``; drive it through :meth:`update_n_on_device` to keep
+        trainer counters coherent (round-dependent layers and tail-batch
+        masks follow the same semantics as the per-step :meth:`update`
+        path: ``rnd`` is traced, ``mask_stack`` rides the batch stack).
         """
         if self.update_period != 1:
             raise ValueError('compile_multi_step requires update_period=1')
@@ -310,7 +318,7 @@ class NetTrainer:
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def multi_step(params, opt_state, data_stack, label_stack, rng0,
-                       epoch0):
+                       epoch0, mask_stack, rnd):
             nstack = data_stack.shape[0]
 
             def body(carry, t):
@@ -319,10 +327,12 @@ class NetTrainer:
                     data_stack, t % nstack, keepdims=False)
                 label = jax.lax.dynamic_index_in_dim(
                     label_stack, t % nstack, keepdims=False)
+                mask = jax.lax.dynamic_index_in_dim(
+                    mask_stack, t % nstack, keepdims=False)
                 rng = jax.random.fold_in(rng0, t)
                 (loss, _), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params, data, label, (), None,
-                                           rng, 0)
+                    loss_fn, has_aux=True)(params, data, label, (), mask,
+                                           rng, rnd)
                 if nan_skip:
                     ok = jnp.isfinite(loss)
                     for g in jax.tree.leaves(grads):
@@ -337,7 +347,13 @@ class NetTrainer:
                 body, (params, opt_state, epoch0), jnp.arange(n_steps))
             return params, opt_state, losses[-1]
 
-        return multi_step
+        def multi_fn(params, opt_state, data_stack, label_stack, rng0,
+                     epoch0, mask_stack, rnd):
+            return multi_step(params, opt_state, data_stack, label_stack,
+                              rng0, epoch0, mask_stack, rnd)
+
+        multi_fn.n_steps = n_steps
+        return multi_fn
 
     def shard_batch_stack(self, stack: np.ndarray, cast: bool = True):
         """Stage a (nstack, batch, ...) stack of batches on device with the
@@ -353,18 +369,43 @@ class NetTrainer:
         return jax.device_put(jnp.asarray(stack), sh)
 
     def update_n_on_device(self, multi_fn, data_stack, label_stack,
-                           n_steps: int):
+                           n_steps: int = None, mask_stack=None):
         """Run a :meth:`compile_multi_step` function over pre-staged stacks,
-        keeping epoch/sample counters coherent.  Returns the last loss
-        (device scalar — fetching it is a real completion barrier)."""
+        keeping epoch/sample counters coherent.  ``n_steps`` defaults to —
+        and must match — the step count baked into ``multi_fn`` at compile
+        time, so the counters can never desynchronize from the steps
+        actually executed.  ``mask_stack`` (nstack, batch) defaults to
+        all-ones (no tail-batch pads).  Returns the last loss (device
+        scalar — fetching it is a real completion barrier)."""
+        compiled = getattr(multi_fn, 'n_steps', None)
+        if n_steps is None:
+            n_steps = compiled
+        elif compiled is not None and n_steps != compiled:
+            raise ValueError(
+                f'n_steps={n_steps} does not match the step count '
+                f'{compiled} compiled into multi_fn')
+        if mask_stack is None:
+            mask_stack = self._ones_mask_stack(data_stack.shape[:2])
         rng0 = jax.random.fold_in(self._rng, 1 + self.sample_counter * 131 +
                                   self.round)
         self.params, self.opt_state, loss = multi_fn(
             self.params, self.opt_state, data_stack, label_stack, rng0,
-            self.epoch_counter)
+            self.epoch_counter, mask_stack, self.round)
         self.epoch_counter += n_steps
         self.sample_counter += n_steps
         return loss
+
+    def _ones_mask_stack(self, shape):
+        """Cached on-device all-ones (nstack, batch) loss-mask stack for
+        :meth:`update_n_on_device` — the common no-pad case costs no
+        per-call H2D transfer."""
+        key = ('stack',) + tuple(shape)
+        cached = self._ones_mask_cache.get(key)
+        if cached is None:
+            cached = self.shard_batch_stack(
+                np.ones(shape, np.float32), cast=False)
+            self._ones_mask_cache[key] = cached
+        return cached
 
     # --- training ---------------------------------------------------------
     def start_round(self, round_: int) -> None:
@@ -404,12 +445,15 @@ class NetTrainer:
                         seen[key] = d
         return bad
 
-    def update(self, batch) -> None:
-        """One minibatch through forward/backward/(maybe) update —
-        the reference hot loop (``nnet_impl:141-185``)."""
-        do_update = (self.sample_counter + 1) % self.update_period == 0
-        rng = jax.random.fold_in(self._rng, 1 + self.sample_counter * 131 +
-                                 self.round)
+    def stage_batch(self, batch):
+        """Begin the async host->device staging of a batch: every
+        ``device_put`` here only ENQUEUES its transfer, so calling this
+        for batch i+1 before dispatching batch i's step overlaps the host
+        link with compute (the H2D half of the reference's prefetch
+        design, ``iter_thread_buffer``; the device half is
+        :meth:`update_staged`).  Returns an opaque handle for
+        :meth:`update_staged`.  Safe because the batch adapters allocate
+        fresh arrays per batch (io/iter_batch.py)."""
         data = self._shard_batch(batch.data)
         label = self._shard_batch(batch.label, cast=False)
         extra = tuple(self._shard_batch(e) for e in batch.extra_data)
@@ -425,6 +469,23 @@ class NetTrainer:
             mask = self._shard_batch(mask, cast=False)
         else:
             mask = self._ones_mask(bs)
+        host_label = (np.asarray(batch.label)
+                      if self.eval_train and len(self.train_metric) else None)
+        return (data, label, extra, mask, host_label, bs,
+                batch.num_batch_padd)
+
+    def update(self, batch) -> None:
+        """One minibatch through forward/backward/(maybe) update —
+        the reference hot loop (``nnet_impl:141-185``)."""
+        self.update_staged(self.stage_batch(batch))
+
+    def update_staged(self, staged) -> None:
+        """Dispatch the training step for a batch staged by
+        :meth:`stage_batch`."""
+        data, label, extra, mask, host_label, bs, num_batch_padd = staged
+        do_update = (self.sample_counter + 1) % self.update_period == 0
+        rng = jax.random.fold_in(self._rng, 1 + self.sample_counter * 131 +
+                                 self.round)
         old_pending = self._pending_train_eval
         self._pending_train_eval = None
         (self.params, self.opt_state, self.grad_acc, loss, evals) = \
@@ -432,16 +493,16 @@ class NetTrainer:
                                 data, label, extra, mask, rng,
                                 self.epoch_counter, self.round,
                                 do_update=do_update)
-        if self.eval_train and len(self.train_metric):
+        if host_label is not None:
             # defer this step's metric readback one step: by the next
             # update() (or evaluate()) the values are already on host, so
             # no per-step device sync — the analogue of the reference's
             # reuse of already-copied eval nodes (nnet_impl:174-180)
-            label_info = _HostLabelInfo(np.asarray(batch.label),
+            label_info = _HostLabelInfo(host_label,
                                         self.net_cfg.label_name_map,
                                         self.net_cfg.label_range)
             self._pending_train_eval = (
-                loss, evals, label_info, bs - batch.num_batch_padd)
+                loss, evals, label_info, bs - num_batch_padd)
         if old_pending is not None:
             self._drain_train_eval(old_pending)
         if do_update:

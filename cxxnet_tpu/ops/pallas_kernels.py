@@ -39,11 +39,36 @@ except Exception:  # pragma: no cover
     _VMEM = None
 
 
+def pallas_mode() -> str:
+    """Tri-state Pallas switch: ``'on'`` (config ``use_pallas=1`` /
+    ``CXXNET_PALLAS=1`` forces every Pallas path), ``'off'`` (explicit 0
+    disables even the measured-profitable ones), ``'auto'`` (unset: each
+    op consults its own receipts-derived profitability gate — see
+    ``lrn_fwd_profitable`` and receipts/micro_*.json)."""
+    v = os.environ.get('CXXNET_PALLAS')
+    if v is None or not v.strip():
+        return 'auto'
+    return ('on' if v.strip().lower() in ('1', 'true', 'yes', 'on')
+            else 'off')
+
+
 def pallas_enabled() -> bool:
-    """Opt-in switch for the Pallas paths (config ``use_pallas=1`` sets it
-    process-wide; default off until benchmarked ahead on hardware)."""
-    return os.environ.get('CXXNET_PALLAS', '0').strip().lower() \
-        in ('1', 'true', 'yes', 'on')
+    """True only when Pallas paths are explicitly forced on."""
+    return pallas_mode() == 'on'
+
+
+def lrn_fwd_profitable(c: int) -> bool:
+    """Whether the Pallas LRN *forward* beats XLA at channel count ``c``
+    on this backend.  From receipts/micro_lrn.json (TPU v5 lite, bf16):
+    4.18x at c=256 (MXU-aligned band matmul), 0.98x at c=96 (tile
+    underfill) — so the gate is lane-aligned channel counts on a real
+    TPU.  The Pallas LRN *backward* loses at every measured shape
+    (0.58-0.70x), which is why the default path is ``lrn_hybrid``."""
+    if pallas_mode() == 'off':
+        return False
+    if pallas_mode() == 'on':
+        return True
+    return not _interpret() and c % 128 == 0
 
 
 def _interpret() -> bool:
@@ -179,6 +204,49 @@ def _lrn_vjp_bwd(nsize, alpha, beta, knorm, res, g):
 
 
 lrn_pallas.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def lrn_hybrid(x, nsize: int, alpha: float, beta: float, knorm: float):
+    """Cross-channel LRN: Pallas forward, XLA backward.
+
+    The measured split (receipts/micro_lrn.json): the fused forward wins
+    up to 4.18x where the band matmul is MXU-aligned, but the Pallas
+    backward loses to XLA everywhere (0.58-0.70x) — XLA fuses the two
+    elementwise chains around the window-sum better than the one-kernel
+    version, which recomputes ``norm**-beta`` twice per tile.  So the
+    backward here is plain jnp ops (the cumsum window trick of
+    ``layers/norm.py``) on the residuals the Pallas forward already
+    produced."""
+    out, _ = _lrn_fwd_impl(x, nsize, alpha, beta, knorm)
+    return out
+
+
+def _lrn_hybrid_fwd(x, nsize, alpha, beta, knorm):
+    out, norm = _lrn_fwd_impl(x, nsize, alpha, beta, knorm)
+    return out, (x, norm.reshape(x.shape))
+
+
+def _lrn_hybrid_bwd(nsize, alpha, beta, knorm, res, g):
+    x, norm = res
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    npow = jnp.power(norm, -beta)
+    t = g32 * x32 * npow / norm
+    n = nsize
+    half_lo = (n - 1) // 2
+    half_hi = n - 1 - half_lo
+    c = x.shape[-1]
+    # dx_j sums t_i over windows i that CONTAIN j — the transposed
+    # window [j-half_hi, j+half_lo], hence the swapped pad widths
+    pad = jnp.pad(t, [(0, 0)] * (x.ndim - 1) + [(half_hi + 1, half_lo)])
+    cums = jnp.cumsum(pad, axis=-1)
+    win = cums[..., n:n + c] - cums[..., 0:c]
+    dx = g32 * npow - 2.0 * beta * (alpha / n) * x32 * win
+    return (dx.astype(x.dtype),)
+
+
+lrn_hybrid.defvjp(_lrn_hybrid_fwd, _lrn_hybrid_bwd)
 
 
 # --- tiled matmul (fullc) -------------------------------------------------

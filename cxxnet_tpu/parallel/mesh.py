@@ -13,21 +13,25 @@ the compiler-native form of the reference's WFBP overlap.
 
 Sharding rules for the 2-D mesh ``(data, model)``:
 * batch:   P('data') on the leading axis,
-* TP-eligible layers (fullc; ungrouped conv) alternate Megatron-style
-  column/row parallelism along the topological order: a column-parallel
+* TP-eligible layers (fullc; ungrouped conv) pair Megatron-style
+  column/row parallelism along each DATAFLOW chain: a column-parallel
   layer shards its OUTPUT features — fullc wmat ``(nin, nh)`` →
   P(None, 'model'), conv HWIO → P(None, None, None, 'model'), bias
-  P('model') — leaving its activation sharded on ``model``; the next
-  eligible layer is row-parallel, sharding its INPUT features — fullc
-  P('model', None), conv P(None, None, 'model', None), bias replicated —
-  so it consumes the sharded activation in place and a single psum
-  restores the replicated activation.  Paired boundaries therefore cost
-  one all-reduce instead of the all-gather-per-layer of naive
-  output-sharding-everywhere (the AlexNet fc6→fc7→fc8 chain is the case
-  where this pays).  XLA's SPMD partitioner propagates the activation
-  shardings through the elementwise/pooling layers in between and inserts
-  the collectives; a layer whose feature axis does not divide ``tp``
-  falls back to the other orientation, then to replication.
+  P('model') — leaving its activation sharded on ``model``; an eligible
+  layer whose INPUT activation is model-sharded goes row-parallel —
+  fullc P('model', None), conv P(None, None, 'model', None), bias
+  replicated — consuming the shards in place so a single psum restores
+  the replicated activation.  Paired boundaries therefore cost one
+  all-reduce instead of the all-gather-per-layer of naive
+  output-sharding-everywhere (the AlexNet fc6→fc7→fc8 chain and each
+  Inception tower's 1x1→3x3 pair are the cases where this pays).
+  Shardedness is tracked per graph node (``param_shardings``), flowing
+  through elementwise/pooling layers and stopping at flatten/LRN/concat,
+  so branched nets pair within a branch rather than across unrelated
+  chains.  XLA's SPMD partitioner propagates the activation shardings
+  and inserts the collectives; a layer whose feature axis does not
+  divide ``tp`` falls back to the other orientation, then to
+  replication.
 * everything else replicated.
 
 Scope note: for the CNN zoo (AlexNet era, model fits one chip many times
@@ -103,32 +107,69 @@ _TP_SPECS = {
 }
 
 
+# Single-in/single-out layers whose output keeps the input's channel/
+# feature sharding: elementwise activations and spatial poolings.  NOT
+# flatten (interleaves channels into features), NOT LRN (cross-channel
+# window needs a halo), NOT concat/split (multi-node) — after those the
+# activation is treated as replicated and the next eligible layer starts
+# a fresh col/row pair.
+_SHARDING_TRANSPARENT = frozenset((
+    lbase.kRectifiedLinear, lbase.kSigmoid, lbase.kTanh, lbase.kSoftplus,
+    lbase.kDropout, lbase.kMaxPooling, lbase.kSumPooling, lbase.kAvgPooling,
+    lbase.kXelu, lbase.kReluMaxPooling, lbase.kInsanity,
+    lbase.kInsanityPooling, lbase.kPRelu, lbase.kBatchNorm, lbase.kBias,
+))
+
+
 def param_shardings(net, params, mesh: Mesh) -> Dict:
     """Per-leaf NamedSharding pytree matching the params structure.
 
-    With ``tp > 1``, eligible layers alternate column/row parallelism in
-    topological order (see module docstring); the parity advances only on
-    layers that actually got sharded, so an ineligible layer between a
-    col/row pair doesn't break the pairing."""
+    With ``tp > 1``, eligible layers pair column/row parallelism along
+    each DATAFLOW chain (see module docstring): a layer whose input
+    activation is model-sharded — because its producer was column-parallel
+    and everything in between preserves channel sharding — goes
+    row-parallel (consuming the shards in place, one psum restores
+    replication); otherwise it starts a new pair as column-parallel.
+    Tracking shardedness per node instead of alternating a global parity
+    keeps the one-psum-per-pair premise true on branched nets
+    (Inception towers pair within each tower), where a sorted-index walk
+    would mark a trunk-fed layer 'row' and force GSPMD to reshard."""
     tp = mesh.shape.get('model', 1)
     out = {}
-    parity = 0
-    for key in sorted(params.keys(), key=int):
-        fields = params[key]
-        i = int(key)
-        info = net.cfg.layers[i]
-        layer = net.layers[i]
-        mode = None
-        if tp > 1:
-            mode = _layer_tp_mode(info.type, fields, layer.param.num_group,
-                                  tp, 'col' if parity % 2 == 0 else 'row')
-        if mode is None:
-            specs = {f: P() for f in fields}
+    sharded_nodes = set()   # node ids whose activation is model-sharded
+    for i, info in enumerate(net.cfg.layers):
+        key = str(i)
+        fields = params.get(key)
+        if fields is not None:
+            mode = None
+            if tp > 1:
+                prefer = ('row' if any(n in sharded_nodes
+                                       for n in info.nindex_in) else 'col')
+                mode = _layer_tp_mode(info.type, fields,
+                                      net.layers[i].param.num_group, tp,
+                                      prefer)
+            if mode is None:
+                specs = {f: P() for f in fields}
+            else:
+                table = _TP_SPECS[(info.type, mode)]
+                # bias divisibility rides the wmat check for 'col' (same axis)
+                specs = {f: table.get(f, P()) for f in fields}
+            out[key] = {f: NamedSharding(mesh, specs[f]) for f in fields}
+            if mode == 'col':
+                sharded_nodes.update(info.nindex_out)
+            elif (mode is None and info.type in _SHARDING_TRANSPARENT
+                  and any(n in sharded_nodes for n in info.nindex_in)):
+                # parameterized but channel-wise layers (batch_norm, bias,
+                # prelu) pass a sharded activation through unchanged —
+                # their per-channel params stay replicated; without this
+                # the conv->bn->relu->conv chains of Inception-BN could
+                # never form a col/row pair
+                sharded_nodes.update(info.nindex_out)
+            else:                # row/other: psum-restored or replicated out
+                sharded_nodes.difference_update(info.nindex_out)
+        elif (info.type in _SHARDING_TRANSPARENT
+              and any(n in sharded_nodes for n in info.nindex_in)):
+            sharded_nodes.update(info.nindex_out)
         else:
-            table = _TP_SPECS[(info.type, mode)]
-            # bias divisibility rides the wmat check for 'col' (same axis)
-            specs = {f: table.get(f, P()) for f in fields}
-            parity += 1
-        out[key] = {f: NamedSharding(mesh, specs[f])
-                    for f in fields}
+            sharded_nodes.difference_update(info.nindex_out)
     return out

@@ -237,6 +237,28 @@ def test_tp_alternating_matches_single_device(tp):
                         f'(specs={specs})')
 
 
+def test_tp_pairs_form_through_batch_norm():
+    """Per-node shardedness must flow through parameterized channel-wise
+    layers (batch_norm): in Inception-BN every conv is followed by BN, so
+    if BN broke the chain no col/row pair could ever form and every
+    boundary would pay an all-gather instead of one psum."""
+    from cxxnet_tpu.layers import base as lbase
+    from cxxnet_tpu.models import inception_bn_conf
+
+    tr = NetTrainer(parse_config_string(
+        inception_bn_conf()
+        + 'batch_size = 8\ndev = tpu:0-7\ntensor_parallel = 2\n'))
+    tr.init_model()
+    row_convs = 0
+    for i, e in enumerate(tr.net_cfg.layers):
+        f = tr.params.get(str(i))
+        if f and e.type == lbase.kConv:
+            s = str(f['wmat'].sharding.spec)
+            if s == "PartitionSpec(None, None, 'model', None)":
+                row_convs += 1
+    assert row_convs >= 5, f'expected row-parallel convs, got {row_convs}'
+
+
 def test_tp_row_col_alternation_layout():
     """Unit check of the parity walk: fc 16->16->16 chain with tp=2 must
     produce col, row, then col again; row-parallel bias stays replicated."""

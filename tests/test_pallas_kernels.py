@@ -54,6 +54,44 @@ def test_lrn_pallas_grad_matches_autodiff(nsize):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize('nsize', [5, 4])
+def test_lrn_hybrid_matches_full_pallas(nsize):
+    """lrn_hybrid (pallas fwd / XLA bwd, the default TPU path at
+    MXU-aligned channel counts) must agree with lrn_pallas in both
+    passes."""
+    from cxxnet_tpu.ops.pallas_kernels import lrn_hybrid
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.rand(2, 2, 3, 32).astype(np.float32) + 0.1)
+    out_h = lrn_hybrid(x, nsize, 0.001, 0.75, 1.0)
+    out_p = lrn_pallas(x, nsize, 0.001, 0.75, 1.0)
+    np.testing.assert_allclose(np.asarray(out_h), np.asarray(out_p),
+                               rtol=1e-5, atol=1e-6)
+    g_h = jax.grad(lambda x: jnp.sum(
+        lrn_hybrid(x, nsize, 0.001, 0.75, 1.0) ** 2))(x)
+    g_p = jax.grad(lambda x: jnp.sum(
+        lrn_pallas(x, nsize, 0.001, 0.75, 1.0) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_h), np.asarray(g_p),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lrn_fwd_profitable_gate(monkeypatch):
+    """'auto' enables the hybrid only on a real TPU at lane-aligned
+    channel counts; explicit on/off override both ways."""
+    from cxxnet_tpu.ops import pallas_kernels as pk
+    monkeypatch.delenv('CXXNET_PALLAS', raising=False)
+    assert pk.pallas_mode() == 'auto'
+    # off a real TPU (interpret mode) auto never turns pallas on
+    monkeypatch.setattr(pk, '_interpret', lambda: True)
+    assert not pk.lrn_fwd_profitable(256)
+    monkeypatch.setattr(pk, '_interpret', lambda: False)
+    assert pk.lrn_fwd_profitable(256)
+    assert not pk.lrn_fwd_profitable(96)
+    monkeypatch.setenv('CXXNET_PALLAS', '0')
+    assert not pk.lrn_fwd_profitable(256)
+    monkeypatch.setenv('CXXNET_PALLAS', '1')
+    assert pk.lrn_fwd_profitable(96)
+
+
 def test_lrn_pallas_under_jit():
     rng = np.random.RandomState(2)
     x = jnp.asarray(rng.rand(4, 2, 2, 16).astype(np.float32))
