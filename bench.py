@@ -38,6 +38,15 @@ import os
 import struct
 import subprocess
 import sys
+
+# Persistent XLA compilation cache: the AlexNet train-step scan takes
+# many minutes to compile over the dev-harness tunnel, and every bench
+# mode / A-B experiment repays it from scratch without this.  Must be in
+# the environment before jax initializes its backend.
+os.environ.setdefault(
+    'JAX_COMPILATION_CACHE_DIR',
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), '.jax_cache'))
+os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '2')
 import time
 
 import numpy as np
@@ -115,7 +124,7 @@ def _peak_flops() -> float:
 
 
 def _throughput(conf: str, batch_size: int, shape, metric: str,
-                baseline: float, last_key: str) -> int:
+                baseline: float) -> int:
     import statistics
 
     from cxxnet_tpu.nnet.trainer import NetTrainer
@@ -183,15 +192,24 @@ def _throughput(conf: str, batch_size: int, shape, metric: str,
         'tflops': round(achieved / 1e12, 2) if measured else None,
         'mfu': round(achieved / peak, 4) if measured and peak else None,
         'step_ms': round(per_step * 1e3, 3),
-        'dispatch_ms': round(statistics.median(t1s) * 1e3, 1),
+        # wall time of a 1-step dispatch minus the step itself = the pure
+        # link/dispatch overhead one un-pipelined update() pays per call
+        'dispatch_ms': round(statistics.median(t1s) * 1e3 - per_step * 1e3,
+                             1),
         'timing': 'scan-in-jit K-vs-1 quotient',
     })
     return 0
 
 
+def _bench_batch(default: int) -> int:
+    """``CXXNET_BENCH_BATCH`` overrides a bench's default batch size
+    (batch-scaling experiments, e.g. GoogLeNet 128 vs 256)."""
+    return int(os.environ.get('CXXNET_BENCH_BATCH', default))
+
+
 def bench_alexnet() -> int:
     from cxxnet_tpu.models import alexnet_conf
-    batch_size = 256
+    batch_size = _bench_batch(256)
     conf = alexnet_conf() + f"""
 batch_size = {batch_size}
 eta = 0.01
@@ -205,26 +223,12 @@ compute_type = bfloat16
 """
     return _throughput(conf, batch_size, (3, 227, 227),
                        'alexnet_images_per_sec_per_chip',
-                       BASELINE_IMAGES_PER_SEC, last_key='16')
-
-
-def _layer_index(conf: str, name: str = None) -> str:
-    """Index (as str) of the named layer — or the last fullc — for the
-    bench sync read-back."""
-    from cxxnet_tpu.nnet.net_config import NetConfig
-    from cxxnet_tpu.utils.config import parse_config_string
-    cfg = NetConfig()
-    cfg.configure(parse_config_string(conf))
-    if name is not None:
-        return str(next(i for i, e in enumerate(cfg.layers)
-                        if e.name == name))
-    return str(max(i for i, e in enumerate(cfg.layers)
-                   if e.type == 1))  # kFullConnect
+                       BASELINE_IMAGES_PER_SEC)
 
 
 def bench_inception_bn() -> int:
     from cxxnet_tpu.models import inception_bn_conf
-    batch_size = 128
+    batch_size = _bench_batch(128)
     conf = inception_bn_conf() + f"""
 batch_size = {batch_size}
 eta = 0.01
@@ -236,13 +240,12 @@ compute_type = bfloat16
 """
     return _throughput(conf, batch_size, (3, 224, 224),
                        'inception_bn_images_per_sec_per_chip',
-                       BASELINE_INCEPTION_IMAGES_PER_SEC,
-                       last_key=_layer_index(conf))
+                       BASELINE_INCEPTION_IMAGES_PER_SEC)
 
 
 def bench_googlenet() -> int:
     from cxxnet_tpu.models import googlenet_conf
-    batch_size = 128
+    batch_size = _bench_batch(128)
     conf = googlenet_conf() + f"""
 batch_size = {batch_size}
 eta = 0.01
@@ -254,13 +257,12 @@ compute_type = bfloat16
 """
     return _throughput(conf, batch_size, (3, 224, 224),
                        'googlenet_images_per_sec_per_chip',
-                       BASELINE_GOOGLENET_IMAGES_PER_SEC,
-                       last_key=_layer_index(conf, 'loss3_fc'))
+                       BASELINE_GOOGLENET_IMAGES_PER_SEC)
 
 
 def bench_vgg16() -> int:
     from cxxnet_tpu.models import vgg16_conf
-    batch_size = 64
+    batch_size = _bench_batch(64)
     conf = vgg16_conf() + f"""
 batch_size = {batch_size}
 eta = 0.01
@@ -272,8 +274,7 @@ compute_type = bfloat16
 """
     return _throughput(conf, batch_size, (3, 224, 224),
                        'vgg16_images_per_sec_per_chip',
-                       BASELINE_VGG16_IMAGES_PER_SEC,
-                       last_key=_layer_index(conf, 'fc8'))
+                       BASELINE_VGG16_IMAGES_PER_SEC)
 
 
 def bench_e2e_alexnet() -> int:
@@ -363,11 +364,19 @@ compute_type = bfloat16
         link_s = time.perf_counter() - t0
         link_mb = probe.nbytes / 1e6                     # bf16 on the wire
 
-        n_done, t0 = 0, time.perf_counter()
+        # production path: one-batch lookahead (stage i+1 before stepping
+        # i) so the host link overlaps device compute — same loop shape as
+        # main.py:_train_rounds
+        n_done, t0, pending = 0, time.perf_counter(), None
         for _round in range(2):
             for b in it:
-                trainer.update(b)
+                staged = trainer.stage_batch(b)
+                if pending is not None:
+                    trainer.update_staged(pending)
+                pending = staged
                 n_done += b.batch_size - b.num_batch_padd
+        if pending is not None:
+            trainer.update_staged(pending)
         jax.device_get(trainer.params['16']['bias'])
         dt = time.perf_counter() - t0
 

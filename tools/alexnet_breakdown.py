@@ -1,7 +1,11 @@
 #!/usr/bin/env python
-"""Per-layer time breakdown of the AlexNet train step on the real chip.
+"""Per-layer time breakdown of a model-zoo train step on the real chip.
 
-    python tools/alexnet_breakdown.py [--batch 256] [--json out.json]
+    python tools/alexnet_breakdown.py [--model alexnet] [--batch 256]
+                                      [--json out.json]
+
+``--model googlenet`` attributes the inception towers (the MFU-0.12
+question); ``alexnet`` is the default and the historical name.
 
 The jax profiler cannot trace through the remote (axon) tunnel, so this
 tool derives the MFU breakdown directly: it times the full optimizer step
@@ -24,6 +28,12 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault(            # persistent XLA cache — see chiptime.py
+    'JAX_COMPILATION_CACHE_DIR',
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 '.jax_cache'))
+os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '2')
 
 import jax                                                     # noqa: E402
 import jax.numpy as jnp                                        # noqa: E402
@@ -55,20 +65,29 @@ def _time_step_scan(tr, dstack, lstack, iters=10, reps=3):
     return (min(tks) - min(t1s)) / (iters - 1)
 
 
+_MODELS = {  # name -> (conf fn name, default batch, input shape)
+    'alexnet': ('alexnet_conf', 256, (3, 227, 227)),
+    'inception_bn': ('inception_bn_conf', 128, (3, 224, 224)),
+    'googlenet': ('googlenet_conf', 128, (3, 224, 224)),
+    'vgg16': ('vgg16_conf', 64, (3, 224, 224)),
+}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument('--batch', type=int, default=256)
+    ap.add_argument('--model', default='alexnet', choices=sorted(_MODELS))
+    ap.add_argument('--batch', type=int, default=None)
     ap.add_argument('--json', default=None)
     args = ap.parse_args()
 
-    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu import models
     from cxxnet_tpu.layers import ForwardContext
-    from cxxnet_tpu.models import alexnet_conf
     from cxxnet_tpu.nnet.trainer import NetTrainer
     from cxxnet_tpu.utils.config import parse_config_string
 
-    bs = args.batch
-    conf = alexnet_conf() + f"""
+    conf_fn, default_bs, shape = _MODELS[args.model]
+    bs = args.batch or default_bs
+    conf = getattr(models, conf_fn)() + f"""
 batch_size = {bs}
 eta = 0.01
 momentum = 0.9
@@ -81,7 +100,7 @@ compute_type = bfloat16
     tr.init_model()
     rng = np.random.RandomState(0)
     dstack = tr.shard_batch_stack(
-        rng.randint(0, 256, (2, bs, 3, 227, 227), dtype=np.uint8))
+        rng.randint(0, 256, (2, bs) + shape, dtype=np.uint8))
     lstack = tr.shard_batch_stack(
         rng.randint(0, 1000, (2, bs, 1)).astype(np.float32), cast=False)
     data, label = dstack[0], lstack[0]
@@ -153,7 +172,8 @@ compute_type = bfloat16
           f'elementwise, optimizer, dispatch)')
     if args.json:
         with open(args.json, 'w') as f:
-            json.dump({'batch': bs, 'step_ms': round(t_step * 1e3, 2),
+            json.dump({'model': args.model, 'batch': bs,
+                       'step_ms': round(t_step * 1e3, 2),
                        'fwd_ms': round(t_fwd * 1e3, 2),
                        'achieved_tflops':
                            round(step_flops / t_step / 1e12, 2),

@@ -27,8 +27,17 @@ before the chip finishes; a device_get cannot).
 
 from __future__ import annotations
 
+import os
 import statistics
 import time
+
+# persistent XLA compile cache (see bench.py): kernel A/B sweeps recompile
+# dozens of loop programs; over the tunnel each costs minutes without this
+os.environ.setdefault(
+    'JAX_COMPILATION_CACHE_DIR',
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 '.jax_cache'))
+os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '2')
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +51,16 @@ def make_loop(fn, length: int):
     def run(*args):
         def body(s, _):
             eps = (s * 1e-30).astype(args[0].dtype)
-            out = fn(args[0] + eps, *args[1:])
+            # perturb ONE element, not all of them: `a + eps` distributes
+            # through linear ops — XLA can rewrite dot(a+eps, b) as
+            # dot(a,b) + eps*colsum(b), hoist the loop-invariant dot out
+            # of the scan, and "measure" above-peak FLOP rates (the r3
+            # matmul receipts showed 249 TF/s on a 197-peak chip — the
+            # tell).  A scatter-add into [0,...,0] forces a genuine
+            # re-execution; its cost is one copy pass over args[0],
+            # the same bandwidth the old broadcast-add already paid.
+            a0 = args[0].at[(0,) * args[0].ndim].add(eps)
+            out = fn(a0, *args[1:])
             # consume EVERY output leaf through a non-factorable reduction:
             # a single-element carry (out[0]) lets XLA push the slice into
             # the op and compute one row of a matmul / one window of an

@@ -3,7 +3,7 @@
 real chip, at the shapes the framework actually runs (AlexNet LRN/fullc,
 transformer attention).
 
-    python tools/pallas_microbench.py [--steps 50] [--json out.json]
+    python tools/pallas_microbench.py [--json out.json]
 
 Each op is timed fwd-only and fwd+bwd (grad through the op), looped
 on-device inside one jit with the dispatch cost cancelled (see
@@ -23,6 +23,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+os.environ.setdefault(            # persistent XLA cache — see chiptime.py
+    'JAX_COMPILATION_CACHE_DIR',
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 '.jax_cache'))
+os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '2')
+
 import jax                                                     # noqa: E402
 import jax.numpy as jnp                                        # noqa: E402
 import numpy as np                                             # noqa: E402
@@ -30,8 +36,8 @@ import numpy as np                                             # noqa: E402
 from chiptime import grad_probe, time_op                       # noqa: E402
 
 
-def bench_pair(name, xla_fn, pallas_fn, args, steps, results, flops=None):
-    del steps                            # loop length is adaptive (chiptime)
+def bench_pair(name, xla_fn, pallas_fn, args, results, flops=None):
+    # loop length is adaptive (chiptime.time_op auto-sizes iterations)
     for tag, wrap in (('fwd', lambda f: f),
                       ('fwd+bwd', grad_probe)):
         t_x = time_op(wrap(xla_fn), args)
@@ -70,7 +76,6 @@ def lrn_xla(x, nsize, alpha, beta, knorm):
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument('--steps', type=int, default=30)
     ap.add_argument('--json', default=None)
     ap.add_argument('--dtype', default='bfloat16',
                     choices=['bfloat16', 'float32'])
@@ -100,7 +105,7 @@ def main() -> int:
                    functools.partial(lrn_xla, nsize=5, alpha=1e-4,
                                      beta=0.75, knorm=1.0),
                    lambda y: lrn_pallas(y, 5, 1e-4, 0.75, 1.0),
-                   (x,), args.steps, results)
+                   (x,), results)
 
     # --- fullc matmuls at AlexNet shapes ------------------------------
     for m, k, n in (((256, 9216, 4096), (256, 4096, 4096),
@@ -109,7 +114,7 @@ def main() -> int:
         bmat = jnp.asarray(rng.randn(k, n) * 0.05, dtype)
         bench_pair(f'matmul {m}x{k}x{n}',
                    lambda p, q: jnp.dot(p, q), pallas_matmul,
-                   (a, bmat), args.steps, results, flops=2.0 * m * k * n)
+                   (a, bmat), results, flops=2.0 * m * k * n)
 
     # --- attention at transformer shapes ------------------------------
     for b, s, heads, d in (((4, 1024, 8, 64), (2, 4096, 8, 64))
@@ -123,7 +128,7 @@ def main() -> int:
                 f'{" causal" if causal else ""}',
                 functools.partial(attention_reference, causal=causal),
                 functools.partial(flash_attention, causal=causal),
-                (q, k, v), args.steps, results)
+                (q, k, v), results)
 
     if args.json:
         with open(args.json, 'w') as f:

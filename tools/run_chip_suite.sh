@@ -1,31 +1,62 @@
 #!/bin/sh
 # One-shot on-chip measurement suite: run when the TPU tunnel is up.
-# Produces the per-op Pallas receipts, the AlexNet per-layer breakdown,
-# and the BASELINE.md bench rows, each as JSON under $OUT (default
-# /tmp/chip_suite). Each step is independently timeout-bounded so a
-# tunnel wedge mid-suite still leaves the earlier results on disk.
+#
+# Durability contract (round-3 postmortem: a round-end kill lost the most
+# valuable artifacts because they were written to /tmp and ordered
+# expensive-last):
+#   * every receipt lands in the tracked receipts/ dir the moment the
+#     producing step finishes, and is git-committed immediately;
+#   * steps run cheapest-first, so an interrupt loses only the tail;
+#   * each step is independently timeout-bounded so a tunnel wedge
+#     mid-suite still leaves the earlier results committed.
 set -x
-OUT=${OUT:-/tmp/chip_suite}
 REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
+OUT=${OUT:-$REPO/receipts}
 mkdir -p "$OUT"
 cd "$REPO" || exit 1
 
-timeout 900 python tools/pallas_microbench.py --steps 50 --only lrn \
-    --json "$OUT/micro_lrn.json"      > "$OUT/micro_lrn.log" 2>&1
-timeout 900 python tools/pallas_microbench.py --steps 50 --only matmul \
-    --json "$OUT/micro_matmul.json"   > "$OUT/micro_matmul.log" 2>&1
-timeout 1200 python tools/pallas_microbench.py --steps 50 --only attn \
-    --json "$OUT/micro_attn.json"     > "$OUT/micro_attn.log" 2>&1
-timeout 1200 python tools/alexnet_breakdown.py \
-    --json "$OUT/alexnet_breakdown.json" > "$OUT/alexnet_breakdown.log" 2>&1
+save() {  # save <file...> — commit receipts the moment they exist
+    # add files one by one, skipping absent ones: a wedged step may leave
+    # only the .log, and `git add missing.json step.log` would abort
+    # having staged NOTHING — losing the log, the one artifact a wedge
+    # produces
+    for p in "$@"; do
+        [ -e "$p" ] && git add "$p"
+    done
+    # unchanged receipts (re-run of a finished step) are a quiet no-op;
+    # any real commit failure must be LOUD — silently uncommitted
+    # receipts are the round-3 failure mode this script exists to prevent
+    if ! git diff --cached --quiet -- "$@"; then
+        git commit -q -m "receipts: $(basename "$1" .json)" -- "$@" ||
+            echo "WARNING: receipts NOT committed: $*" >&2
+    fi
+}
+
+micro() {  # micro <only> — pallas-vs-xla microbench (iterations auto-sized)
+    f="$OUT/micro_$1.json"
+    timeout 900 python tools/pallas_microbench.py --only "$1" \
+        --json "$f" > "$OUT/micro_$1.log" 2>&1
+    save "$f" "$OUT/micro_$1.log"
+}
+
 bench() {  # bench <mode> <outfile> [env]
     f="$OUT/$2"
-    env $3 timeout 900 python bench.py "$1" > "$f" 2>"$OUT/$2.log" ||
+    env $3 timeout 1200 python bench.py "$1" > "$f" 2>"$OUT/$2.log" ||
         [ -s "$f" ] || echo '{"metric":"'"$1"'","value":null,"error":"killed/timeout"}' > "$f"
+    save "$f" "$OUT/$2.log"
 }
-bench alexnet     bench_alexnet.json
-bench alexnet     bench_alexnet_pallas.json CXXNET_PALLAS=1
-bench vgg16       bench_vgg16.json
-bench e2e_alexnet bench_e2e.json
-echo "chip suite done; results in $OUT"
+
+# -- cheapest first ---------------------------------------------------------
+micro lrn
+micro matmul
+micro attn
+bench alexnet      bench_alexnet.json
+bench vgg16        bench_vgg16.json
+bench googlenet    bench_googlenet.json
+bench inception_bn bench_inception_bn.json
+timeout 1200 python tools/alexnet_breakdown.py \
+    --json "$OUT/alexnet_breakdown.json" > "$OUT/alexnet_breakdown.log" 2>&1
+save "$OUT/alexnet_breakdown.json" "$OUT/alexnet_breakdown.log"
+bench e2e_alexnet  bench_e2e.json
+echo "chip suite done; results committed under $OUT"
 ls -la "$OUT"
