@@ -63,6 +63,10 @@ class TransformerConfig:
     causal: bool = True
     num_microbatches: int = 4
     dtype: object = jnp.float32
+    remat: bool = False          # rematerialize each block in backward:
+    # activations of a stage are recomputed instead of stored, cutting
+    # per-block activation HBM to O(1) blocks — the lever that lets long
+    # sequences fit (pairs with ring attention's O(s) memory)
 
 
 def init_params(rng: np.random.RandomState, cfg: TransformerConfig):
@@ -185,6 +189,11 @@ def _loss_local(params, tokens, labels, *, cfg, tp, sp):
     h = jnp.take(params['embed'], tokens, axis=0)        # (b, s, D)
     xs = split_microbatches(h, cfg.num_microbatches)
     stage = functools.partial(_stage_fn, cfg=cfg, tp=tp, sp=sp)
+    if cfg.remat:
+        # recompute the block in backward instead of storing its
+        # activations; collectives (ring ppermute, psum, all_to_all)
+        # replay under remat, so this composes with all four axes
+        stage = jax.checkpoint(stage)
     hs, aux = pipeline_stage_loop(stage, params['stages'], xs,
                                   axis_name='pipe',
                                   num_stages=cfg.num_stages, has_aux=True)

@@ -30,6 +30,8 @@ def main() -> int:
     ap.add_argument('--sp', type=int, default=2)
     ap.add_argument('--tp', type=int, default=1)
     ap.add_argument('--experts', type=int, default=0)
+    ap.add_argument('--remat', action='store_true',
+                    help='rematerialize blocks in backward (long-context HBM saver)')
     ap.add_argument('--steps', type=int, default=30)
     ap.add_argument('--seq', type=int, default=128)
     ap.add_argument('--batch', type=int, default=8)
@@ -67,7 +69,7 @@ def main() -> int:
     micro = max(m for m in (4, 3, 2, 1) if local_batch % m == 0)
     cfg = TransformerConfig(seq_len=args.seq, num_experts=args.experts,
                             num_stages=args.pp,
-                            num_microbatches=micro)
+                            num_microbatches=micro, remat=args.remat)
     mesh = build_transformer_mesh(n, args.pp, args.dp, args.sp, args.tp)
     print(f'mesh: {dict(mesh.shape)}  experts={args.experts}')
     step = make_train_step(cfg, mesh)

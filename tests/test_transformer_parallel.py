@@ -217,6 +217,30 @@ def test_transformer_loss_decreases():
     assert losses[-1] < losses[0] * 0.7, losses
 
 
+def test_remat_matches_no_remat():
+    """cfg.remat recomputes block activations in backward; the math must
+    be identical — same loss AND same updated params on the full 4-axis
+    mesh (collectives replay under jax.checkpoint)."""
+    kw = dict(vocab_size=32, d_model=16, num_heads=4, d_ff=32,
+              num_stages=2, seq_len=16, num_microbatches=2, attn='ring')
+    mesh = tfm.build_transformer_mesh(8, 2, 1, 2, 2, devices=_devices(8))
+    rng = np.random.RandomState(11)
+    params = tfm.init_params(rng, tfm.TransformerConfig(**kw))
+    tokens, labels = _make_inputs(tfm.TransformerConfig(**kw), 4)
+    outs = {}
+    for remat in (False, True):
+        cfg = tfm.TransformerConfig(remat=remat, **kw)
+        step = tfm.make_train_step(cfg, mesh, lr=0.1)
+        new_params, loss, _aux = step(jax.tree.map(jnp.copy, params),
+                                      tokens, labels)
+        outs[remat] = (new_params, float(loss))
+    assert outs[False][1] == pytest.approx(outs[True][1], rel=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                np.asarray(b), rtol=1e-6),
+        outs[False][0], outs[True][0])
+
+
 def test_local_attn_rejected_on_seq_mesh():
     cfg = tfm.TransformerConfig(num_stages=2, attn='local')
     mesh = tfm.build_transformer_mesh(8, 2, 2, 2, 1, devices=_devices(8))
