@@ -255,8 +255,7 @@ class LearnTask:
         assert self.itr_pred is not None, 'must specify a pred iterator'
         print('start predicting...')
         with open(self.name_pred, 'w') as fo:
-            for batch in self.itr_pred:
-                pred = self.net_trainer.predict(batch)
+            for pred in self.net_trainer.predict_stream(self.itr_pred):
                 for v in pred:
                     fo.write(f'{v:g}\n')
         print(f'finished prediction, write into {self.name_pred}')
@@ -269,9 +268,10 @@ class LearnTask:
         never dispatches it — here it works.)"""
         assert self.itr_pred is not None, 'must specify a pred iterator'
         print('start predicting (raw scores)...')
+        tr = self.net_trainer
         with open(self.name_pred, 'w') as fo:
-            for batch in self.itr_pred:
-                out = self.net_trainer.extract_feature(batch, 'top[-1]')
+            for out in tr.forward_stream(self.itr_pred,
+                                         tr.net.node_index('top[-1]')):
                 for row in out.reshape(out.shape[0], -1):
                     fo.write(' '.join(f'{v:g}' for v in row) + '\n')
         print(f'finished prediction, write into {self.name_pred}')
@@ -281,9 +281,9 @@ class LearnTask:
         node = self.extract_node_name or 'top[-1]'
         print(f'start extracting feature from {node}...')
         import numpy as np
-        feats = []
-        for batch in self.itr_pred:
-            feats.append(self.net_trainer.extract_feature(batch, node))
+        tr = self.net_trainer
+        feats = list(tr.forward_stream(self.itr_pred,
+                                       tr.net.node_index(node)))
         out = np.concatenate(feats, axis=0)
         if self.output_format == 1:
             np.savetxt(self.name_pred, out.reshape(out.shape[0], -1), '%g')

@@ -625,9 +625,36 @@ class NetTrainer:
         out = self._forward_nodes(batch, [last])[0]
         n = batch.batch_size - batch.num_batch_padd
         out = out[:n]
+        return self._pred_transform(out)
+
+    @staticmethod
+    def _pred_transform(out: np.ndarray) -> np.ndarray:
         if out.ndim > 1 and out.shape[1] != 1:
             return np.argmax(out, axis=1).astype(np.float32)
         return out.reshape(-1).astype(np.float32)
+
+    def forward_stream(self, batches, nid: int):
+        """Generator of one node's per-batch host outputs, pad rows
+        trimmed, with a one-batch software pipeline: batch i+1's forward
+        is enqueued before batch i's readback blocks, so the device
+        computes under the host transfer — the pred/extract analog of
+        :meth:`evaluate`'s overlap (reference eval-request overlap,
+        nnet_impl:232-241)."""
+        pending = None
+        for batch in batches:
+            outs = self._forward_nodes_async(batch, [nid])
+            prev, pending = pending, (
+                outs[0], batch.batch_size - batch.num_batch_padd)
+            if prev is not None:
+                yield np.asarray(prev[0])[:prev[1]]
+        if pending is not None:
+            yield np.asarray(pending[0])[:pending[1]]
+
+    def predict_stream(self, batches):
+        """Pipelined :meth:`predict` over a batch iterator."""
+        last = self.net.cfg.layers[-1].nindex_out[-1]
+        for out in self.forward_stream(batches, last):
+            yield self._pred_transform(out)
 
     def extract_feature(self, batch, node_name: str) -> np.ndarray:
         nid = self.net.node_index(node_name)

@@ -80,7 +80,8 @@ def main() -> int:
     ap.add_argument('--dtype', default='bfloat16',
                     choices=['bfloat16', 'float32'])
     ap.add_argument('--only', default='',
-                    help='comma list of op groups: lrn,matmul,attn')
+                    help='comma list of op groups: lrn,matmul,attn,'
+                         'matmul_tiles')
     args = ap.parse_args()
     only = set(args.only.split(',')) if args.only else None
 
@@ -115,6 +116,48 @@ def main() -> int:
         bench_pair(f'matmul {m}x{k}x{n}',
                    lambda p, q: jnp.dot(p, q), pallas_matmul,
                    (a, bmat), results, flops=2.0 * m * k * n)
+
+    # --- matmul tile-size sweep (kernel tuning, fwd only) -------------
+    # answers "is the 45% matmul gap a tiling problem?" in one run:
+    # every (tm, tn, tk) variant of the K-blocked kernel vs XLA's dot
+    # at the two big fullc shapes.  Opt-in only (--only matmul_tiles):
+    # ~16 fresh kernel compiles would bloat the standard receipt run.
+    if only is not None and 'matmul_tiles' in only:
+        from cxxnet_tpu.ops.pallas_kernels import _matmul_impl
+        for m, k, n in ((256, 9216, 4096), (256, 4096, 4096)):
+            a = jnp.asarray(rng.randn(m, k) * 0.05, dtype)
+            bmat = jnp.asarray(rng.randn(k, n) * 0.05, dtype)
+            t_x = time_op(lambda p, q: jnp.dot(p, q), (a, bmat))
+            fl = 2.0 * m * k * n
+            print(f'matmul {m}x{k}x{n} XLA {t_x * 1e6:9.1f}us '
+                  f'[{fl / t_x / 1e12:6.1f} TF/s]', flush=True)
+            results.append({'op': f'matmul {m}x{k}x{n}', 'pass': 'fwd',
+                            'tiles': 'xla', 'us': round(t_x * 1e6, 1),
+                            'tflops': round(fl / t_x / 1e12, 1)})
+            for tm, tn, tk in ((256, 256, 512), (128, 256, 512),
+                               (256, 512, 512), (512, 512, 512),
+                               (256, 256, 1024), (128, 512, 1024),
+                               (256, 1024, 512), (512, 256, 1024)):
+                f = functools.partial(_matmul_impl, tile_m=tm, tile_n=tn,
+                                      tile_k=tk)
+                try:
+                    t_p = time_op(f, (a, bmat))
+                except Exception as e:   # VMEM OOM at big tiles: record
+                    print(f'  tiles {tm}x{tn}x{tk}: FAILED '
+                          f'{type(e).__name__}', flush=True)
+                    results.append({'op': f'matmul {m}x{k}x{n}',
+                                    'pass': 'fwd',
+                                    'tiles': f'{tm}x{tn}x{tk}',
+                                    'error': type(e).__name__})
+                    continue
+                print(f'  tiles {tm}x{tn}x{tk}: {t_p * 1e6:9.1f}us '
+                      f'[{fl / t_p / 1e12:6.1f} TF/s] '
+                      f'{t_x / t_p:5.3f}x of XLA', flush=True)
+                results.append({'op': f'matmul {m}x{k}x{n}',
+                                'pass': 'fwd', 'tiles': f'{tm}x{tn}x{tk}',
+                                'us': round(t_p * 1e6, 1),
+                                'tflops': round(fl / t_p / 1e12, 1),
+                                'vs_xla': round(t_x / t_p, 3)})
 
     # --- attention at transformer shapes ------------------------------
     for b, s, heads, d in (((4, 1024, 8, 64), (2, 4096, 8, 64))
