@@ -213,3 +213,42 @@ def test_rec_at_n():
     with pytest.raises(ValueError):
         bad = create_metric('rec@5')
         bad.add_eval(pred, label)
+
+
+def test_lookahead_staging_equals_plain_update():
+    """The CLI train loop's one-batch lookahead (stage_batch for i+1
+    enqueued before update_staged for i) must produce bitwise-identical
+    training to plain per-batch update() — staging must not disturb rng
+    streams, counters, masks, or deferred train metrics."""
+    batches = [_multilabel_batch(np.random.RandomState(100 + i))
+               for i in range(5)]
+
+    def final_params(drive):
+        tr = NetTrainer(parse_config_string(MULTILABEL_CONF + 'seed = 7\n'))
+        tr.init_model()
+        drive(tr)
+        tr.flush_train_metrics()
+        return tr
+
+    def plain(tr):
+        for b in batches:
+            tr.update(b)
+
+    def lookahead(tr):
+        pending = None
+        for b in batches:
+            staged = tr.stage_batch(b)
+            if pending is not None:
+                tr.update_staged(pending)
+            pending = staged
+        tr.update_staged(pending)
+
+    t1, t2 = final_params(plain), final_params(lookahead)
+    assert t1.sample_counter == t2.sample_counter
+    assert t1.epoch_counter == t2.epoch_counter
+    for k, fields in t1.params.items():
+        for f, v in fields.items():
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(t2.params[k][f]),
+                                          err_msg=f'{k}/{f}')
+    assert t1.train_metric.print('t') == t2.train_metric.print('t')
