@@ -295,7 +295,7 @@ def bench_e2e_alexnet() -> int:
     from cxxnet_tpu.utils.config import parse_config_string
     from PIL import Image
 
-    batch_size = 256
+    batch_size = _bench_batch(256)
     n_images = int(os.environ.get('CXXNET_E2E_IMAGES', '1024'))
     rng = np.random.RandomState(0)
 

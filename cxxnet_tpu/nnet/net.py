@@ -82,6 +82,86 @@ class Net:
             if isinstance(self.layers[i], SplitLayer):
                 self.layers[i].set_num_outputs(len(info.nindex_out))
         self._infer_shapes()
+        self._build_sibling_fusion()
+
+    # --- horizontal fusion ------------------------------------------------
+    def _build_sibling_fusion(self) -> None:
+        """Group sibling 1x1 convolutions for horizontally fused execution.
+
+        Inception-style towers launch several small 1x1 convs off the same
+        trunk node (``concat_layer-inl.hpp:55-78`` context); each is a
+        skinny matmul whose output-channel count (16..96) underfills the
+        128-wide MXU.  Executing one conv with the weights concatenated
+        along the output axis and splitting the result is mathematically
+        identical per output channel (each column's contraction is
+        unchanged) and fills the systolic array.  Eligibility: ungrouped
+        1x1, stride 1, no padding, single in/out, homogeneous bias-ness.
+        Disable with ``fuse_siblings = 0``.
+        """
+        from ..layers.conv import ConvolutionLayer
+        enabled = 1
+        tp = 1
+        for name, val in self.cfg.defcfg:
+            if name == 'fuse_siblings':
+                enabled = int(val)
+            if name == 'tensor_parallel':
+                tp = int(val)
+        self._sibling_groups: Dict[int, List[int]] = {}
+        if not enabled or tp > 1:
+            # under tensor parallelism the member wmats are sharded on
+            # exactly the axis fusion concatenates (mesh.py
+            # P(None,None,None,'model')), and member widths don't align
+            # to shard boundaries — fusing would force GSPMD to
+            # all-gather what the col/row pairing keeps sharded
+            return
+        groups: Dict[tuple, List[int]] = {}
+        for i, info in enumerate(self.cfg.layers):
+            layer = self.layers[i]
+            if not isinstance(layer, ConvolutionLayer):
+                continue
+            p = layer.param
+            if (p.kernel_height, p.kernel_width, p.stride, p.pad_y,
+                    p.pad_x, p.num_group) != (1, 1, 1, 0, 0, 1):
+                continue
+            if len(info.nindex_in) != 1 or len(info.nindex_out) != 1:
+                continue
+            groups.setdefault((info.nindex_in[0], p.no_bias), []).append(i)
+        for (node, _), members in groups.items():
+            if len(members) < 2:
+                continue
+            # the grouping is sound only if the input node keeps ONE value
+            # across the group's span: the config language allows in-place
+            # rewrites (layer[a->a] = ...), after which a later member
+            # would legally read the REWRITTEN value while the fused conv
+            # ran on the old one.  Reject the group if any layer within
+            # [first, last] member positions writes the node.
+            lo, hi = members[0], members[-1]
+            rewritten = any(
+                node in self.cfg.layers[w].nindex_out
+                for w in range(lo, hi + 1))
+            if rewritten:
+                continue
+            for m in members:
+                self._sibling_groups[m] = members
+
+    def _fused_sibling_outputs(self, params: Params, x, members: List[int]):
+        """One 1x1 conv over the concatenated weights, split back into the
+        member layers' outputs (same order)."""
+        widths = [self.layers[m].param.num_channel for m in members]
+        w = jnp.concatenate(
+            [self._layer_params(params, m)['wmat'] for m in members],
+            axis=3).astype(x.dtype)
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding=((0, 0), (0, 0)),
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+        if self.layers[members[0]].param.no_bias == 0:
+            b = jnp.concatenate(
+                [self._layer_params(params, m)['bias'] for m in members]
+            ).astype(x.dtype)
+            out = out + b
+        out = out.astype(x.dtype)
+        splits = np.cumsum(widths)[:-1]
+        return jnp.split(out, splits, axis=-1)
 
     # --- shape inference --------------------------------------------------
     def _infer_shapes(self) -> None:
@@ -176,6 +256,7 @@ class Net:
                     ex = ex.reshape(ex.shape[0], -1)
                 values[1 + k] = ex
         total_loss = jnp.asarray(0.0, jnp.float32)
+        fused: Dict[int, jax.Array] = {}
         for i, info in enumerate(cfg.layers):
             layer = self.layers[i]
             lctx = ForwardContext(is_train=ctx.is_train, rng=ctx.rng,
@@ -187,7 +268,15 @@ class Net:
             if isinstance(layer, LossLayerBase) and labels is not None:
                 total_loss = total_loss + layer.loss(
                     lp, ins, labels.field(layer.target), lctx, loss_mask)
-            outs = layer.forward(lp, ins, lctx)
+            if i in self._sibling_groups:
+                if i not in fused:   # first member: run the fused conv
+                    members = self._sibling_groups[i]
+                    for m, v in zip(members, self._fused_sibling_outputs(
+                            params, ins[0], members)):
+                        fused[m] = v
+                outs = [fused[i]]
+            else:
+                outs = layer.forward(lp, ins, lctx)
             for j, v in zip(info.nindex_out, outs):
                 values[j] = v
         return values, total_loss

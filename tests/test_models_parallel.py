@@ -281,3 +281,86 @@ def test_tp_row_col_alternation_layout():
         spec('fc2', 'wmat') == "PartitionSpec('model', None)"
     assert spec('fc2', 'bias') == 'PartitionSpec()'
     assert spec('fc3', 'wmat') == "PartitionSpec(None, 'model')"
+
+
+def test_sibling_1x1_fusion_matches_unfused():
+    """Horizontal fusion of sibling 1x1 convs (inception towers) must be
+    a pure execution-plan change: same outputs, same gradients, params
+    and checkpoints untouched (nnet/net.py:_build_sibling_fusion)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cxxnet_tpu.layers import ForwardContext
+    from cxxnet_tpu.models import googlenet_conf
+    from cxxnet_tpu.nnet.net import Net
+    from cxxnet_tpu.nnet.net_config import NetConfig
+
+    def build(extra):
+        cfg = NetConfig()
+        cfg.configure(parse_config_string(
+            googlenet_conf() + 'batch_size = 2\n' + extra))
+        return Net(cfg)
+
+    fused_net, plain_net = build(''), build('fuse_siblings = 0\n')
+    assert fused_net._sibling_groups, 'googlenet must trigger fusion'
+    assert not plain_net._sibling_groups
+    params = fused_net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = jnp.asarray(rng.rand(2, 3, 224, 224).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, 1000, (2, 1)).astype(np.float32))
+
+    def loss_of(net):
+        def f(p):
+            ctx = ForwardContext(is_train=False, rng=None)
+            _, loss = net.forward(p, batch, ctx,
+                                  labels=net.make_label_info(label))
+            return loss
+        return f
+
+    lf, gf = jax.value_and_grad(loss_of(fused_net))(params)
+    lp, gp = jax.value_and_grad(loss_of(plain_net))(params)
+    np.testing.assert_allclose(float(lf), float(lp), rtol=1e-6)
+    for k, fields in gf.items():
+        for f, v in fields.items():
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(gp[k][f]), rtol=1e-5, atol=1e-6,
+                err_msg=f'{k}/{f}')
+
+
+def test_sibling_fusion_rejects_rewritten_node_and_tp():
+    """Fusion must NOT group across an in-place rewrite of the shared
+    input node, and must stay off under tensor parallelism (the concat
+    axis is the model-sharded axis)."""
+    from cxxnet_tpu.models import googlenet_conf
+    from cxxnet_tpu.nnet.net import Net
+    from cxxnet_tpu.nnet.net_config import NetConfig
+
+    conf = """
+netconfig=start
+layer[0->1] = conv:c1
+  kernel_size = 1
+  nchannel = 8
+layer[0->0] = dropout
+  threshold = 0.5
+layer[0->2] = conv:c2
+  kernel_size = 1
+  nchannel = 8
+layer[1,2->3] = ch_concat
+layer[3->4] = flatten
+layer[4->5] = fullc:fc
+  nhidden = 4
+layer[5->5] = softmax
+netconfig=end
+input_shape = 4,6,6
+batch_size = 2
+"""
+    cfg = NetConfig()
+    cfg.configure(parse_config_string(conf))
+    net = Net(cfg)
+    assert not net._sibling_groups, \
+        'in-place rewrite of the shared node must veto fusion'
+
+    cfg2 = NetConfig()
+    cfg2.configure(parse_config_string(
+        googlenet_conf() + 'batch_size = 2\ntensor_parallel = 2\n'))
+    assert not Net(cfg2)._sibling_groups, 'tp>1 must disable fusion'
