@@ -57,6 +57,26 @@ def pallas_enabled() -> bool:
     return pallas_mode() == 'on'
 
 
+def attn_use_flash(seq_len: int) -> bool:
+    """Whether fused flash attention should replace the dense local path
+    at this (post-gather, global) sequence length.  ``'on'`` forces it;
+    in ``'auto'`` it engages only on a real TPU (with the pallas TPU
+    memory spaces importable) from 16384 tokens up.  The threshold is a
+    MEMORY feasibility bound, not a speed claim: at 16k+, the dense
+    O(seq^2) score materialization (b*h*s^2 f32 — ~17 GB at b2 h8 s16k)
+    stops fitting v5e-class HBM, so the O(seq) kernel is the only local
+    path that runs at all.  At every SPEED-measured shape (<= 4096,
+    receipts/micro_attn.json) XLA's dense path won, so auto stays off
+    below the feasibility bound; no measured crossover exists between
+    4k and 16k yet."""
+    mode = pallas_mode()
+    if mode == 'off':
+        return False
+    if mode == 'on':
+        return True
+    return not _interpret() and pltpu is not None and seq_len >= 16384
+
+
 def lrn_fwd_profitable(c: int) -> bool:
     """Whether the Pallas LRN *forward* beats XLA at channel count ``c``
     on this backend.  From receipts/micro_lrn.json (TPU v5 lite, bf16):

@@ -123,7 +123,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = 'data',
     return fn(q, k, v)
 
 
-def _ulysses_local(q, k, v, axis_name: str, causal: bool):
+def _ulysses_local(q, k, v, axis_name: str, causal: bool,
+                   use_flash: bool):
     """seq-sharded -> all_to_all -> head-sharded dense attention -> back."""
     n = lax.psum(1, axis_name)
     # (b, s/n, h, d) -> (b, s, h/n, d): gather sequence, scatter heads
@@ -131,7 +132,7 @@ def _ulysses_local(q, k, v, axis_name: str, causal: bool):
     k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
     from ..ops import pallas_kernels as pk
-    if pk.pallas_enabled() and pk.pltpu is not None:
+    if use_flash and pk.pltpu is not None:
         # fused online-softmax kernel: O(seq) memory for the local dense
         # attention after the head scatter (dense fallback when the TPU
         # pallas memory spaces aren't importable)
@@ -155,13 +156,14 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = 'data',
     axis size."""
     if q.shape[2] % mesh.shape[axis_name]:
         raise ValueError('ulysses: heads must divide the mesh axis')
+    from ..ops.pallas_kernels import attn_use_flash
+    use_flash = attn_use_flash(q.shape[1])   # post-gather = global seq
     spec = P(None, axis_name, None, None)
     local = functools.partial(_ulysses_local, axis_name=axis_name,
-                              causal=causal)
+                              causal=causal, use_flash=use_flash)
     wrap = functools.partial(shard_map, local, mesh=mesh,
                              in_specs=(spec, spec, spec), out_specs=spec)
-    from ..ops.pallas_kernels import pallas_enabled
-    if not pallas_enabled():
+    if not use_flash:
         fn = wrap()
     else:
         # pallas_call doesn't propagate varying-manual-axes through its

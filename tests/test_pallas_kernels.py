@@ -216,3 +216,21 @@ class TestFlashAttention:
         ref = attention_reference(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-4)
+
+
+def test_attn_use_flash_gate(monkeypatch):
+    """'auto' engages flash only on real TPU at lengths where the dense
+    score matrix stops fitting HBM (>=16384); explicit on/off force both
+    ways."""
+    from cxxnet_tpu.ops import pallas_kernels as pk
+    monkeypatch.delenv('CXXNET_PALLAS', raising=False)
+    monkeypatch.setattr(pk, '_interpret', lambda: True)
+    assert not pk.attn_use_flash(32768)
+    monkeypatch.setattr(pk, '_interpret', lambda: False)
+    if pk.pltpu is not None:
+        assert pk.attn_use_flash(16384)
+    assert not pk.attn_use_flash(8192)
+    monkeypatch.setenv('CXXNET_PALLAS', '1')
+    assert pk.attn_use_flash(64)
+    monkeypatch.setenv('CXXNET_PALLAS', '0')
+    assert not pk.attn_use_flash(16384)
