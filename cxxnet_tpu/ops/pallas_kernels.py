@@ -57,24 +57,28 @@ def pallas_enabled() -> bool:
     return pallas_mode() == 'on'
 
 
-def attn_use_flash(seq_len: int) -> bool:
+_FLASH_SCORE_BYTES = 4 << 30   # dense-score budget: ~1/4 of v5e HBM
+
+
+def attn_use_flash(seq_len: int, batch: int = 1, heads: int = 1) -> bool:
     """Whether fused flash attention should replace the dense local path
-    at this (post-gather, global) sequence length.  ``'on'`` forces it;
-    in ``'auto'`` it engages only on a real TPU (with the pallas TPU
-    memory spaces importable) from 16384 tokens up.  The threshold is a
-    MEMORY feasibility bound, not a speed claim: at 16k+, the dense
-    O(seq^2) score materialization (b*h*s^2 f32 — ~17 GB at b2 h8 s16k)
-    stops fitting v5e-class HBM, so the O(seq) kernel is the only local
-    path that runs at all.  At every SPEED-measured shape (<= 4096,
-    receipts/micro_attn.json) XLA's dense path won, so auto stays off
-    below the feasibility bound; no measured crossover exists between
-    4k and 16k yet."""
+    for a (local) ``batch x heads x seq x seq`` attention.  ``'on'``
+    forces it; in ``'auto'`` it engages only on a real TPU (with the
+    pallas TPU memory spaces importable) when the dense O(seq^2) score
+    materialization — ``batch*heads*seq^2`` f32 — would blow a ~4 GiB
+    budget (about a quarter of v5e HBM, leaving room for params,
+    activations, and the backward's second score pass).  The gate is a
+    MEMORY feasibility bound, not a speed claim: at every SPEED-measured
+    shape (seq <= 4096 at small b*h, receipts/micro_attn.json) XLA's
+    dense path won, so auto stays off while dense still fits."""
     mode = pallas_mode()
     if mode == 'off':
         return False
     if mode == 'on':
         return True
-    return not _interpret() and pltpu is not None and seq_len >= 16384
+    score_bytes = 4.0 * batch * heads * seq_len * seq_len
+    return (not _interpret() and pltpu is not None
+            and score_bytes >= _FLASH_SCORE_BYTES)
 
 
 def lrn_fwd_profitable(c: int) -> bool:

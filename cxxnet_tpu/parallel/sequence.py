@@ -157,7 +157,10 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = 'data',
     if q.shape[2] % mesh.shape[axis_name]:
         raise ValueError('ulysses: heads must divide the mesh axis')
     from ..ops.pallas_kernels import attn_use_flash
-    use_flash = attn_use_flash(q.shape[1])   # post-gather = global seq
+    # post-gather local shape: full seq, heads split over the axis
+    use_flash = attn_use_flash(
+        q.shape[1], batch=q.shape[0],
+        heads=max(1, q.shape[2] // mesh.shape[axis_name]))
     spec = P(None, axis_name, None, None)
     local = functools.partial(_ulysses_local, axis_name=axis_name,
                               causal=causal, use_flash=use_flash)

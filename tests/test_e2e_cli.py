@@ -488,3 +488,24 @@ metric = error
     # like the reference, the round-end SaveModel runs even in test_io
     # mode (cxxnet_main.cpp TaskTrain saves unconditionally)
     assert (tmp_path / 'models' / '0001.model').exists()
+
+
+def test_transformer_example_cli(tmp_path):
+    """example/transformer/train_lm.py runs the composed 4-axis mesh from
+    the command line (virtual CPU devices), with remat, and reports a
+    finite decreasing loss."""
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'example', 'transformer',
+                                      'train_lm.py'),
+         '--pp', '2', '--dp', '1', '--sp', '2', '--tp', '2',
+         '--steps', '4', '--seq', '32', '--batch', '2', '--remat'],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    losses = [float(m) for m in
+              re.findall(r'loss ([0-9.]+)', r.stdout)]
+    assert losses and all(np.isfinite(losses))
+    assert losses[-1] < losses[0]

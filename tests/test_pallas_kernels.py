@@ -219,18 +219,20 @@ class TestFlashAttention:
 
 
 def test_attn_use_flash_gate(monkeypatch):
-    """'auto' engages flash only on real TPU at lengths where the dense
-    score matrix stops fitting HBM (>=16384); explicit on/off force both
-    ways."""
+    """'auto' engages flash only on real TPU where the dense score
+    matrix (batch*heads*seq^2 f32) blows the HBM budget; explicit on/off
+    force both ways."""
     from cxxnet_tpu.ops import pallas_kernels as pk
     monkeypatch.delenv('CXXNET_PALLAS', raising=False)
     monkeypatch.setattr(pk, '_interpret', lambda: True)
-    assert not pk.attn_use_flash(32768)
+    assert not pk.attn_use_flash(16384, batch=2, heads=8)
     monkeypatch.setattr(pk, '_interpret', lambda: False)
     if pk.pltpu is not None:
-        assert pk.attn_use_flash(16384)
-    assert not pk.attn_use_flash(8192)
+        assert pk.attn_use_flash(16384, batch=2, heads=8)    # ~17 GB
+        assert pk.attn_use_flash(4096, batch=64, heads=16)   # big b*h
+    assert not pk.attn_use_flash(4096, batch=2, heads=8)     # ~1 GB
+    assert not pk.attn_use_flash(16384)                      # b1 h1: fits
     monkeypatch.setenv('CXXNET_PALLAS', '1')
     assert pk.attn_use_flash(64)
     monkeypatch.setenv('CXXNET_PALLAS', '0')
-    assert not pk.attn_use_flash(16384)
+    assert not pk.attn_use_flash(16384, batch=2, heads=8)

@@ -36,13 +36,19 @@ import numpy as np                                             # noqa: E402
 from chiptime import grad_probe, time_op                       # noqa: E402
 
 
-def bench_pair(name, xla_fn, pallas_fn, args, results, flops=None):
-    # loop length is adaptive (chiptime.time_op auto-sizes iterations)
-    for tag, wrap in (('fwd', lambda f: f),
-                      ('fwd+bwd', grad_probe)):
+_PASS_WRAPS = {'fwd': lambda f: f, 'fwd+bwd': None, 'bwd-op': lambda f: f}
+
+
+def bench_pair(name, xla_fn, pallas_fn, args, results, flops=None,
+               passes=('fwd', 'fwd+bwd')):
+    # loop length is adaptive (chiptime.time_op auto-sizes iterations).
+    # 'bwd-op' times a raw backward building block as-is (no grad wrap —
+    # the raw impls aren't differentiable themselves).
+    for tag in passes:
+        wrap = _PASS_WRAPS[tag] or grad_probe
         t_x = time_op(wrap(xla_fn), args)
         t_p = time_op(wrap(pallas_fn), args)
-        speedup = t_x / t_p
+        speedup = t_x / max(t_p, 1e-9)
         row = {'op': name, 'pass': tag,
                'xla_us': round(t_x * 1e6, 1),
                'pallas_us': round(t_p * 1e6, 1),
@@ -81,7 +87,7 @@ def main() -> int:
                     choices=['bfloat16', 'float32'])
     ap.add_argument('--only', default='',
                     help='comma list of op groups: lrn,matmul,attn,'
-                         'matmul_tiles')
+                         'matmul_bwd,matmul_tiles')
     args = ap.parse_args()
     only = set(args.only.split(',')) if args.only else None
 
@@ -116,6 +122,29 @@ def main() -> int:
         bench_pair(f'matmul {m}x{k}x{n}',
                    lambda p, q: jnp.dot(p, q), pallas_matmul,
                    (a, bmat), results, flops=2.0 * m * k * n)
+
+    # --- backward-matmul kernels (da = g@b^T, db = a^T@g) -------------
+    # A/Bs the dedicated transpose-free NT/TN kernels against XLA's own
+    # contraction of the stored layouts — the r3 fwd+bwd ratio (0.33x)
+    # bundled a physical 75MB weight transpose into the pallas side
+    if only is not None and 'matmul_bwd' in only:   # opt-in, like tiles
+        from cxxnet_tpu.ops.pallas_kernels import (_matmul_nt_impl,
+                                                   _matmul_tn_impl)
+        for m, k, n in ((256, 9216, 4096), (256, 4096, 4096)):
+            g = jnp.asarray(rng.randn(m, n) * 0.05, dtype)
+            a = jnp.asarray(rng.randn(m, k) * 0.05, dtype)
+            bmat = jnp.asarray(rng.randn(k, n) * 0.05, dtype)
+            fl = 2.0 * m * k * n
+            bench_pair(f'da=g@bT {m}x{k}x{n}',
+                       lambda p, q: jax.lax.dot_general(
+                           p, q, (((1,), (1,)), ((), ()))),
+                       _matmul_nt_impl, (g, bmat), results, flops=fl,
+                       passes=('bwd-op',))
+            bench_pair(f'db=aT@g {m}x{k}x{n}',
+                       lambda p, q: jax.lax.dot_general(
+                           p, q, (((0,), (0,)), ((), ()))),
+                       _matmul_tn_impl, (a, g), results, flops=fl,
+                       passes=('bwd-op',))
 
     # --- matmul tile-size sweep (kernel tuning, fwd only) -------------
     # answers "is the 45% matmul gap a tiling problem?" in one run:

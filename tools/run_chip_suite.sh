@@ -57,6 +57,7 @@ bench googlenet    bench_googlenet.json
 micro attn
 bench inception_bn bench_inception_bn.json
 bench googlenet    bench_googlenet_b256.json CXXNET_BENCH_BATCH=256
+micro matmul_bwd
 micro matmul_tiles
 timeout 2700 python tools/alexnet_breakdown.py \
     --json "$OUT/alexnet_breakdown.json" > "$OUT/alexnet_breakdown.log" 2>&1
