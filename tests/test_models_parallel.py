@@ -129,6 +129,21 @@ input_shape = 3,224,224
     assert 'fit-error:' in res
 
 
+
+def _snapshot_params(tr):
+    return {k: {f: np.asarray(v) for f, v in d.items()}
+            for k, d in tr.params.items()}
+
+
+def _assert_params_close(a, b, rtol, atol, what=''):
+    for k in a:
+        for f in a[k]:
+            np.testing.assert_allclose(
+                a[k][f], b[k][f], rtol=rtol, atol=atol,
+                err_msg=f'{k}/{f} diverged {what}')
+            assert np.isfinite(b[k][f]).all()
+
+
 def test_tail_batch_mask_on_sharded_mesh():
     """A synthetic-padded tail batch (num_batch_padd, pad_synthetic) must
     produce the same update on an 8-device data-sharded mesh as on one
@@ -157,14 +172,9 @@ metric = error
     for dev_line in ('dev = cpu', 'dev = tpu:0-7'):
         tr = make(dev_line)
         tr.update(batch)
-        results.append({k: {f: np.asarray(v) for f, v in d.items()}
-                        for k, d in tr.params.items()})
-    for k in results[0]:
-        for f in results[0][k]:
-            np.testing.assert_allclose(
-                results[0][k][f], results[1][k][f], rtol=2e-5, atol=1e-6,
-                err_msg=f'{k}/{f} diverged between 1-dev and 8-dev')
-            assert np.isfinite(results[1][k][f]).all()
+        results.append(_snapshot_params(tr))
+    _assert_params_close(results[0], results[1], rtol=2e-5, atol=1e-6,
+                         what='between 1-dev and 8-dev')
 
 
 _TP_ORACLE_CONF = """
@@ -364,3 +374,52 @@ batch_size = 2
     cfg2.configure(parse_config_string(
         googlenet_conf() + 'batch_size = 2\ntensor_parallel = 2\n'))
     assert not Net(cfg2)._sibling_groups, 'tp>1 must disable fusion'
+
+
+def test_sibling_fusion_on_data_mesh():
+    """Fused sibling 1x1 execution must not disturb training on a
+    data-sharded mesh: same params after an update as fuse_siblings=0."""
+    conf_body = """
+netconfig=start
+layer[0->1] = conv:trunk
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+layer[1->2] = relu
+layer[2->t1] = conv:t1
+  kernel_size = 1
+  nchannel = 8
+layer[2->t2] = conv:t2
+  kernel_size = 1
+  nchannel = 16
+layer[2->t3] = conv:t3
+  kernel_size = 1
+  nchannel = 4
+layer[t1,t2,t3->3] = ch_concat
+layer[3->4] = flatten
+layer[4->5] = fullc:fc
+  nhidden = 4
+layer[5->5] = softmax
+netconfig=end
+input_shape = 3,8,8
+batch_size = 16
+dev = tpu:0-7
+eta = 0.1
+momentum = 0.9
+metric = error
+seed = 11
+"""
+    rng = np.random.RandomState(2)
+    x = rng.randn(16, 3, 8, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16, 1)).astype(np.float32)
+
+    outs = []
+    for extra in ('', 'fuse_siblings = 0\n'):
+        tr = NetTrainer(parse_config_string(conf_body + extra))
+        tr.init_model()
+        if not extra:
+            assert tr.net._sibling_groups, 'fusion must engage'
+        tr.update(DataBatch(x, y))
+        outs.append(_snapshot_params(tr))
+    _assert_params_close(outs[0], outs[1], rtol=1e-5, atol=1e-6,
+                         what='fused vs unfused')
