@@ -250,6 +250,11 @@ class ForwardContext:
     # activation dtype for the MXU path (bfloat16 for mixed precision);
     # params and loss stay float32, matmuls accumulate in float32
     compute_dtype: object = jnp.float32
+    # device count of the mesh this trace runs under: auto-enabled Pallas
+    # paths stand down when > 1 (an opaque pallas_call has no GSPMD
+    # sharding rule, so the partitioner would gather the full sharded
+    # activation around it)
+    spmd_devices: int = 1
 
     def layer_rng(self) -> jax.Array:
         if self.rng is None:

@@ -48,7 +48,7 @@ class LRNLayer(Layer):
     def forward(self, params, inputs, ctx):
         x = inputs[0]  # (b, y, x, c)
         from ..ops.pallas_kernels import lrn_fwd_profitable, lrn_hybrid
-        if lrn_fwd_profitable(x.shape[-1]):
+        if lrn_fwd_profitable(x.shape[-1], ctx.spmd_devices):
             # Pallas forward / XLA backward hybrid: on by default at the
             # shapes where the fused forward measured ahead
             # (receipts/micro_lrn.json; ops/pallas_kernels.py)

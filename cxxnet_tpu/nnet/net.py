@@ -262,7 +262,8 @@ class Net:
             lctx = ForwardContext(is_train=ctx.is_train, rng=ctx.rng,
                                   layer_index=i, round=ctx.round,
                                   max_round=ctx.max_round,
-                                  compute_dtype=ctx.compute_dtype)
+                                  compute_dtype=ctx.compute_dtype,
+                                  spmd_devices=ctx.spmd_devices)
             lp = self._layer_params(params, i)
             ins = [values[j] for j in info.nindex_in]
             if isinstance(layer, LossLayerBase) and labels is not None:

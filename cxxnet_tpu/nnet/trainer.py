@@ -228,11 +228,13 @@ class NetTrainer:
         eval_ids = self._eval_node_ids
         compute_dtype = self.compute_dtype
         max_round = self.max_round
+        spmd = self._mesh.devices.size
 
         def loss_fn(params, data, label, extra, mask, rng, rnd):
             ctx = ForwardContext(is_train=True, rng=rng, round=rnd,
                                  max_round=max_round,
-                                 compute_dtype=compute_dtype)
+                                 compute_dtype=compute_dtype,
+                                 spmd_devices=spmd)
             values, loss = net.forward(params, data, ctx,
                                        labels=net.make_label_info(label),
                                        loss_mask=mask, extra_data=extra)
@@ -274,11 +276,14 @@ class NetTrainer:
         compute_dtype = self.compute_dtype
         max_round = self.max_round
 
+        spmd = self._mesh.devices.size
+
         @jax.jit
         def forward_step(params, data, extra, rnd):
             ctx = ForwardContext(is_train=False, rng=None, round=rnd,
                                  max_round=max_round,
-                                 compute_dtype=compute_dtype)
+                                 compute_dtype=compute_dtype,
+                                 spmd_devices=spmd)
             values, _ = net.forward(params, data, ctx, extra_data=extra)
             return values
 

@@ -57,6 +57,8 @@ def pallas_enabled() -> bool:
     return pallas_mode() == 'on'
 
 
+
+
 _FLASH_SCORE_BYTES = 4 << 30   # dense-score budget: ~1/4 of v5e HBM
 
 
@@ -81,18 +83,28 @@ def attn_use_flash(seq_len: int, batch: int = 1, heads: int = 1) -> bool:
             and score_bytes >= _FLASH_SCORE_BYTES)
 
 
-def lrn_fwd_profitable(c: int) -> bool:
+def lrn_fwd_profitable(c: int, spmd_devices: int = 1) -> bool:
     """Whether the Pallas LRN *forward* beats XLA at channel count ``c``
     on this backend.  From receipts/micro_lrn.json (TPU v5 lite, bf16):
     4.18x at c=256 (MXU-aligned band matmul), 0.98x at c=96 (tile
     underfill) — so the gate is lane-aligned channel counts on a real
     TPU.  The Pallas LRN *backward* loses at every measured shape
-    (0.58-0.70x), which is why the default path is ``lrn_hybrid``."""
+    (0.58-0.70x), which is why the default path is ``lrn_hybrid``.
+
+    ``spmd_devices`` is the mesh size of the CALLING program (threaded
+    through ForwardContext): auto engages only in single-device
+    programs, because under GSPMD a ``pallas_call`` is an opaque custom
+    call with no sharding rule — the partitioner would gather the full
+    sharded activation around it, slower and memory-fatter than the XLA
+    path it replaces (and the receipts are single-chip measurements).
+    Explicit ``use_pallas=1`` still forces the kernel everywhere; the
+    shard_map'd paths in parallel/sequence.py run per-shard by
+    construction and take no such scoping."""
     if pallas_mode() == 'off':
         return False
     if pallas_mode() == 'on':
         return True
-    return not _interpret() and c % 128 == 0
+    return (not _interpret() and spmd_devices == 1 and c % 128 == 0)
 
 
 def _interpret() -> bool:

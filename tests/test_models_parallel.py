@@ -136,7 +136,9 @@ def _snapshot_params(tr):
 
 
 def _assert_params_close(a, b, rtol, atol, what=''):
+    assert a.keys() == b.keys(), (sorted(a), sorted(b))
     for k in a:
+        assert a[k].keys() == b[k].keys(), k
         for f in a[k]:
             np.testing.assert_allclose(
                 a[k][f], b[k][f], rtol=rtol, atol=atol,

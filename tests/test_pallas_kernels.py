@@ -236,3 +236,19 @@ def test_attn_use_flash_gate(monkeypatch):
     assert pk.attn_use_flash(64)
     monkeypatch.setenv('CXXNET_PALLAS', '0')
     assert not pk.attn_use_flash(16384, batch=2, heads=8)
+
+
+def test_lrn_auto_gate_scoped_to_single_device(monkeypatch):
+    """The auto LRN hybrid must stand down inside multi-device GSPMD
+    programs (no sharding rule for the opaque pallas_call); explicit
+    use_pallas=1 still forces it.  The mesh size is threaded per-program
+    through ForwardContext, not a process global."""
+    from cxxnet_tpu.layers import ForwardContext
+    from cxxnet_tpu.ops import pallas_kernels as pk
+    monkeypatch.delenv('CXXNET_PALLAS', raising=False)
+    monkeypatch.setattr(pk, '_interpret', lambda: False)
+    assert pk.lrn_fwd_profitable(256, spmd_devices=1)
+    assert not pk.lrn_fwd_profitable(256, spmd_devices=8)
+    monkeypatch.setenv('CXXNET_PALLAS', '1')
+    assert pk.lrn_fwd_profitable(256, spmd_devices=8)
+    assert ForwardContext(is_train=False).spmd_devices == 1
