@@ -215,16 +215,19 @@ def test_rec_at_n():
         bad.add_eval(pred, label)
 
 
-def test_lookahead_staging_equals_plain_update():
+@pytest.mark.parametrize('update_period', [1, 2])
+def test_lookahead_staging_equals_plain_update(update_period):
     """The CLI train loop's one-batch lookahead (stage_batch for i+1
     enqueued before update_staged for i) must produce bitwise-identical
     training to plain per-batch update() — staging must not disturb rng
-    streams, counters, masks, or deferred train metrics."""
+    streams, counters, masks, gradient accumulation (update_period>1),
+    or deferred train metrics."""
     batches = [_multilabel_batch(np.random.RandomState(100 + i))
                for i in range(5)]
 
     def final_params(drive):
-        tr = NetTrainer(parse_config_string(MULTILABEL_CONF + 'seed = 7\n'))
+        tr = NetTrainer(parse_config_string(
+            MULTILABEL_CONF + f'seed = 7\nupdate_period = {update_period}\n'))
         tr.init_model()
         drive(tr)
         tr.flush_train_metrics()
@@ -246,6 +249,14 @@ def test_lookahead_staging_equals_plain_update():
     t1, t2 = final_params(plain), final_params(lookahead)
     assert t1.sample_counter == t2.sample_counter
     assert t1.epoch_counter == t2.epoch_counter
+    # 5 batches at update_period=2: the tail accumulation lives only in
+    # grad_acc — compare it too, or a staging bug in a non-applying step
+    # would be invisible
+    for k, fields in t1.grad_acc.items():
+        for f, v in fields.items():
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(t2.grad_acc[k][f]),
+                                          err_msg=f'grad_acc {k}/{f}')
     for k, fields in t1.params.items():
         for f, v in fields.items():
             np.testing.assert_array_equal(np.asarray(v),
