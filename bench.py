@@ -277,6 +277,81 @@ compute_type = bfloat16
                        BASELINE_VGG16_IMAGES_PER_SEC)
 
 
+def _pack_synthetic_imgbin(tmp: str, n_images: int):
+    """Pack a synthetic JPEG imgbin dataset with the in-tree packer;
+    returns (list_path, bin_path)."""
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    lst = os.path.join(tmp, 'train.lst')
+    with open(lst, 'w') as f:
+        for i in range(n_images):
+            # low-frequency content (16x16 noise upsampled): natural-
+            # photo-like JPEG size/decode cost, unlike raw noise which
+            # barely compresses and overstates decode time
+            small = rng.randint(0, 255, (16, 16, 3), dtype=np.uint8)
+            img = Image.fromarray(small).resize((256, 256),
+                                                Image.BILINEAR)
+            img.save(os.path.join(tmp, f'{i}.jpg'), quality=85)
+            f.write(f'{i}\t{i % 1000}\t{i}.jpg\n')
+    binpath = os.path.join(tmp, 'train.bin')
+    subprocess.check_call(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      'tools', 'im2bin.py'), lst, tmp, binpath],
+        stdout=subprocess.DEVNULL)
+    return lst, binpath
+
+
+def _imgbinx_chain(lst: str, binpath: str, batch_size: int):
+    """The production input chain: two-stage imgbinx reader -> augment
+    (rand crop+mirror) -> batch -> background threadbuffer."""
+    return [('iter', 'imgbinx'),
+            ('image_list', lst),
+            ('image_bin', binpath),
+            ('shuffle', '1'), ('rand_crop', '1'), ('rand_mirror', '1'),
+            ('input_shape', '3,227,227'),
+            ('batch_size', str(batch_size)),
+            ('round_batch', '1'), ('silent', '1'),
+            ('iter', 'threadbuffer')]
+
+
+def bench_io() -> int:
+    """HOST-side input-pipeline throughput: imgbin pages -> JPEG decode
+    -> augment -> batch -> threadbuffer, no device involved (runs
+    anywhere, chip or not).  This is the supply side of the e2e number:
+    if bench_io < bench_alexnet img/s, the host pipeline is the e2e
+    bottleneck (the reference's iter_thread_imbin_x exists for exactly
+    that reason).  Counterpart of the reference's ``test_io=1`` harness
+    (cxxnet_main.cpp test_io loop)."""
+    import tempfile
+
+    from cxxnet_tpu.io.data import create_iterator
+
+    batch_size = _bench_batch(256)
+    n_images = int(os.environ.get('CXXNET_E2E_IMAGES', '1024'))
+    with tempfile.TemporaryDirectory() as tmp:
+        lst, binpath = _pack_synthetic_imgbin(tmp, n_images)
+        it = create_iterator(_imgbinx_chain(lst, binpath, batch_size))
+        it.init()
+        for b in it:                 # warm: page cache, buffers, threads
+            pass
+        n_done, t0 = 0, time.perf_counter()
+        for _round in range(2):
+            for b in it:
+                n_done += b.batch_size - b.num_batch_padd
+        dt = time.perf_counter() - t0
+    ips = n_done / dt
+    _emit({
+        'metric': 'host_io_images_per_sec',
+        'value': round(ips, 1),
+        'unit': 'images/sec',
+        'vs_baseline': None,
+        'images': n_done,
+        'note': 'imgbinx+decode+augment+threadbuffer, host only',
+    })
+    return 0
+
+
 def bench_e2e_alexnet() -> int:
     """END-TO-END AlexNet throughput: the real CLI training-loop path —
     imgbin pages -> native/PIL JPEG decode -> augment (crop+mirror) ->
@@ -293,31 +368,12 @@ def bench_e2e_alexnet() -> int:
     from cxxnet_tpu.models import alexnet_conf
     from cxxnet_tpu.nnet.trainer import NetTrainer
     from cxxnet_tpu.utils.config import parse_config_string
-    from PIL import Image
 
     batch_size = _bench_batch(256)
     n_images = int(os.environ.get('CXXNET_E2E_IMAGES', '1024'))
-    rng = np.random.RandomState(0)
 
     with tempfile.TemporaryDirectory() as tmp:
-        # pack a synthetic JPEG imgbin dataset with the in-tree packer
-        lst = os.path.join(tmp, 'train.lst')
-        with open(lst, 'w') as f:
-            for i in range(n_images):
-                # low-frequency content (16x16 noise upsampled): natural-
-                # photo-like JPEG size/decode cost, unlike raw noise which
-                # barely compresses and overstates decode time
-                small = rng.randint(0, 255, (16, 16, 3), dtype=np.uint8)
-                img = Image.fromarray(small).resize((256, 256),
-                                                    Image.BILINEAR)
-                img.save(os.path.join(tmp, f'{i}.jpg'), quality=85)
-                f.write(f'{i}\t{i % 1000}\t{i}.jpg\n')
-        subprocess.check_call(
-            [sys.executable,
-             os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          'tools', 'im2bin.py'),
-             lst, tmp, os.path.join(tmp, 'train.bin')],
-            stdout=subprocess.DEVNULL)
+        lst, binpath = _pack_synthetic_imgbin(tmp, n_images)
 
         conf = alexnet_conf() + f"""
 batch_size = {batch_size}
@@ -330,15 +386,7 @@ compute_type = bfloat16
 """
         trainer = NetTrainer(parse_config_string(conf))
         trainer.init_model()
-        itcfg = [('iter', 'imgbinx'),
-                 ('image_list', lst),
-                 ('image_bin', os.path.join(tmp, 'train.bin')),
-                 ('shuffle', '1'), ('rand_crop', '1'), ('rand_mirror', '1'),
-                 ('input_shape', '3,227,227'),
-                 ('batch_size', str(batch_size)),
-                 ('round_batch', '1'), ('silent', '1'),
-                 ('iter', 'threadbuffer')]
-        it = create_iterator(itcfg)
+        it = create_iterator(_imgbinx_chain(lst, binpath, batch_size))
         it.init()
 
         # round 0: compile + pipeline warmup (untimed)
@@ -596,6 +644,7 @@ _MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
           'vgg16': ('vgg16_images_per_sec_per_chip', bench_vgg16),
           'e2e_alexnet': ('alexnet_e2e_images_per_sec_per_chip',
                           bench_e2e_alexnet),
+          'io': ('host_io_images_per_sec', bench_io),
           'mnist_tta': ('mnist_time_to_2pct_error', bench_mnist_tta)}
 
 
@@ -607,7 +656,8 @@ def main() -> int:
         return 2
     metric, fn = _MODES[mode]
     try:
-        _ensure_backend()
+        if mode != 'io':             # host-only mode: no device needed
+            _ensure_backend()
         return fn()
     except BaseException as e:           # noqa: BLE001 — one JSON line, always
         _emit({'metric': metric, 'value': None, 'unit': None,

@@ -394,3 +394,23 @@ def test_membuffer_caches_and_loops(tmp_path):
     assert len(e1) == 2 and len(e2) == 2     # capped at max_nbatch
     for a, b in zip(e1, e2):
         np.testing.assert_array_equal(a.data, b.data)
+
+
+def test_imgbinx_decode_pool_order_identical(tmp_path, small_pages):
+    """The decode thread pool must yield the exact instance stream of the
+    serial path for any thread count (order-preserving submission
+    window) — shuffle permutations included."""
+    lst, binp = _write_bin_dataset(str(tmp_path), 37)
+
+    def stream(threads):
+        cfg = [('iter', 'imgbinx'), ('image_list', lst),
+               ('image_bin', binp), ('shuffle', '1'),
+               ('decode_threads', str(threads)), ('silent', '1'),
+               ('seed_data', '5'), ('batch_size', '8'),
+               ('input_shape', '3,6,6'), ('round_batch', '0')]
+        return _instance_order(cfg)
+
+    base = stream(1)
+    assert sorted(base) == list(range(37))
+    for t in (3, 8):
+        assert stream(t) == base, f'decode_threads={t} changed the stream'
