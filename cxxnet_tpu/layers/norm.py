@@ -47,11 +47,18 @@ class LRNLayer(Layer):
 
     def forward(self, params, inputs, ctx):
         x = inputs[0]  # (b, y, x, c)
-        from ..ops.pallas_kernels import lrn_fwd_profitable, lrn_hybrid
-        if lrn_fwd_profitable(x.shape[-1], ctx.spmd_devices):
-            # Pallas forward / XLA backward hybrid: on by default at the
-            # shapes where the fused forward measured ahead
+        from ..ops.pallas_kernels import (lrn_auto_mode, lrn_hybrid,
+                                          lrn_pallas)
+        mode = lrn_auto_mode(x.shape[-1], ctx.spmd_devices)
+        if mode == 'full':
+            # Pallas forward AND backward: fwd+bwd measured 2.16x ahead
+            # of XLA at 128-lane-aligned channels
             # (receipts/micro_lrn.json; ops/pallas_kernels.py)
+            return [lrn_pallas(x, self.nsize, self.alpha, self.beta,
+                               self.knorm)]
+        if mode == 'hybrid':
+            # Pallas forward / XLA backward: the fused fwd wins even at
+            # non-MXU-aligned channel counts but the Pallas bwd loses
             return [lrn_hybrid(x, self.nsize, self.alpha, self.beta,
                                self.knorm)]
         x32 = x.astype(jnp.float32)

@@ -1,5 +1,5 @@
 """Device compute ops: Pallas TPU kernels + XLA lowerings."""
 
-from .pallas_kernels import (lrn_fwd_profitable, lrn_hybrid,
+from .pallas_kernels import (lrn_auto_mode, lrn_hybrid,
                              lrn_pallas, pallas_enabled,
                              pallas_matmul, pallas_mode)

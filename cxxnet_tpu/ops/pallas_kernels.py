@@ -44,7 +44,7 @@ def pallas_mode() -> str:
     ``CXXNET_PALLAS=1`` forces every Pallas path), ``'off'`` (explicit 0
     disables even the measured-profitable ones), ``'auto'`` (unset: each
     op consults its own receipts-derived profitability gate — see
-    ``lrn_fwd_profitable`` and receipts/micro_*.json)."""
+    ``lrn_auto_mode`` and receipts/micro_*.json)."""
     v = os.environ.get('CXXNET_PALLAS')
     if v is None or not v.strip():
         return 'auto'
@@ -83,13 +83,22 @@ def attn_use_flash(seq_len: int, batch: int = 1, heads: int = 1) -> bool:
             and score_bytes >= _FLASH_SCORE_BYTES)
 
 
-def lrn_fwd_profitable(c: int, spmd_devices: int = 1) -> bool:
-    """Whether the Pallas LRN *forward* beats XLA at channel count ``c``
-    on this backend.  From receipts/micro_lrn.json (TPU v5 lite, bf16):
-    4.18x at c=256 (MXU-aligned band matmul), 0.98x at c=96 (tile
-    underfill) — so the gate is lane-aligned channel counts on a real
-    TPU.  The Pallas LRN *backward* loses at every measured shape
-    (0.58-0.70x), which is why the default path is ``lrn_hybrid``.
+def lrn_auto_mode(c: int, spmd_devices: int = 1) -> str:
+    """Which LRN implementation the ``auto`` Pallas mode picks at channel
+    count ``c``: ``'full'`` (Pallas fwd+bwd), ``'hybrid'`` (Pallas fwd /
+    XLA bwd), or ``'xla'``.
+
+    From receipts/micro_lrn.json (TPU v5 lite, bf16, 2026-07-30
+    scatter-add-perturbation rerun — the earlier broadcast-perturbation
+    numbers let XLA hoist work and are superseded):
+    c=256 (AlexNet norm2): fwd 1.37x, fwd+bwd **2.16x** -> full Pallas;
+    c=96  (AlexNet norm1): fwd 1.90x, fwd+bwd 0.66x -> the fused fwd
+    wins even with the 96-lane underfill but the bwd loses, so the
+    hybrid keeps the fwd win and hands the bwd to XLA.  The gates:
+    128-lane-aligned channels run full Pallas; other sublane-aligned
+    (c % 8) counts at or above the measured c=96 floor run the hybrid
+    (smaller channel counts underfill the (c, c) band matmul worse than
+    anything measured, so they stay on XLA); ragged counts stay on XLA.
 
     ``spmd_devices`` is the mesh size of the CALLING program (threaded
     through ForwardContext): auto engages only in single-device
@@ -97,14 +106,21 @@ def lrn_fwd_profitable(c: int, spmd_devices: int = 1) -> bool:
     call with no sharding rule — the partitioner would gather the full
     sharded activation around it, slower and memory-fatter than the XLA
     path it replaces (and the receipts are single-chip measurements).
-    Explicit ``use_pallas=1`` still forces the kernel everywhere; the
-    shard_map'd paths in parallel/sequence.py run per-shard by
+    Explicit ``use_pallas=1`` still forces the full kernel everywhere;
+    the shard_map'd paths in parallel/sequence.py run per-shard by
     construction and take no such scoping."""
-    if pallas_mode() == 'off':
-        return False
-    if pallas_mode() == 'on':
-        return True
-    return (not _interpret() and spmd_devices == 1 and c % 128 == 0)
+    mode = pallas_mode()
+    if mode == 'off':
+        return 'xla'
+    if mode == 'on':
+        return 'full'
+    if _interpret() or spmd_devices != 1:
+        return 'xla'
+    if c % 128 == 0:
+        return 'full'
+    if c % 8 == 0 and c >= 96:
+        return 'hybrid'
+    return 'xla'
 
 
 def _interpret() -> bool:
@@ -246,14 +262,17 @@ lrn_pallas.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)
 def lrn_hybrid(x, nsize: int, alpha: float, beta: float, knorm: float):
     """Cross-channel LRN: Pallas forward, XLA backward.
 
-    The measured split (receipts/micro_lrn.json): the fused forward wins
-    up to 4.18x where the band matmul is MXU-aligned, but the Pallas
-    backward loses to XLA everywhere (0.58-0.70x) — XLA fuses the two
-    elementwise chains around the window-sum better than the one-kernel
-    version, which recomputes ``norm**-beta`` twice per tile.  So the
-    backward here is plain jnp ops (the cumsum window trick of
-    ``layers/norm.py``) on the residuals the Pallas forward already
-    produced."""
+    The measured split (receipts/micro_lrn.json, 2026-07-30 rerun): the
+    fused forward wins at every measured shape (1.90x at c=96, 1.37x at
+    c=256), while the Pallas backward only wins at 128-lane-aligned
+    channels (fwd+bwd 2.16x at c=256 — ``lrn_auto_mode`` routes those to
+    the full ``lrn_pallas``) and loses below that (fwd+bwd 0.66x at
+    c=96, where the bwd band matmul underfills the MXU worse than the
+    fwd because it runs two elementwise chains per tile).  So this
+    hybrid — the auto choice at non-128-aligned channels — keeps the
+    Pallas forward and runs the backward as plain jnp ops (the cumsum
+    window trick of ``layers/norm.py``) on the residuals the Pallas
+    forward already produced."""
     out, _ = _lrn_fwd_impl(x, nsize, alpha, beta, knorm)
     return out
 

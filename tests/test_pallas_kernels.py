@@ -74,22 +74,26 @@ def test_lrn_hybrid_matches_full_pallas(nsize):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_lrn_fwd_profitable_gate(monkeypatch):
-    """'auto' enables the hybrid only on a real TPU at lane-aligned
-    channel counts; explicit on/off override both ways."""
+def test_lrn_auto_mode_gate(monkeypatch):
+    """'auto' picks full Pallas at 128-lane-aligned channels, the
+    fwd-only hybrid at other sublane-aligned counts, XLA for ragged
+    channels or off-TPU; explicit on/off override both ways
+    (receipts/micro_lrn.json)."""
     from cxxnet_tpu.ops import pallas_kernels as pk
     monkeypatch.delenv('CXXNET_PALLAS', raising=False)
     assert pk.pallas_mode() == 'auto'
     # off a real TPU (interpret mode) auto never turns pallas on
     monkeypatch.setattr(pk, '_interpret', lambda: True)
-    assert not pk.lrn_fwd_profitable(256)
+    assert pk.lrn_auto_mode(256) == 'xla'
     monkeypatch.setattr(pk, '_interpret', lambda: False)
-    assert pk.lrn_fwd_profitable(256)
-    assert not pk.lrn_fwd_profitable(96)
+    assert pk.lrn_auto_mode(256) == 'full'     # norm2: fwd+bwd 2.16x
+    assert pk.lrn_auto_mode(96) == 'hybrid'    # norm1: fwd 1.90x, bwd loses
+    assert pk.lrn_auto_mode(50) == 'xla'       # ragged channel count
+    assert pk.lrn_auto_mode(24) == 'xla'       # below the measured floor
     monkeypatch.setenv('CXXNET_PALLAS', '0')
-    assert not pk.lrn_fwd_profitable(256)
+    assert pk.lrn_auto_mode(256) == 'xla'
     monkeypatch.setenv('CXXNET_PALLAS', '1')
-    assert pk.lrn_fwd_profitable(96)
+    assert pk.lrn_auto_mode(96) == 'full'
 
 
 def test_lrn_pallas_under_jit():
@@ -247,8 +251,8 @@ def test_lrn_auto_gate_scoped_to_single_device(monkeypatch):
     from cxxnet_tpu.ops import pallas_kernels as pk
     monkeypatch.delenv('CXXNET_PALLAS', raising=False)
     monkeypatch.setattr(pk, '_interpret', lambda: False)
-    assert pk.lrn_fwd_profitable(256, spmd_devices=1)
-    assert not pk.lrn_fwd_profitable(256, spmd_devices=8)
+    assert pk.lrn_auto_mode(256, spmd_devices=1) == 'full'
+    assert pk.lrn_auto_mode(256, spmd_devices=8) == 'xla'
     monkeypatch.setenv('CXXNET_PALLAS', '1')
-    assert pk.lrn_fwd_profitable(256, spmd_devices=8)
+    assert pk.lrn_auto_mode(256, spmd_devices=8) == 'full'
     assert ForwardContext(is_train=False).spmd_devices == 1
