@@ -1,0 +1,37 @@
+#!/bin/sh
+# Follow-up chip measurements queued behind run_chip_suite.sh: waits for
+# the suite to release the chip, then lands the rows the suite doesn't
+# carry — the mnist_tta refresh (BASELINE.md promises its receipt) and an
+# AlexNet rerun capturing the lrn_auto_mode gate change (full-Pallas LRN
+# at norm2 + hybrid at norm1) that was committed after the suite's
+# alexnet step ran.  Same durability contract: every receipt commits the
+# moment it exists.
+set -x
+REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
+OUT=${OUT:-$REPO/receipts}
+cd "$REPO" || exit 1
+
+while pgrep -f run_chip_suite.sh >/dev/null 2>&1; do
+    sleep 60
+done
+
+save() {
+    for p in "$@"; do
+        [ -e "$p" ] && git add "$p"
+    done
+    if ! git diff --cached --quiet -- "$@"; then
+        git commit -q -m "receipts: $(basename "$1" .json)" -- "$@" ||
+            echo "WARNING: receipts NOT committed: $*" >&2
+    fi
+}
+
+bench() {
+    f="$OUT/$2"
+    timeout 2700 python bench.py "$1" > "$f" 2>"$OUT/$2.log" ||
+        [ -s "$f" ] || echo '{"metric":"'"$1"'","value":null,"error":"killed/timeout"}' > "$f"
+    save "$f" "$OUT/$2.log"
+}
+
+bench mnist_tta bench_mnist_tta.json
+bench alexnet   bench_alexnet_lrngate.json
+echo "followup done"
