@@ -130,6 +130,17 @@ def test_lrn_layer_uses_pallas_when_enabled(monkeypatch):
                                rtol=1e-5, atol=1e-6)
 
 
+def test_clamp_tile():
+    """Default tiles shrink to the covered dim (lane-aligned): fullc's
+    production m=256 must not be padded to the TN kernel's old fixed
+    tile_m=512 (that halved its throughput, receipts/micro_matmul_bwd)."""
+    from cxxnet_tpu.ops.pallas_kernels import _clamp_tile
+    assert _clamp_tile(512, 256) == 256
+    assert _clamp_tile(512, 1000) == 512
+    assert _clamp_tile(256, 100) == 128
+    assert _clamp_tile(128, 8) == 128
+
+
 def test_pallas_matmul_grad():
     rng = np.random.RandomState(5)
     a = jnp.asarray(rng.randn(64, 48).astype(np.float32))
