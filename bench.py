@@ -12,6 +12,8 @@ Extra modes for the BASELINE.md ledger (same JSON shape):
   python bench.py e2e_alexnet      # AlexNet through the FULL data path
                                    #   (imgbin+decode+augment+H2D included)
   python bench.py mnist_tta        # MNIST conv time-to-2%-test-error (sec)
+  python bench.py transformer      # TransformerLM tokens/sec (GPT-2-small
+                                   #   class; beyond-reference family)
 
 Robustness: the axon tunnel that fronts the TPU chip can wedge or report
 UNAVAILABLE transiently (it recovers by waiting).  Before importing jax in
@@ -56,6 +58,9 @@ BASELINE_INCEPTION_IMAGES_PER_SEC = 130.0  # Inception-BN stand-in, same era
 BASELINE_GOOGLENET_IMAGES_PER_SEC = 150.0  # GoogLeNet v1 stand-in, same era
 BASELINE_VGG16_IMAGES_PER_SEC = 50.0       # VGG-16 stand-in, same era
 BASELINE_MNIST_TTA_SEC = 30.0            # reference MNIST.conf CPU run
+BASELINE_TRANSFORMER_TOKENS_PER_SEC = 25000.0  # stand-in: GPT-2-small-class
+# fp16 training on a 2019 V100 (no reference number exists — the
+# reference framework has no attention; generous like the other stand-ins)
 
 # bf16 peak TFLOP/s by TPU generation (marketing peak; MFU denominators)
 _PEAK_BF16_TFLOPS = (
@@ -123,10 +128,60 @@ def _peak_flops() -> float:
     return 197e12                        # v5e-class default
 
 
-def _throughput(conf: str, batch_size: int, shape, metric: str,
-                baseline: float) -> int:
+def _bench_steps(default: int) -> int:
+    """K for the K-vs-1 quotient; floor 2 (K=1 has no quotient)."""
+    return max(2, int(os.environ.get('CXXNET_BENCH_STEPS', str(default))))
+
+
+def _quotient_per_step(run_1, run_k, steps: int):
+    """The ledger timing method, in ONE place: warm both compiled loops,
+    then 4 reps of each endpoint; per-step seconds is the K-vs-1
+    difference quotient of the min wall times.  min over reps because the
+    link cost is a constant floor plus positive jitter spikes, so min
+    rejects the spikes where a median-of-noisy-quotients cannot.
+    Returns (per_step_seconds, t1s)."""
+    run_1()                              # compile + warm
+    run_k()
+    t1s, tks = [], []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        run_1()
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_k()
+        tks.append(time.perf_counter() - t0)
+    return (min(tks) - min(t1s)) / (steps - 1), t1s
+
+
+def _emit_throughput(metric: str, work_per_step: float, unit: str,
+                     baseline: float, step_flops: float, per_step: float,
+                     t1s) -> None:
+    """The shared ledger JSON payload (value/tflops/mfu/step_ms/
+    dispatch_ms/timing keys) — one schema for every model family."""
     import statistics
 
+    rate = work_per_step / per_step
+    achieved = step_flops / per_step
+    peak = _peak_flops()
+    measured = step_flops > 0            # 0 = backend has no cost model
+    _emit({
+        'metric': metric,
+        'value': round(rate, 1),
+        'unit': unit,
+        'vs_baseline': round(rate / baseline, 3),
+        'tflops': round(achieved / 1e12, 2) if measured else None,
+        'mfu': round(achieved / peak, 4) if measured and peak else None,
+        'step_ms': round(per_step * 1e3, 3),
+        # wall time of a 1-step dispatch minus the step itself = the pure
+        # link/dispatch overhead one un-pipelined update() pays per call
+        'dispatch_ms': round(statistics.median(t1s) * 1e3 - per_step * 1e3,
+                             1),
+        'timing': 'scan-in-jit K-vs-1 quotient',
+    })
+
+
+def _throughput(conf: str, batch_size: int, shape, metric: str,
+                baseline: float) -> int:
     from cxxnet_tpu.nnet.trainer import NetTrainer
     from cxxnet_tpu.utils.config import parse_config_string
 
@@ -154,7 +209,7 @@ def _throughput(conf: str, batch_size: int, shape, metric: str,
         rng.randint(0, 1000, (nstack, batch_size, 1)).astype(np.float32),
         cast=False)
 
-    steps = int(os.environ.get('CXXNET_BENCH_STEPS', '30'))
+    steps = _bench_steps(30)
     multi_1 = trainer.compile_multi_step(1)
     multi_k = trainer.compile_multi_step(steps)
     step_flops = trainer.train_step_flops(dstack[0], lstack[0])
@@ -165,39 +220,10 @@ def _throughput(conf: str, batch_size: int, shape, metric: str,
         return float(np.asarray(
             trainer.update_n_on_device(fn, dstack, lstack, n)))
 
-    run(multi_1, 1)                      # compile + warm
-    run(multi_k, steps)
-    # min over reps at each endpoint before the quotient: the link cost is
-    # a constant floor plus positive jitter spikes, so min rejects the
-    # spikes where a median-of-noisy-quotients cannot
-    t1s, tks = [], []
-    for _ in range(4):
-        t0 = time.perf_counter()
-        run(multi_1, 1)
-        t1s.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        run(multi_k, steps)
-        tks.append(time.perf_counter() - t0)
-    per_step = (min(tks) - min(t1s)) / (steps - 1)
-
-    ips = batch_size / per_step
-    achieved = step_flops / per_step
-    peak = _peak_flops()
-    measured = step_flops > 0            # 0 = backend has no cost model
-    _emit({
-        'metric': metric,
-        'value': round(ips, 1),
-        'unit': 'images/sec',
-        'vs_baseline': round(ips / baseline, 3),
-        'tflops': round(achieved / 1e12, 2) if measured else None,
-        'mfu': round(achieved / peak, 4) if measured and peak else None,
-        'step_ms': round(per_step * 1e3, 3),
-        # wall time of a 1-step dispatch minus the step itself = the pure
-        # link/dispatch overhead one un-pipelined update() pays per call
-        'dispatch_ms': round(statistics.median(t1s) * 1e3 - per_step * 1e3,
-                             1),
-        'timing': 'scan-in-jit K-vs-1 quotient',
-    })
+    per_step, t1s = _quotient_per_step(
+        lambda: run(multi_1, 1), lambda: run(multi_k, steps), steps)
+    _emit_throughput(metric, batch_size, 'images/sec', baseline,
+                     step_flops, per_step, t1s)
     return 0
 
 
@@ -275,6 +301,74 @@ compute_type = bfloat16
     return _throughput(conf, batch_size, (3, 224, 224),
                        'vgg16_images_per_sec_per_chip',
                        BASELINE_VGG16_IMAGES_PER_SEC)
+
+
+def _transformer_throughput(cfg, batch: int, metric: str,
+                            baseline: float) -> int:
+    """Tokens/sec of a TransformerLM train step on the current backend,
+    timed like _throughput: the whole K-step loop runs on device in one
+    dispatch (lax.scan over the params carry, cycling a stacked token
+    stack) and the per-step time is the K-vs-1 difference quotient."""
+    import jax.numpy as jnp
+
+    from cxxnet_tpu.models import transformer as T
+
+    rng = np.random.RandomState(0)
+    params = T.init_params(rng, cfg)
+    nstack = 4
+    toks = jnp.asarray(rng.randint(
+        0, cfg.vocab_size, (nstack, batch, cfg.seq_len)), jnp.int32)
+    labs = jnp.asarray(rng.randint(
+        0, cfg.vocab_size, (nstack, batch, cfg.seq_len)), jnp.int32)
+
+    steps = _bench_steps(20)
+    multi_1 = T.make_multi_train_step(cfg, 1, lr=0.01)
+    multi_k = T.make_multi_train_step(cfg, steps, lr=0.01)
+
+    try:
+        cost = multi_1.lower(params, toks, labs).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        step_flops = float(cost.get('flops', 0.0)) if cost else 0.0
+    except Exception:
+        step_flops = 0.0
+
+    def run(fn):
+        nonlocal params
+        params, loss = fn(params, toks, labs)
+        # a device_get is the only reliable completion barrier over the
+        # remote tunnel (block_until_ready acks early there)
+        return float(np.asarray(loss))
+
+    per_step, t1s = _quotient_per_step(
+        lambda: run(multi_1), lambda: run(multi_k), steps)
+    _emit_throughput(metric, batch * cfg.seq_len, 'tokens/sec', baseline,
+                     step_flops, per_step, t1s)
+    return 0
+
+
+def bench_transformer() -> int:
+    """TransformerLM tokens/sec on one chip — the beyond-reference
+    flagship family (the reference has no attention anywhere, SURVEY.md
+    §5 'long-context: N/A for parity').  GPT-2-small-class decoder:
+    8 blocks, d_model 1024, 16 heads, d_ff 4096, causal, bf16.  Times
+    the single-device path (``reference_loss`` + scanned SGD) — the
+    exact math the 4-axis shard_map step is oracle-tested against
+    (tests/test_transformer_parallel.py), but NOT the shard_map program
+    itself, which needs a multi-chip mesh to mean anything."""
+    import jax.numpy as jnp
+
+    from cxxnet_tpu.models import transformer as T
+
+    batch = _bench_batch(16)
+    seq = int(os.environ.get('CXXNET_BENCH_SEQ', '1024'))
+    cfg = T.TransformerConfig(
+        vocab_size=32768, d_model=1024, num_heads=16, d_ff=4096,
+        num_stages=8, seq_len=seq, attn='local', causal=True,
+        num_microbatches=1, dtype=jnp.bfloat16)
+    return _transformer_throughput(
+        cfg, batch, 'transformer_tokens_per_sec_per_chip',
+        BASELINE_TRANSFORMER_TOKENS_PER_SEC)
 
 
 def _pack_synthetic_imgbin(tmp: str, n_images: int):
@@ -645,7 +739,9 @@ _MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
           'e2e_alexnet': ('alexnet_e2e_images_per_sec_per_chip',
                           bench_e2e_alexnet),
           'io': ('host_io_images_per_sec', bench_io),
-          'mnist_tta': ('mnist_time_to_2pct_error', bench_mnist_tta)}
+          'mnist_tta': ('mnist_time_to_2pct_error', bench_mnist_tta),
+          'transformer': ('transformer_tokens_per_sec_per_chip',
+                          bench_transformer)}
 
 
 def main() -> int:

@@ -207,11 +207,12 @@ def _loss_local(params, tokens, labels, *, cfg, tp, sp):
     return loss, aux
 
 
-def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 0.1):
-    """Jitted full train step: (params, tokens, labels) ->
-    (new_params, loss, aux).  tokens/labels are global (B, seq_len) int32;
-    aux reports ``balance_loss`` (unweighted) and ``drop_frac`` summed over
-    MoE blocks (zeros for dense FFN)."""
+def _make_step_body(cfg: TransformerConfig, mesh: Mesh, lr: float):
+    """The per-rank train-step body of :func:`make_train_step`:
+    (params, tokens, labels) -> (new_params, loss, aux), all local
+    shards.  NOTE: :func:`make_multi_train_step` does NOT use this — it
+    scans :func:`reference_loss` (see its docstring for why); optimizer
+    changes here must be mirrored there."""
     tp = mesh.shape['model']
     sp = mesh.shape['seq']
     if cfg.num_heads % tp:
@@ -221,7 +222,6 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 0.1):
             f"attn='{cfg.attn}' on a seq-sharded mesh (seq={sp}) would "
             "attend block-diagonally; use attn='ring'")
     specs = param_specs(cfg)
-    tok_spec = P('data', 'seq')
 
     n_ranks = (mesh.shape['pipe'] * mesh.shape['data']
                * mesh.shape['seq'] * mesh.shape['model'])
@@ -253,12 +253,58 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 0.1):
         aux = jax.tree.map(lambda v: lax.pmean(v, AXES), aux)
         return new_params, lax.pmean(loss, AXES), aux
 
+    return body, specs
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 0.1):
+    """Jitted full train step: (params, tokens, labels) ->
+    (new_params, loss, aux).  tokens/labels are global (B, seq_len) int32;
+    aux reports ``balance_loss`` (unweighted) and ``drop_frac`` summed over
+    MoE blocks (zeros for dense FFN)."""
+    body, specs = _make_step_body(cfg, mesh, lr)
+    tok_spec = P('data', 'seq')
     fn = shard_map(body, mesh=mesh,
                    in_specs=(specs, tok_spec, tok_spec),
                    out_specs=(specs, P(), {'balance_loss': P(),
                                            'drop_frac': P()}),
                    check_vma=False)
     return jax.jit(fn)
+
+
+def make_multi_train_step(cfg: TransformerConfig, n_steps: int,
+                          lr: float = 0.1):
+    """Single-device jitted ``n_steps``-step training loop in ONE
+    dispatch: (params, tok_stack, lab_stack) -> (new_params, last_loss),
+    the stacks (nstack, B, seq_len) int32 cycled round-robin — the
+    transformer counterpart of ``NetTrainer.compile_multi_step``, used by
+    bench.py (per-step dispatch over the dev-harness tunnel measures the
+    link, not the chip) and by single-chip pre-staged pipelines.  Built
+    on :func:`reference_loss` (the oracle the mesh step is tested
+    against): a ``lax.scan`` whose body contains a shard_map does not
+    lower on this jax version (internally-jitted jnp helpers become
+    closed_calls the lowering cache misses), and a single chip needs no
+    mesh anyway."""
+
+    def multi(params, tok_stack, lab_stack):
+        nstack = tok_stack.shape[0]
+
+        def sbody(p, t):
+            tok = lax.dynamic_index_in_dim(tok_stack, t % nstack,
+                                           keepdims=False)
+            lab = lax.dynamic_index_in_dim(lab_stack, t % nstack,
+                                           keepdims=False)
+            loss, grads = jax.value_and_grad(reference_loss)(p, tok, lab,
+                                                             cfg)
+            p = jax.tree.map(
+                lambda w, g: (w - lr * g).astype(w.dtype), p, grads)
+            return p, loss
+
+        params, losses = lax.scan(sbody, params, jnp.arange(n_steps))
+        return params, losses[-1]
+
+    jitted = jax.jit(multi, donate_argnums=(0,))
+    jitted.n_steps = n_steps
+    return jitted
 
 
 def build_transformer_mesh(n_devices: int,

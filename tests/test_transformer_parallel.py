@@ -328,3 +328,27 @@ def test_param_shapes_matches_init_params():
         for path, leaf in la:
             assert lb[path].shape == leaf.shape, path
             assert lb[path].dtype == leaf.dtype, path
+
+
+def test_bench_transformer_throughput_smoke(monkeypatch, capsys):
+    """bench.py's transformer mode end-to-end at toy size: the scan-in-jit
+    K-vs-1 quotient path must emit one valid JSON line with positive
+    tokens/sec (the on-chip run reuses this exact code at GPT-2-small
+    size)."""
+    import json as _json
+
+    import bench
+
+    monkeypatch.setenv('CXXNET_BENCH_STEPS', '3')
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, num_heads=2,
+                                d_ff=64, num_stages=2, seq_len=16,
+                                attn='local', causal=True,
+                                num_microbatches=1, dtype=jnp.float32)
+    assert bench._transformer_throughput(
+        cfg, batch=2, metric='transformer_tokens_per_sec_per_chip',
+        baseline=1.0) == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = _json.loads(line)
+    assert out['metric'] == 'transformer_tokens_per_sec_per_chip'
+    assert out['unit'] == 'tokens/sec'
+    assert out['value'] and out['value'] > 0
