@@ -1,0 +1,23 @@
+#!/bin/sh
+# Chained behind run_chip_remaining.sh (which predates the transformer
+# bench mode): waits for that runner to drain and the tunnel to answer,
+# then lands the TransformerLM tokens/sec receipt.
+set -x
+REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
+OUT=${OUT:-$REPO/receipts}
+cd "$REPO" || exit 1
+
+while pgrep -f run_chip_remaining.sh >/dev/null 2>&1; do
+    sleep 120
+done
+until (echo > /dev/tcp/127.0.0.1/8083) 2>/dev/null &&
+      timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; do
+    sleep 120
+done
+
+f="$OUT/bench_transformer.json"
+timeout 2700 python bench.py transformer > "$f" 2>"$OUT/bench_transformer.json.log" ||
+    [ -s "$f" ] || echo '{"metric":"transformer","value":null,"error":"killed/timeout"}' > "$f"
+git add "$f" "$OUT/bench_transformer.json.log" 2>/dev/null
+git diff --cached --quiet -- "$f" || git commit -q -m "receipts: bench_transformer" -- "$f" "$OUT/bench_transformer.json.log"
+echo "transformer bench done"
