@@ -12,7 +12,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-from .base import (ForwardContext, Layer, NodeSpec, Params, as_mat,
+from .base import (Layer, NodeSpec, Params, as_mat,
                    kBias, kChConcat, kConcat, kDropout, kFixConnect, kFlatten,
                    kFullConnect, kInsanity, kMaxout, kPRelu,
                    kRectifiedLinear, kSigmoid, kSoftplus, kSplit, kTanh,

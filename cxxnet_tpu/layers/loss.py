@@ -20,7 +20,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .base import (Layer, NodeSpec, as_mat, kL2Loss, kMultiLogistic,
+from .base import (Layer, as_mat, kL2Loss, kMultiLogistic,
                    kSoftmax, register_layer)
 
 

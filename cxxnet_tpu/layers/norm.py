@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .base import Layer, NodeSpec, kBatchNorm, kLRN, register_layer
+from .base import Layer, kBatchNorm, kLRN, register_layer
 
 
 @register_layer

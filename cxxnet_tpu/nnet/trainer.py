@@ -24,7 +24,6 @@ Reference semantics preserved:
 
 from __future__ import annotations
 
-import io as _io
 import os
 import re
 import struct
@@ -37,7 +36,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..layers import ForwardContext
-from ..layers.loss import LossLayerBase
 from ..parallel.mesh import (batch_sharding, build_mesh, param_shardings,
                              replicated_sharding)
 from ..updater import (apply_updates, create_updater_hyper, init_opt_state)

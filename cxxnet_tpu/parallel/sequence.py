@@ -30,7 +30,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 try:                                    # jax >= 0.5 spelling
     from jax import shard_map

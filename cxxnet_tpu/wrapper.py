@@ -17,7 +17,6 @@ directly, with no FFI hop on the train path.  Semantics preserved:
 
 from __future__ import annotations
 
-import struct
 import sys
 from typing import Iterator, Optional
 
