@@ -756,9 +756,17 @@ def main() -> int:
             _ensure_backend()
         return fn()
     except BaseException as e:           # noqa: BLE001 — one JSON line, always
-        _emit({'metric': metric, 'value': None, 'unit': None,
-               'vs_baseline': None,
-               'error': f'{type(e).__name__}: {e}'})
+        payload = {'metric': metric, 'value': None, 'unit': None,
+                   'vs_baseline': None,
+                   'error': f'{type(e).__name__}: {e}'}
+        # the tunnel to the chip goes down for hours at a time; if this
+        # run could not reach it, point at the last committed on-chip
+        # receipt for the same mode so the measured number is still found
+        receipt = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               'receipts', f'bench_{mode}.json')
+        if os.path.exists(receipt):
+            payload['last_committed_receipt'] = f'receipts/bench_{mode}.json'
+        _emit(payload)
         return 1
 
 
