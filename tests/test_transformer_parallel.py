@@ -352,3 +352,35 @@ def test_bench_transformer_throughput_smoke(monkeypatch, capsys):
     assert out['metric'] == 'transformer_tokens_per_sec_per_chip'
     assert out['unit'] == 'tokens/sec'
     assert out['value'] and out['value'] > 0
+
+
+def test_multi_train_step_matches_mesh_step():
+    """The mirror-contract guard: make_multi_train_step (scanned
+    reference_loss + SGD) applied for ONE step must produce the same loss
+    and updated params as make_train_step on the composed pp2-dp2-sp2
+    mesh (the gradient tie makes that the gradient of the same
+    global-mean loss) — if an optimizer change lands in _make_step_body
+    but not in the multi-step loop (or vice versa), this is the test
+    that breaks."""
+    cfg = tfm.TransformerConfig(vocab_size=32, d_model=16, num_heads=2,
+                                d_ff=32, num_stages=2, seq_len=8,
+                                num_microbatches=2, dtype=jnp.float32)
+    mesh = tfm.build_transformer_mesh(8, 2, 2, 2, 1, devices=_devices(8))
+    rng = np.random.RandomState(7)
+    params_a = tfm.init_params(np.random.RandomState(0), cfg)
+    params_b = tfm.init_params(np.random.RandomState(0), cfg)
+    tok = jnp.asarray(rng.randint(0, 32, (4, 8)), jnp.int32)
+    lab = jnp.asarray(rng.randint(0, 32, (4, 8)), jnp.int32)
+
+    step = tfm.make_train_step(cfg, mesh, lr=0.05)
+    new_a, loss_a, _ = step(params_a, tok, lab)
+
+    multi = tfm.make_multi_train_step(cfg, 1, lr=0.05)
+    new_b, loss_b = multi(params_b, tok[None], lab[None])
+
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
+    for (pa, a), (pb, b) in zip(jax.tree.leaves_with_path(new_a),
+                                jax.tree.leaves_with_path(new_b)):
+        assert pa == pb
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
