@@ -119,6 +119,27 @@ compute_type = bfloat16
     net = tr.net
     host = jax.device_get(tr.params)
     rows = []
+
+    def dump(partial: bool) -> None:
+        # write (atomically) after EVERY layer: a killed/timed-out run
+        # must still leave the rows it produced — losing a finished
+        # measurement to a round-end kill is the round-3 failure mode
+        # the receipts discipline exists to prevent
+        if not args.json:
+            return
+        payload = {'model': args.model, 'batch': bs,
+                   'step_ms': round(t_step * 1e3, 2),
+                   'fwd_ms': round(t_fwd * 1e3, 2),
+                   'achieved_tflops': round(step_flops / t_step / 1e12, 2),
+                   'layers': rows}
+        if partial:
+            payload['partial'] = True
+        tmp = args.json + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, args.json)
+
+    dump(partial=True)
     for i, info in enumerate(net.cfg.layers):
         layer = net.layers[i]
         if layer.type_name in ('relu', 'flatten', 'dropout', 'softmax'):
@@ -164,20 +185,15 @@ compute_type = bfloat16
         print(f'{name:26s} fwd {t_f * 1e6:9.1f}us   '
               f'fwd+bwd {t_g * 1e6:9.1f}us   {100 * t_g / t_step:5.1f}% '
               f'of step', flush=True)
+        dump(partial=True)
 
     covered = sum(r['fwd_bwd_us'] for r in rows) / 1e6
     print(f'sum of isolated layers (fwd+bwd): {covered * 1e3:.2f} ms '
           f'of {t_step * 1e3:.2f} ms step '
           f'({100 * covered / t_step:.0f}% — rest is fusion overlap, '
           f'elementwise, optimizer, dispatch)')
+    dump(partial=False)
     if args.json:
-        with open(args.json, 'w') as f:
-            json.dump({'model': args.model, 'batch': bs,
-                       'step_ms': round(t_step * 1e3, 2),
-                       'fwd_ms': round(t_fwd * 1e3, 2),
-                       'achieved_tflops':
-                           round(step_flops / t_step / 1e12, 2),
-                       'layers': rows}, f, indent=1)
         print(f'wrote {args.json}')
     return 0
 
