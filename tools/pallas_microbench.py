@@ -102,7 +102,28 @@ def main() -> int:
     print(f'device: {dev.device_kind} ({dev.platform})', flush=True)
     dtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
     rng = np.random.RandomState(0)
-    results = []
+
+    def dump(rows, partial: bool) -> None:
+        # rewrite (atomically) after every row: a tunnel wedge mid-suite
+        # already cost one tile sweep its JSON (only the .log survived) —
+        # finished measurements must not die with the process
+        if not args.json:
+            return
+        payload = {'device': dev.device_kind, 'dtype': args.dtype,
+                   'results': list(rows)}
+        if partial:
+            payload['partial'] = True
+        tmp = args.json + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, args.json)
+
+    class _DumpingList(list):
+        def append(self, row):
+            super().append(row)
+            dump(self, partial=True)
+
+    results = _DumpingList()
 
     # --- LRN at AlexNet shapes (NHWC) ---------------------------------
     for b, h, w, c in (((256, 27, 27, 96), (256, 13, 13, 256))
@@ -202,10 +223,8 @@ def main() -> int:
                 functools.partial(flash_attention, causal=causal),
                 (q, k, v), results)
 
+    dump(results, partial=False)
     if args.json:
-        with open(args.json, 'w') as f:
-            json.dump({'device': dev.device_kind, 'dtype': args.dtype,
-                       'results': results}, f, indent=1)
         print(f'wrote {args.json}')
     return 0
 
