@@ -50,3 +50,33 @@ def test_default_value_skipped():
 def test_cli_overrides_append():
     cfg = apply_cli_overrides([('a', '1')], ['a=9', 'b=x'])
     assert cfg == [('a', '1'), ('a', '9'), ('b', 'x')]
+
+
+def test_roundtrip_random_pairs_property():
+    """Property test: any sequence of k=v pairs serialized to conf text
+    parses back to the same ordered pairs (values with spaces/# quoted),
+    pinning the tokenizer against the reference's ordered-replay
+    contract (src/utils/config.h:20-189)."""
+    from hypothesis import given, settings, strategies as st
+
+    keys = st.text('abcdefghijklmnopqrstuvwxyz_0123456789[]->:',
+                   min_size=1, max_size=12).filter(
+        lambda s: s not in ('data', 'eval', 'iter', 'pred'))
+    plain_vals = st.text(
+        'abcdefghijklmnopqrstuvwxyz0123456789.,-/', min_size=1, max_size=16)
+    spaced_vals = st.text(
+        'abcdefghijklmnopqrstuvwxyz #', min_size=1, max_size=16).filter(
+        lambda s: s.strip() == s and s)
+
+    @settings(max_examples=60, deadline=None)
+    @given(st.lists(st.tuples(keys, st.one_of(plain_vals, spaced_vals)),
+                    min_size=1, max_size=12))
+    def run(pairs):
+        lines = []
+        for k, v in pairs:
+            needs_quote = (' ' in v) or ('#' in v)
+            lines.append(f'{k} = "{v}"' if needs_quote else f'{k} = {v}')
+        got = parse_config_string('\n'.join(lines) + '\n')
+        assert got == pairs
+
+    run()
