@@ -414,3 +414,39 @@ def test_imgbinx_decode_pool_order_identical(tmp_path, small_pages):
     assert sorted(base) == list(range(37))
     for t in (3, 8):
         assert stream(t) == base, f'decode_threads={t} changed the stream'
+
+
+def test_binary_page_property_roundtrip():
+    """Property test: any blob sequence (incl. empty blobs and an
+    exact-fit final blob) survives push -> save -> load -> iterate with
+    order and bytes intact, and a full page refuses further pushes —
+    the bit-compatibility contract behind imgbin interop
+    (src/utils/io.h:253-326)."""
+    import io as _io
+
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=12, deadline=None)
+    @given(st.lists(st.binary(min_size=0, max_size=4096), max_size=40),
+           st.booleans())
+    def run(blobs, exact_fill):
+        page = BinaryPage()
+        pushed = []
+        for b in blobs:
+            if page.push(b):
+                pushed.append(b)
+        if exact_fill and page._free_bytes() >= 4:
+            fill = b'z' * (page._free_bytes() - 4)
+            assert page.push(fill)
+            pushed.append(fill)
+            assert page._free_bytes() == 0
+            assert not page.push(b'')   # even b'' needs a 4-byte header
+        buf = _io.BytesIO()
+        page.save(buf)
+        assert buf.tell() == BinaryPage.N_BYTES
+        buf.seek(0)
+        p2 = BinaryPage()
+        assert p2.load(buf)
+        assert list(p2) == pushed
+
+    run()
