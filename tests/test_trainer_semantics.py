@@ -263,3 +263,47 @@ def test_lookahead_staging_equals_plain_update(update_period):
                                           np.asarray(t2.params[k][f]),
                                           err_msg=f'{k}/{f}')
     assert t1.train_metric.print('t') == t2.train_metric.print('t')
+
+
+def test_momentum_saturation_schedule():
+    """Momentum saturation (updater/param.h:76-94): with the schedule on,
+    the effective momentum is min(momentum + ramp(e) + base_momentum,
+    final_momentum) — the reference's quirky additive formula, preserved,
+    with the unconditional final_momentum cap (param.h:88)."""
+    from cxxnet_tpu.updater.updaters import UpdaterHyper
+    h = UpdaterHyper(tag='wmat')
+    for k, v in (('momentum', '0.0'), ('momentum_schedule', '1'),
+                 ('base_momentum', '0.5'), ('final_momentum', '0.9'),
+                 ('saturation_epoch', '100')):
+        h.set_param(k, v)
+    import numpy as _np
+    for epoch, want in ((0, 0.5), (50, 0.7), (200, 0.9)):
+        _lr, mom = h.schedule(epoch)
+        assert _np.asarray(mom) == pytest.approx(want, abs=1e-6)
+    # schedule off: static momentum
+    h2 = UpdaterHyper(tag='wmat')
+    h2.set_param('momentum', '0.8')
+    _lr, mom = h2.schedule(123)
+    assert _np.asarray(mom) == pytest.approx(0.8)
+
+
+def test_clip_gradient_clips_and_zeroes_nan():
+    """clip_gradient both clips to [-c, c] and zeroes NaN gradients in
+    one functor (sgd_updater-inl.hpp:15-22)."""
+    import jax.numpy as _jnp
+    import numpy as _np
+    from cxxnet_tpu.updater.updaters import UpdaterHyper, _sgd_leaf
+    h = UpdaterHyper(tag='wmat')
+    h.set_param('clip_gradient', '1.0')
+    h.set_param('wd', '0')
+    g = _jnp.asarray([10.0, _np.nan, -5.0, 0.5])
+    w = _jnp.zeros(4)
+    m = _jnp.zeros(4)
+    w_new, _m_new = _sgd_leaf(w, g, m, lr=1.0, mom=0.0, h=h)
+    _np.testing.assert_allclose(_np.asarray(w_new),
+                                [-1.0, 0.0, 1.0, -0.5], atol=1e-7)
+    # clip_gradient = 0 (default): NaNs pass through untouched
+    h0 = UpdaterHyper(tag='wmat')
+    h0.set_param('wd', '0')
+    w_raw, _ = _sgd_leaf(w, g, m, lr=1.0, mom=0.0, h=h0)
+    assert _np.isnan(_np.asarray(w_raw)[1])
