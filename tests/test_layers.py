@@ -314,3 +314,18 @@ def test_insanity_pooling_eval_is_max_train_jitters_within_input():
     _, _, outs_t = run_layer(layer, [NodeSpec(3, 4, 4)], [x], is_train=True)
     assert np.all(np.isin(np.round(outs_t[0], 5), np.round(x, 5))), \
         'train outputs must be actual input values'
+
+
+def test_pairtest_reports_mismatch(capsys):
+    """The differential harness must actually fire: a pairtest of two
+    layers that disagree (relu vs sigmoid) reports the relative error
+    (pairtest_layer-inl.hpp:75-118 prints mismatches; we keep that
+    report-don't-abort contract)."""
+    import jax
+    x = np.random.RandomState(11).randn(2, 6).astype(np.float32)
+    layer = make_layer('pairtest-relu-sigmoid')
+    _, _, outs = run_layer(layer, [NodeSpec(1, 1, 6)], [x])
+    jax.effects_barrier()
+    assert 'MISMATCH' in capsys.readouterr().out
+    # master's output is what flows on (relu here)
+    np.testing.assert_allclose(outs[0], np.maximum(x, 0), rtol=1e-6)
