@@ -354,3 +354,16 @@ def test_adam_updater_matches_reference_math():
     assert _np.asarray(m1n) == pytest.approx(m1_ref, rel=1e-6)
     assert _np.asarray(m2n) == pytest.approx(m2_ref, rel=1e-6)
     assert _np.asarray(w2) == pytest.approx(w_ref, rel=1e-6)
+
+
+def test_lr_constant_and_start_epoch_hold():
+    """The two schedule behaviors TestSchedules doesn't pin: the constant
+    schedule, and lr:start_epoch holding the base LR until the start
+    epoch is reached (updater/param.h:89-92)."""
+    import numpy as _np
+    lr, _ = _hyper(eta=0.1).schedule(250)
+    assert _np.asarray(lr) == pytest.approx(0.1)
+    h = _hyper(eta=0.1, **{'lr:schedule': 'expdecay', 'lr:gamma': 0.5,
+                           'lr:step': 100, 'lr:start_epoch': 500})
+    lr, _ = h.schedule(250)
+    assert _np.asarray(lr) == pytest.approx(0.1)    # held at base before
