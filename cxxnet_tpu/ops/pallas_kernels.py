@@ -329,6 +329,15 @@ def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref):
         o_ref[:] = acc_ref[:].astype(o_ref.dtype)
 
 
+# Measured-winning forward tile config (receipts/micro_matmul_tiles.log,
+# TPU v5 lite, bf16): at fc6's 256x9216x4096 the (256, 1024, 512) tiling
+# ran 172.6 TF/s vs XLA's 151.0 — 1.143x, the first Pallas matmul win at
+# a production shape.  Not the default (the sweep was cut off by a
+# tunnel drop before covering fc7; the training path's bwd kernels still
+# lose) — callers opt in via _matmul_impl(a, b, *MATMUL_TILES_WIDE_N).
+MATMUL_TILES_WIDE_N = (256, 1024, 512)
+
+
 @jax.custom_vjp
 def pallas_matmul(a, b):
     """(m, k) @ (k, n) with an MXU-tiled Pallas kernel; differentiable

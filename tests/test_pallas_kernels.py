@@ -267,3 +267,16 @@ def test_lrn_auto_gate_scoped_to_single_device(monkeypatch):
     monkeypatch.setenv('CXXNET_PALLAS', '1')
     assert pk.lrn_auto_mode(256, spmd_devices=8) == 'full'
     assert ForwardContext(is_train=False).spmd_devices == 1
+
+
+def test_matmul_wide_n_preset_numerics():
+    """The measured-winning fc6 tile preset (MATMUL_TILES_WIDE_N,
+    receipts/micro_matmul_tiles.log) must be numerically identical to the
+    default tiling — it is a pure schedule change."""
+    from cxxnet_tpu.ops import pallas_kernels as pk
+    rng = np.random.RandomState(6)
+    a = jnp.asarray(rng.randn(64, 192).astype(np.float32))
+    b = jnp.asarray(rng.randn(192, 96).astype(np.float32))
+    out = pk._matmul_impl(a, b, *pk.MATMUL_TILES_WIDE_N)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(a) @ np.asarray(b),
+                               rtol=1e-4, atol=1e-4)
