@@ -1,4 +1,6 @@
-#!/bin/sh
+#!/bin/bash
+# bash, not sh: the tunnel probe uses /dev/tcp, a bash-ism (dash fails it
+# unconditionally, which leaves the watcher polling forever on a live chip).
 # Remaining r4 chip work, gated on tunnel health: the axon tunnel died
 # mid-suite a second time (16:05 UTC, after the 06:30-15:39 outage), so
 # this script polls until the chip answers and then runs every step the

@@ -1,4 +1,5 @@
-#!/bin/sh
+#!/bin/bash
+# bash, not sh: the tunnel probe uses /dev/tcp, a bash-ism.
 # Chained behind run_chip_remaining.sh (which predates the transformer
 # bench mode): waits for that runner to drain and the tunnel to answer,
 # then lands the TransformerLM tokens/sec receipt.
