@@ -20,18 +20,35 @@ from ..utils.thread_buffer import ThreadBuffer
 ConfigEntry = Tuple[str, str]
 
 
+class NormSpec:
+    """Deferred input normalization: what the augment stage would have done
+    on host ((x - mean) * scale, ``iter_augment_proc-inl.hpp:199-231``),
+    carried alongside a raw uint8 batch so the jitted step applies it on
+    device instead.  TPU-side redesign: the reference always ships float32
+    to the device; shipping the decoded uint8 halves H2D bytes and skips
+    the per-batch host cast (see ``device_normalize`` in iter_augment)."""
+
+    __slots__ = ('mean_img', 'mean_vals', 'scale')
+
+    def __init__(self, mean_img=None, mean_vals=None, scale=1.0):
+        self.mean_img = mean_img            # (c, y, x) float32 or None
+        self.mean_vals = mean_vals          # (c,) float32 or None
+        self.scale = float(scale)
+
+
 class DataBatch:
     """One minibatch (``src/io/data.h:83-181``)."""
 
     __slots__ = ('data', 'label', 'inst_index', 'num_batch_padd',
-                 'pad_synthetic', 'extra_data')
+                 'pad_synthetic', 'extra_data', 'norm_spec')
 
     def __init__(self, data: np.ndarray, label: np.ndarray,
                  inst_index: Optional[np.ndarray] = None,
                  num_batch_padd: int = 0,
                  extra_data: Optional[List[np.ndarray]] = None,
-                 pad_synthetic: bool = False):
-        self.data = data                    # (b, c, y, x) float32
+                 pad_synthetic: bool = False,
+                 norm_spec: Optional[NormSpec] = None):
+        self.data = data                    # (b, c, y, x) float32, or uint8
         self.label = label                  # (b, label_width) float32
         self.inst_index = inst_index        # (b,) uint32 or None
         self.num_batch_padd = num_batch_padd
@@ -40,6 +57,9 @@ class DataBatch:
         # instances (round_batch=1) that the reference trains on
         self.pad_synthetic = pad_synthetic
         self.extra_data = extra_data or []
+        # set when data is raw uint8 and the trainer must normalize on
+        # device (device_normalize=1)
+        self.norm_spec = norm_spec
 
     @property
     def batch_size(self) -> int:
@@ -68,6 +88,12 @@ class IIterator:
 
     def init(self) -> None:
         pass
+
+    def get_norm_spec(self) -> Optional[NormSpec]:
+        """The deferred-normalization spec of the augment stage in this
+        chain, or None.  Wrappers delegate to their wrapped iterator."""
+        base = getattr(self, 'base', None)
+        return base.get_norm_spec() if base is not None else None
 
     def __iter__(self) -> Iterator:
         raise NotImplementedError

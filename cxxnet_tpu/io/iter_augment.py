@@ -120,6 +120,10 @@ class ImageAugmenter:
         Mrc = np.array([[M[1, 1], M[1, 0]], [M[0, 1], M[0, 0]]])
         inv = np.linalg.inv(Mrc)
         offset = -inv @ np.array([ty, tx])
+        # warp in float32: affine_transform returns the INPUT dtype when no
+        # output= is given, so uint8 sources would quantize interpolated
+        # pixels and wrap cubic-spline overshoot (e.g. -3 -> 253)
+        data = np.asarray(data, np.float32)
         out = np.empty((c, new_h, new_w), np.float32)
         for ch in range(c):
             out[ch] = ndimage.affine_transform(
@@ -144,8 +148,10 @@ class AugmentIterator(IIterator):
         self.max_random_contrast = 0.0
         self.max_random_illumination = 0.0
         self.seed_data = 0
+        self.device_normalize = 0
         self.aug = ImageAugmenter()
         self._meanimg = None
+        self._warned_dev_norm = False
 
     def set_param(self, name, val):
         self.base.set_param(name, val)
@@ -179,6 +185,8 @@ class AugmentIterator(IIterator):
         if name == 'mean_value':
             self.mean_vals = np.asarray(
                 [float(t) for t in val.split(',')], np.float32)
+        if name == 'device_normalize':
+            self.device_normalize = int(val)
 
     def init(self):
         self.base.init()
@@ -219,13 +227,50 @@ class AugmentIterator(IIterator):
                 crop = crop[:, :, ::-1]
             yield inst, crop
 
+    def _device_norm_active(self) -> bool:
+        """uint8-through mode: crop/mirror on host, (x-mean)*scale deferred
+        to the device step (NormSpec).  Random contrast/illumination are
+        per-instance host-RNG draws baked into the pixels, so they force
+        the host path."""
+        if not self.device_normalize:
+            return False
+        c, ty, tx = self.shape
+        if ty == 1 and c == 1:
+            return False                    # flat input: host scale only
+        if self.max_random_contrast > 0 or self.max_random_illumination > 0:
+            if not self._warned_dev_norm and self.silent == 0:
+                print('device_normalize=1 ignored: random contrast/'
+                      'illumination require the host normalize path')
+                self._warned_dev_norm = True
+            return False
+        return True
+
+    def get_norm_spec(self):
+        if not self._device_norm_active():
+            return None
+        from .data import NormSpec
+        # host-path quirk preserved: a mean image whose shape mismatches
+        # the input is silently skipped (see __iter__), so the deferred
+        # spec must drop it too rather than crash the jitted broadcast
+        mean_img = self._meanimg
+        if mean_img is not None and tuple(mean_img.shape) != self.shape:
+            mean_img = None
+        return NormSpec(mean_img=mean_img, mean_vals=self.mean_vals,
+                        scale=self.scale)
+
     def __iter__(self):
+        if self._device_norm_active():
+            # raw crops go to the device untouched; normalization happens
+            # inside the jitted step (trainer._norm_input)
+            yield from self._raw_iter_insts()
+            return
         rng = np.random.RandomState(self.seed_data + 91)
         c, ty, tx = self.shape
         for inst, crop in self._raw_iter():
             if ty == 1 and c == 1:
-                yield DataInst(inst.index, crop * self.scale, inst.label,
-                               inst.extra_data)
+                yield DataInst(inst.index,
+                               np.asarray(crop, np.float32) * self.scale,
+                               inst.label, inst.extra_data)
                 continue
             contrast = 1.0
             illum = 0.0
@@ -243,6 +288,13 @@ class AugmentIterator(IIterator):
                     out = out - self._meanimg
             out = (out * contrast + illum) * self.scale
             yield DataInst(inst.index, out, inst.label, inst.extra_data)
+
+    def _raw_iter_insts(self):
+        """Device-normalize path: instances with the raw (typically uint8)
+        crop; rand-crop/mirror RNG sequence identical to ``_raw_iter``."""
+        for inst, crop in self._raw_iter():
+            yield DataInst(inst.index, np.ascontiguousarray(crop),
+                           inst.label, inst.extra_data)
 
     def _create_mean_img(self):
         if self.silent == 0:

@@ -23,6 +23,7 @@ class BatchAdaptIterator(IIterator):
         self.test_skipread = 0
         self.label_width = 1
         self._cached: DataBatch | None = None
+        self._norm_spec = None
 
     def set_param(self, name, val):
         if name == 'batch_size':
@@ -37,10 +38,14 @@ class BatchAdaptIterator(IIterator):
 
     def init(self):
         self.base.init()
+        self._norm_spec = self.base.get_norm_spec()
 
     def _make_batch(self, insts):
-        bs = len(insts)
-        data = np.stack([i.data for i in insts]).astype(np.float32)
+        data = np.stack([i.data for i in insts])
+        if not (data.dtype == np.uint8 and self._norm_spec is not None):
+            # reference host contract: float32 batches
+            # (device_normalize keeps the decoded uint8 on the wire)
+            data = data.astype(np.float32)
         label = np.stack([np.atleast_1d(i.label) for i in insts]).astype(np.float32)
         index = np.asarray([i.index for i in insts], dtype=np.uint32)
         return data, label, index
@@ -56,7 +61,8 @@ class BatchAdaptIterator(IIterator):
             buf.append(inst)
             if len(buf) == bs:
                 data, label, index = self._make_batch(buf)
-                batch = DataBatch(data, label, index)
+                batch = DataBatch(data, label, index,
+                                  norm_spec=self._norm_spec)
                 if self.test_skipread and self._cached is None:
                     self._cached = batch
                 yield batch
@@ -76,7 +82,8 @@ class BatchAdaptIterator(IIterator):
                 if not took:
                     raise RuntimeError('round_batch: source is empty')
             data, label, index = self._make_batch(buf + wrap)
-            yield DataBatch(data, label, index, num_batch_padd=npadd)
+            yield DataBatch(data, label, index, num_batch_padd=npadd,
+                            norm_spec=self._norm_spec)
         elif buf:
             # round_batch=0: emit the short final batch padded to full size
             # with num_batch_padd = batch_size - top
@@ -88,4 +95,4 @@ class BatchAdaptIterator(IIterator):
             npadd = bs - len(buf)
             data, label, index = self._make_batch(buf + [buf[-1]] * npadd)
             yield DataBatch(data, label, index, num_batch_padd=npadd,
-                            pad_synthetic=True)
+                            pad_synthetic=True, norm_spec=self._norm_spec)

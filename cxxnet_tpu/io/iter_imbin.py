@@ -134,7 +134,9 @@ class ImageBinIterator(IIterator):
             from PIL import Image
             with Image.open(io.BytesIO(blob)) as im:
                 arr = np.asarray(im.convert('RGB'), np.uint8)
-        return np.transpose(arr.astype(np.float32), (2, 0, 1))
+        # keep the decoded uint8: the augment stage owns the float32
+        # conversion (host path) or defers it to device (device_normalize)
+        return np.transpose(arr, (2, 0, 1))
 
     def _load_lines(self, part):
         with open(self._lists[part]) as f:

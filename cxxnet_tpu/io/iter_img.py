@@ -2,7 +2,9 @@
 
 Reads a ``.lst`` file (``index \\t label[ \\t label...] \\t filename``) and
 decodes one image per instance (PIL replaces OpenCV), yielding ``(3, h, w)``
-float32 pixel data in 0-255 range, channels in the tensor order the
+uint8 pixel data in 0-255 range (the augment stage owns the float32
+conversion — host normalize path — or defers it to the device under
+``device_normalize=1``), channels in the tensor order the
 reference produces, with labels of ``label_width`` columns.
 """
 
@@ -18,7 +20,8 @@ from .data import DataInst, IIterator
 def load_image_chw(path: str) -> np.ndarray:
     from PIL import Image
     with Image.open(path) as im:
-        arr = np.asarray(im.convert('RGB'), dtype=np.float32)
+        # uint8 through: the augment stage owns the float32 conversion
+        arr = np.asarray(im.convert('RGB'), dtype=np.uint8)
     return np.transpose(arr, (2, 0, 1))          # (3, h, w)
 
 

@@ -47,6 +47,20 @@ from .net_config import NetConfig
 ConfigEntry = Tuple[str, str]
 
 
+def _apply_input_norm(data, norm):
+    """Device-side input normalization for raw uint8 batches
+    (``device_normalize=1``): the augment stage's ``(x - mean) * scale``
+    (``iter_augment_proc-inl.hpp:199-231``) applied inside the jitted
+    step.  ``norm`` is ``()`` (host already normalized — no-op) or a
+    ``(mean, scale)`` pair of device arrays; the pytree structure keys
+    the jit cache, so the two paths compile separately.  f32 math before
+    the net's compute-dtype cast, same rounding order as the host path."""
+    if not norm:
+        return data
+    mean, scale = norm
+    return (data.astype(jnp.float32) - mean) * scale
+
+
 def parse_devices(val: str) -> List[int]:
     """Parse ``dev = tpu:0-3`` / ``dev = gpu:0,2`` / ``dev = cpu``
     (``nnet_impl-inl.hpp:31-55``).  Device ordinals index ``jax.devices()``;
@@ -92,6 +106,7 @@ class NetTrainer:
         self._forward_fn = None
         self._pending_train_eval = None
         self._ones_mask_cache: Dict[int, object] = {}
+        self._norm_dev = {}        # per-spec staged (mean, scale) consts
         if cfg:
             for name, val in cfg:
                 self.set_param(name, val)
@@ -208,6 +223,34 @@ class NetTrainer:
         self.opt_state = {k: put(v) for k, v in opt.items()}
         self.grad_acc = put(jax.tree.map(jnp.zeros_like, self.params))
 
+    def _norm_args(self, batch):
+        """Device constants for a deferred-normalization batch: ``()`` when
+        none needed (host-normalized float32, or raw uint8 bench data with
+        no spec).  Keyed on the spec alone — raw data is usually uint8 but
+        an active affine warp yields raw float32, which still needs the
+        deferred (x-mean)*scale.  Built once — the spec is chain-constant."""
+        spec = getattr(batch, 'norm_spec', None)
+        if spec is None:
+            return ()
+        cached = self._norm_dev.get(id(spec))
+        if cached is not None and cached[0] is spec:
+            return cached[1]
+        # host-path priority: per-channel mean_value wins over a mean
+        # image when both are configured (iter_augment __iter__ order)
+        if spec.mean_vals is not None:
+            mean = np.asarray(spec.mean_vals, np.float32)[:, None, None]
+        elif spec.mean_img is not None:
+            mean = np.asarray(spec.mean_img, np.float32)
+        else:
+            mean = np.zeros((1, 1, 1), np.float32)
+        sh = replicated_sharding(self._mesh)
+        consts = (jax.device_put(jnp.asarray(mean), sh),
+                  jax.device_put(jnp.float32(spec.scale), sh))
+        # keyed per spec instance (train and eval chains may normalize
+        # differently); the spec ref pins the id against reuse
+        self._norm_dev[id(spec)] = (spec, consts)
+        return consts
+
     def _shard_batch(self, data: np.ndarray, cast: bool = True):
         data = np.asarray(data)
         if data.dtype == np.float64:
@@ -228,7 +271,8 @@ class NetTrainer:
         max_round = self.max_round
         spmd = self._mesh.devices.size
 
-        def loss_fn(params, data, label, extra, mask, rng, rnd):
+        def loss_fn(params, data, label, extra, mask, rng, rnd, norm=()):
+            data = _apply_input_norm(data, norm)
             ctx = ForwardContext(is_train=True, rng=rng, round=rnd,
                                  max_round=max_round,
                                  compute_dtype=compute_dtype,
@@ -249,10 +293,10 @@ class NetTrainer:
 
         @partial(jax.jit, static_argnames=('do_update',), donate_argnums=(0, 1, 2))
         def train_step(params, opt_state, grad_acc, data, label, extra, mask,
-                       rng, epoch, rnd, do_update):
+                       rng, epoch, rnd, do_update, norm=()):
             (loss, evals), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, data, label, extra, mask,
-                                       rng, rnd)
+                                       rng, rnd, norm)
             if nan_skip:
                 # failure detection beyond the reference's NaN-zeroing clip
                 # (sgd_updater-inl.hpp:15-22): a non-finite loss — or a
@@ -277,7 +321,8 @@ class NetTrainer:
         spmd = self._mesh.devices.size
 
         @jax.jit
-        def forward_step(params, data, extra, rnd):
+        def forward_step(params, data, extra, rnd, norm=()):
+            data = _apply_input_norm(data, norm)
             ctx = ForwardContext(is_train=False, rng=None, round=rnd,
                                  max_round=max_round,
                                  compute_dtype=compute_dtype,
@@ -321,7 +366,7 @@ class NetTrainer:
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def multi_step(params, opt_state, data_stack, label_stack, rng0,
-                       epoch0, mask_stack, rnd):
+                       epoch0, mask_stack, rnd, norm=()):
             nstack = data_stack.shape[0]
 
             def body(carry, t):
@@ -335,7 +380,7 @@ class NetTrainer:
                 rng = jax.random.fold_in(rng0, t)
                 (loss, _), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params, data, label, (), mask,
-                                           rng, rnd)
+                                           rng, rnd, norm)
                 if nan_skip:
                     ok = jnp.isfinite(loss)
                     for g in jax.tree.leaves(grads):
@@ -351,9 +396,9 @@ class NetTrainer:
             return params, opt_state, losses[-1]
 
         def multi_fn(params, opt_state, data_stack, label_stack, rng0,
-                     epoch0, mask_stack, rnd):
+                     epoch0, mask_stack, rnd, norm=()):
             return multi_step(params, opt_state, data_stack, label_stack,
-                              rng0, epoch0, mask_stack, rnd)
+                              rng0, epoch0, mask_stack, rnd, norm)
 
         multi_fn.n_steps = n_steps
         return multi_fn
@@ -372,14 +417,19 @@ class NetTrainer:
         return jax.device_put(jnp.asarray(stack), sh)
 
     def update_n_on_device(self, multi_fn, data_stack, label_stack,
-                           n_steps: int = None, mask_stack=None):
+                           n_steps: int = None, mask_stack=None, norm=()):
         """Run a :meth:`compile_multi_step` function over pre-staged stacks,
         keeping epoch/sample counters coherent.  ``n_steps`` defaults to —
         and must match — the step count baked into ``multi_fn`` at compile
         time, so the counters can never desynchronize from the steps
         actually executed.  ``mask_stack`` (nstack, batch) defaults to
-        all-ones (no tail-batch pads).  Returns the last loss (device
-        scalar — fetching it is a real completion barrier)."""
+        all-ones (no tail-batch pads).  ``norm``: stacks of RAW (un-
+        normalized) pixels from a ``device_normalize=1`` chain need the
+        deferred (mean, scale) device constants — pass
+        ``trainer._norm_args(batch)`` of any batch carrying the chain's
+        spec; the default () means the stack is already normalized.
+        Returns the last loss (device scalar — fetching it is a real
+        completion barrier)."""
         compiled = getattr(multi_fn, 'n_steps', None)
         if n_steps is None:
             n_steps = compiled
@@ -393,7 +443,7 @@ class NetTrainer:
                                   self.round)
         self.params, self.opt_state, loss = multi_fn(
             self.params, self.opt_state, data_stack, label_stack, rng0,
-            self.epoch_counter, mask_stack, self.round)
+            self.epoch_counter, mask_stack, self.round, norm)
         self.epoch_counter += n_steps
         self.sample_counter += n_steps
         return loss
@@ -457,7 +507,12 @@ class NetTrainer:
         :meth:`update_staged`).  Returns an opaque handle for
         :meth:`update_staged`.  Safe because the batch adapters allocate
         fresh arrays per batch (io/iter_batch.py)."""
-        data = self._shard_batch(batch.data)
+        norm = self._norm_args(batch)
+        # raw (uncentered) pixels must not be pre-cast to bf16: values
+        # ~128 lose ~0.4% relative each, which mean-subtraction amplifies
+        # ~100x.  uint8 ships as-is; raw f32 (affine path) ships f32 and
+        # is centered on device before any compute-dtype cast.
+        data = self._shard_batch(batch.data, cast=not norm)
         label = self._shard_batch(batch.label, cast=False)
         extra = tuple(self._shard_batch(e) for e in batch.extra_data)
         # synthetic pad rows of a short tail batch (round_batch=0) carry
@@ -475,7 +530,7 @@ class NetTrainer:
         host_label = (np.asarray(batch.label)
                       if self.eval_train and len(self.train_metric) else None)
         return (data, label, extra, mask, host_label, bs,
-                batch.num_batch_padd)
+                batch.num_batch_padd, norm)
 
     def update(self, batch) -> None:
         """One minibatch through forward/backward/(maybe) update —
@@ -485,7 +540,8 @@ class NetTrainer:
     def update_staged(self, staged) -> None:
         """Dispatch the training step for a batch staged by
         :meth:`stage_batch`."""
-        data, label, extra, mask, host_label, bs, num_batch_padd = staged
+        (data, label, extra, mask, host_label, bs, num_batch_padd,
+         norm) = staged
         do_update = (self.sample_counter + 1) % self.update_period == 0
         rng = jax.random.fold_in(self._rng, 1 + self.sample_counter * 131 +
                                  self.round)
@@ -495,7 +551,7 @@ class NetTrainer:
             self._train_step_fn(self.params, self.opt_state, self.grad_acc,
                                 data, label, extra, mask, rng,
                                 self.epoch_counter, self.round,
-                                do_update=do_update)
+                                do_update=do_update, norm=norm)
         if host_label is not None:
             # defer this step's metric readback one step: by the next
             # update() (or evaluate()) the values are already on host, so
@@ -537,10 +593,12 @@ class NetTrainer:
         self.train_metric.add_eval(
             [np.asarray(e)[:n] for e in evals], label_info.slice(n))
 
-    def update_on_device(self, data, label) -> None:
+    def update_on_device(self, data, label, norm=()) -> None:
         """One training step over batches already resident on device (jax
         arrays with the right shardings).  Used by benchmarks and by data
-        pipelines that pre-stage batches to hide host->device latency."""
+        pipelines that pre-stage batches to hide host->device latency.
+        ``norm``: required (as from :meth:`_norm_args`) when ``data`` is
+        RAW pixels from a ``device_normalize=1`` chain."""
         do_update = (self.sample_counter + 1) % self.update_period == 0
         rng = jax.random.fold_in(self._rng, 1 + self.sample_counter * 131 +
                                  self.round)
@@ -548,7 +606,7 @@ class NetTrainer:
             self._train_step_fn(self.params, self.opt_state, self.grad_acc,
                                 data, label, (), None, rng,
                                 self.epoch_counter, self.round,
-                                do_update=do_update)
+                                do_update=do_update, norm=norm)
         if do_update:
             self.epoch_counter += 1
         self.sample_counter += 1
@@ -575,8 +633,12 @@ class NetTrainer:
     def _forward_nodes_async(self, batch, node_ids: List[int]):
         """Launch the forward pass; returns device arrays (no readback)."""
         extra = tuple(self._shard_batch(e) for e in batch.extra_data)
-        values = self._forward_fn(self.params, self._shard_batch(batch.data),
-                                  extra, self.round)
+        norm = self._norm_args(batch)
+        # raw uncentered pixels: same no-bf16-precast rule as stage_batch
+        values = self._forward_fn(self.params,
+                                  self._shard_batch(batch.data,
+                                                    cast=not norm),
+                                  extra, self.round, norm=norm)
         return [values[i] for i in node_ids]
 
     def _forward_nodes(self, batch, node_ids: List[int]) -> List[np.ndarray]:

@@ -1,0 +1,232 @@
+"""device_normalize=1: decoded uint8 stays on the wire and the augment
+stage's (x - mean) * scale (``iter_augment_proc-inl.hpp:199-231``) runs
+inside the jitted step instead of per-instance on host.
+
+Beyond-reference TPU redesign: the reference always ships float32 batches
+to the device (``nnet_impl-inl.hpp:141-185`` Copy of a host float batch);
+shipping uint8 halves H2D bytes and removes the host-side cast, which the
+e2e receipt showed dominating the wall on a slow host link.  These tests
+pin the contract: the deferred path must produce the SAME f32 pixels the
+host path produces, through train, eval and predict.
+"""
+
+import numpy as np
+
+from cxxnet_tpu.io.data import create_iterator
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+from test_io import make_img_dataset
+
+CONV_CONF = """
+netconfig=start
+layer[+1] = conv:cv1
+  kernel_size = 3
+  stride = 1
+  nchannel = 4
+  init_sigma = 0.05
+layer[+1] = relu:rl1
+layer[+1] = flatten:fl1
+layer[+1] = fullc:fc1
+  nhidden = 3
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,16,16
+batch_size = 4
+dev = cpu
+eta = 0.1
+momentum = 0.9
+metric[label] = error
+"""
+
+
+def _chain(lst, root, dev_norm, extra=()):
+    cfg = [('iter', 'img'), ('image_list', lst), ('image_root', root),
+           ('input_shape', '3,16,16'), ('batch_size', '4'),
+           ('round_batch', '1'), ('silent', '1'),
+           ('mean_value', '120,118,122'), ('scale', '0.0078125')]
+    cfg += list(extra)
+    if dev_norm:
+        cfg.append(('device_normalize', '1'))
+    it = create_iterator(cfg)
+    it.init()
+    return it
+
+
+def test_uint8_wire_and_spec_math(tmp_path):
+    """Deferred batches are uint8 + spec; applying the spec on host
+    reproduces the host-normalized f32 pixels exactly."""
+    lst = make_img_dataset(str(tmp_path))
+    dev_batches = list(_chain(lst, str(tmp_path), True))
+    host_batches = list(_chain(lst, str(tmp_path), False))
+    assert len(dev_batches) == len(host_batches) == 3
+    spec = dev_batches[0].norm_spec
+    assert spec is not None and spec.mean_vals is not None
+    assert spec.scale == 0.0078125
+    for db, hb in zip(dev_batches, host_batches):
+        assert db.data.dtype == np.uint8
+        assert hb.data.dtype == np.float32
+        assert hb.norm_spec is None
+        applied = (db.data.astype(np.float32)
+                   - spec.mean_vals[:, None, None]) * spec.scale
+        np.testing.assert_allclose(applied, hb.data, rtol=0, atol=1e-6)
+        np.testing.assert_array_equal(db.label, hb.label)
+
+
+def test_random_contrast_forces_host_path(tmp_path):
+    """Per-instance contrast/illumination draws bake host RNG into the
+    pixels, so device_normalize must fall back to the host path."""
+    lst = make_img_dataset(str(tmp_path))
+    it = _chain(lst, str(tmp_path), True,
+                extra=[('max_random_contrast', '0.2')])
+    b = next(iter(it))
+    assert b.data.dtype == np.float32
+    assert b.norm_spec is None
+
+
+def test_train_eval_predict_equivalence(tmp_path):
+    """Same data through host-normalize and device-normalize chains:
+    identical training trajectory, eval metrics, and predictions
+    (f32 CPU — exact up to float associativity)."""
+    lst = make_img_dataset(str(tmp_path))
+
+    def run(dev_norm):
+        trainer = NetTrainer(parse_config_string(CONV_CONF))
+        trainer.init_model()
+        batches = list(_chain(lst, str(tmp_path), dev_norm))
+        for b in batches:
+            trainer.update(b)
+        ev = trainer.evaluate(iter(batches), 'x')
+        preds = np.concatenate([trainer.predict(b) for b in batches])
+        params = {k: {f: np.asarray(v) for f, v in layer.items()}
+                  for k, layer in trainer.params.items()}
+        return ev, preds, params
+
+    ev_h, preds_h, params_h = run(False)
+    ev_d, preds_d, params_d = run(True)
+    assert ev_d == ev_h
+    np.testing.assert_array_equal(preds_d, preds_h)
+    for k in params_h:
+        for f in params_h[k]:
+            np.testing.assert_allclose(params_d[k][f], params_h[k][f],
+                                       rtol=1e-5, atol=1e-7)
+
+
+def test_affine_warp_uint8_matches_float32():
+    """The affine warp must compute in float32 regardless of source dtype:
+    uint8 input would quantize interpolated pixels and wrap cubic-spline
+    overshoot (review finding on the uint8-at-source change)."""
+    from cxxnet_tpu.io.iter_augment import ImageAugmenter
+    aug = ImageAugmenter()
+    aug.set_param('rotate', '30')
+    aug.set_param('max_rotate_angle', '30')
+    rng_img = np.random.RandomState(0)
+    img_u8 = rng_img.randint(0, 255, (3, 20, 20)).astype(np.uint8)
+    out_u8 = aug.process(img_u8, np.random.RandomState(1), 20, 20)
+    out_f32 = aug.process(img_u8.astype(np.float32),
+                          np.random.RandomState(1), 20, 20)
+    assert out_u8.dtype == np.float32
+    np.testing.assert_allclose(out_u8, out_f32, rtol=0, atol=1e-4)
+
+
+def test_mean_image_shape_mismatch_skipped(tmp_path):
+    """Host path silently skips a mean image whose shape mismatches the
+    input; the deferred spec must drop it the same way (not crash the
+    jitted broadcast)."""
+    from cxxnet_tpu.io.iter_augment import AugmentIterator, _save_mean
+    lst = make_img_dataset(str(tmp_path))
+    mean_path = str(tmp_path / 'wrong_mean.bin')
+    _save_mean(mean_path, np.zeros((3, 8, 8), np.float32))
+    cfg = [('iter', 'img'), ('image_list', lst),
+           ('image_root', str(tmp_path)),
+           ('input_shape', '3,16,16'), ('batch_size', '4'),
+           ('round_batch', '1'), ('silent', '1'),
+           ('image_mean', mean_path), ('device_normalize', '1')]
+    it = create_iterator(cfg)
+    it.init()
+    b = next(iter(it))
+    assert b.data.dtype == np.uint8
+    assert b.norm_spec is not None
+    assert b.norm_spec.mean_img is None     # mismatched -> skipped, as host
+
+
+def test_per_spec_norm_constants(tmp_path):
+    """Train and eval chains may normalize differently: the trainer's
+    device constants are keyed per spec, not cached once."""
+    from cxxnet_tpu.io.data import DataBatch, NormSpec
+    trainer = NetTrainer(parse_config_string(CONV_CONF))
+    trainer.init_model()
+    data = np.zeros((4, 3, 16, 16), np.uint8)
+    label = np.zeros((4, 1), np.float32)
+    spec_a = NormSpec(mean_vals=np.asarray([1., 2., 3.], np.float32),
+                      scale=0.5)
+    spec_b = NormSpec(mean_vals=np.asarray([9., 9., 9.], np.float32),
+                      scale=0.25)
+    norm_a = trainer._norm_args(DataBatch(data, label, norm_spec=spec_a))
+    norm_b = trainer._norm_args(DataBatch(data, label, norm_spec=spec_b))
+    np.testing.assert_allclose(np.asarray(norm_a[0]).ravel(), [1., 2., 3.])
+    np.testing.assert_allclose(np.asarray(norm_b[0]).ravel(), [9., 9., 9.])
+    assert float(norm_a[1]) == 0.5 and float(norm_b[1]) == 0.25
+    # cached per spec instance
+    assert trainer._norm_args(
+        DataBatch(data, label, norm_spec=spec_a))[1] is norm_a[1]
+
+
+def test_multi_step_applies_norm(tmp_path):
+    """compile_multi_step / update_n_on_device must apply the deferred
+    normalization to raw stacks — a raw uint8 stack with the norm consts
+    must land on the same params as pre-normalized f32 steps."""
+    lst = make_img_dataset(str(tmp_path))
+    dev_batches = list(_chain(lst, str(tmp_path), True))
+    host_batches = list(_chain(lst, str(tmp_path), False))
+    spec = dev_batches[0].norm_spec
+
+    def snap(trainer):
+        return {k: {f: np.asarray(v) for f, v in layer.items()}
+                for k, layer in trainer.params.items()}
+
+    # reference trajectory: per-batch updates on the host-normalized data
+    t_ref = NetTrainer(parse_config_string(CONV_CONF))
+    t_ref.init_model()
+    for b in host_batches[:2]:
+        t_ref.update(b)
+    ref = snap(t_ref)
+
+    # multi-step trajectory: one dispatch over the raw uint8 stack + norm
+    t_dev = NetTrainer(parse_config_string(CONV_CONF))
+    t_dev.init_model()
+    stack = np.stack([b.data for b in dev_batches[:2]])
+    labels = np.stack([b.label for b in dev_batches[:2]])
+    multi_fn = t_dev.compile_multi_step(2)
+    norm = t_dev._norm_args(dev_batches[0])
+    t_dev.update_n_on_device(
+        multi_fn, t_dev.shard_batch_stack(stack),
+        t_dev.shard_batch_stack(labels, cast=False), norm=norm)
+    got = snap(t_dev)
+    for k in ref:
+        for f in ref[k]:
+            np.testing.assert_allclose(got[k][f], ref[k][f],
+                                       rtol=1e-5, atol=1e-7)
+
+
+def test_mean_image_spec(tmp_path):
+    """image_mean file: the spec carries the cached mean image and the
+    deferred math matches the host path."""
+    lst = make_img_dataset(str(tmp_path))
+    mean_path = str(tmp_path / 'mean.bin')
+    base = [('iter', 'img'), ('image_list', lst),
+            ('image_root', str(tmp_path)),
+            ('input_shape', '3,16,16'), ('batch_size', '4'),
+            ('round_batch', '1'), ('silent', '1'),
+            ('image_mean', mean_path)]
+    host_it = create_iterator(list(base))
+    host_it.init()          # builds + caches mean.bin
+    dev_it = create_iterator(base + [('device_normalize', '1')])
+    dev_it.init()
+    spec = next(iter(dev_it)).norm_spec
+    assert spec is not None and spec.mean_img is not None
+    assert spec.mean_img.shape == (3, 16, 16)
+    for db, hb in zip(dev_it, host_it):
+        applied = (db.data.astype(np.float32) - spec.mean_img) * spec.scale
+        np.testing.assert_allclose(applied, hb.data, rtol=0, atol=1e-5)
