@@ -1,0 +1,65 @@
+#!/bin/bash
+# Round-4 chip runner, second wave.  Differences from run_chip_remaining.sh
+# learned the hard way on this harness:
+#   * the tunnel gate runs BEFORE EVERY STEP, not once at launch — the
+#     axon tunnel drops for hours mid-suite, and a step launched into a
+#     dead tunnel hangs its whole timeout and produces nothing;
+#   * the probe lives in tools/tunnel_lib.sh (shared, bash-only /dev/tcp);
+#   * wall-clock-sensitive steps (mnist_tta time-to-accuracy, e2e link
+#     measurement) run FIRST and are marked in the driver log so the
+#     operator can keep the single host core idle during them; on-device
+#     quotient-timed steps follow (host contention cannot skew those);
+#   * every receipt is git-added and committed the moment it exists.
+set -x
+REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
+OUT=${OUT:-$REPO/receipts}
+mkdir -p "$OUT"
+cd "$REPO" || exit 1
+. tools/tunnel_lib.sh
+
+save() {
+    for p in "$@"; do
+        [ -e "$p" ] && git add "$p"
+    done
+    if ! git diff --cached --quiet -- "$@"; then
+        git commit -q -m "receipts: $(basename "$1" .json)" -- "$@" ||
+            echo "WARNING: receipts NOT committed: $*" >&2
+    fi
+}
+
+bench() {
+    wait_tunnel "$OUT/r4b.marker"
+    f="$OUT/$2"
+    env $3 timeout 2700 python bench.py "$1" > "$f" 2>"$OUT/$2.log" ||
+        [ -s "$f" ] || echo '{"metric":"'"$1"'","value":null,"error":"killed/timeout"}' > "$f"
+    save "$f" "$OUT/$2.log"
+}
+
+micro() {
+    wait_tunnel "$OUT/r4b.marker"
+    f="$OUT/micro_$1.json"
+    timeout 2400 python tools/pallas_microbench.py --only "$1" \
+        --json "$f" > "$OUT/micro_$1.log" 2>&1
+    save "$f" "$OUT/micro_$1.log"
+}
+
+breakdown() {    # $1 = model flag ('' = alexnet), $2 = receipt basename
+    wait_tunnel "$OUT/r4b.marker"
+    timeout 2700 python tools/alexnet_breakdown.py $1 \
+        --json "$OUT/$2.json" > "$OUT/$2.log" 2>&1
+    save "$OUT/$2.json" "$OUT/$2.log"
+}
+
+echo "=== WALL-CLOCK-SENSITIVE PHASE (keep host idle) ==="
+bench mnist_tta    bench_mnist_tta.json
+# e2e with the new uint8-wire path (default); separate receipt so the
+# committed host-normalize number (bench_e2e.json, 40.1 img/s) stays as
+# the A-side of the comparison
+bench e2e_alexnet  bench_e2e_devnorm.json
+echo "=== ON-DEVICE-TIMED PHASE (host work ok) ==="
+micro matmul_bwd
+breakdown ""                   alexnet_breakdown
+breakdown "--model googlenet"  googlenet_breakdown
+micro matmul_tiles
+bench transformer  bench_transformer.json
+echo "r4b suite done"

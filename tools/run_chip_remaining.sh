@@ -12,18 +12,10 @@ OUT=${OUT:-$REPO/receipts}
 mkdir -p "$OUT"
 cd "$REPO" || exit 1
 
-tunnel_up() {
-    # the port-8083 compile helper refusing connections is the reliable
-    # down-marker; confirm with a real device probe (which can hang when
-    # half-up, hence the timeout)
-    (echo > /dev/tcp/127.0.0.1/8083) 2>/dev/null || return 1
-    timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1
-}
+# probe shared with every chip watcher (bash-only /dev/tcp)
+. "$REPO/tools/tunnel_lib.sh"
 
-until tunnel_up; do
-    sleep 120
-done
-echo "tunnel up at $(date -u)" >> "$OUT/remaining_r4.marker"
+wait_tunnel "$OUT/remaining_r4.marker"
 
 save() {
     for p in "$@"; do
@@ -53,7 +45,10 @@ bench() {
 micro matmul_bwd
 bench mnist_tta    bench_mnist_tta.json
 bench alexnet      bench_alexnet_lrngate.json
-bench e2e_alexnet  bench_e2e.json
+# bench_e2e.json is the HOST-normalize A-side of the uint8-wire A/B
+# (bench.py defaults to CXXNET_E2E_DEVNORM=1 since the device_normalize
+# feature; the B-side lives in bench_e2e_devnorm.json via run_chip_r4b.sh)
+bench e2e_alexnet  bench_e2e.json  CXXNET_E2E_DEVNORM=0
 timeout 2700 python tools/alexnet_breakdown.py \
     --json "$OUT/alexnet_breakdown.json" > "$OUT/alexnet_breakdown.log" 2>&1
 save "$OUT/alexnet_breakdown.json" "$OUT/alexnet_breakdown.log"

@@ -8,13 +8,12 @@ REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
 OUT=${OUT:-$REPO/receipts}
 cd "$REPO" || exit 1
 
+. "$REPO/tools/tunnel_lib.sh"
+
 while pgrep -f run_chip_remaining.sh >/dev/null 2>&1; do
     sleep 120
 done
-until (echo > /dev/tcp/127.0.0.1/8083) 2>/dev/null &&
-      timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; do
-    sleep 120
-done
+wait_tunnel
 
 f="$OUT/bench_transformer.json"
 timeout 2700 python bench.py transformer > "$f" 2>"$OUT/bench_transformer.json.log" ||

@@ -1,0 +1,27 @@
+# Shared tunnel-health probe for the chip watcher scripts.  bash only:
+# the port probe is /dev/tcp, a bash-ism that fails unconditionally under
+# sh/dash (which once burned a whole round of polling — see
+# receipts/remaining_r4.log's correction note).  Source this file; do not
+# execute it.
+#
+# tunnel_up   — one probe: port-8083 compile helper answering AND a real
+#               device enumeration completing (can hang half-up, hence
+#               the timeout).
+# wait_tunnel — block until tunnel_up succeeds, logging the recovery
+#               time to $1 (a marker file) when given.
+
+tunnel_up() {
+    (echo > /dev/tcp/127.0.0.1/8083) 2>/dev/null || return 1
+    timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+wait_tunnel() {
+    local marker="$1" waited=0
+    until tunnel_up; do
+        sleep 120
+        waited=$((waited + 120))
+    done
+    if [ -n "$marker" ]; then
+        echo "tunnel up at $(date -u) (waited ~${waited}s)" >> "$marker"
+    fi
+}
