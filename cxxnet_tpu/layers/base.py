@@ -126,6 +126,10 @@ class LayerParam:
     silent: int = 0
     num_input_channel: int = 0
     num_input_node: int = 0
+    # conv MXU-lowering experiment knob (beyond reference):
+    # auto | native (lax.conv) | im2col (patches GEMM, shallow inputs) |
+    # split (per-group convs instead of feature_group_count)
+    conv_lowering: str = 'auto'
 
     def set_param(self, name: str, val: str) -> None:
         if name == 'init_sigma':
@@ -167,6 +171,10 @@ class LayerParam:
             self.silent = int(val)
         if name == 'temp_col_max':
             self.temp_col_max = int(val) << 18
+        if name == 'conv_lowering':
+            assert val in ('auto', 'native', 'im2col', 'split'), \
+                f'conv_lowering: unknown mode {val}'
+            self.conv_lowering = val
 
     def rand_init_weight(self, rng: jax.Array, shape: Tuple[int, ...],
                          in_num: int, out_num: int,

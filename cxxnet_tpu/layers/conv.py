@@ -21,6 +21,47 @@ from jax import lax
 
 from .base import Layer, NodeSpec, kConv, register_layer
 
+_DN = ('NHWC', 'HWIO', 'NHWC')
+
+
+def conv_native(x, w, strides, pad, groups=1):
+    """Plain lax.conv lowering; grouped via feature_group_count."""
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad,
+        dimension_numbers=_DN, feature_group_count=groups)
+
+
+def conv_im2col(x, w, strides, pad):
+    """Explicit patches->GEMM lowering: a shallow input (e.g. AlexNet
+    conv1's c=3) gives the native conv only a c-deep contraction per MXU
+    pass; the patch GEMM contracts kh*kw*c deep (363) at the cost of
+    materializing the column tensor — the reference's im2col
+    (``convolution_layer-inl.hpp:70-106``) reborn as an XLA-level
+    lowering choice.  Backward comes from AD: dW is a GEMM, dx flows
+    through the patch-extraction transpose (col2im)."""
+    kh, kw, _, cout = w.shape
+    pat = lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=strides,
+        padding=pad, dimension_numbers=_DN)
+    b, oy, ox, k = pat.shape
+    # patches feature order is (c, kh, kw)
+    w2 = jnp.transpose(w, (2, 0, 1, 3)).reshape(k, cout)
+    return (pat.reshape(b * oy * ox, k) @ w2).reshape(b, oy, ox, cout)
+
+
+def conv_split(x, w, strides, pad, groups):
+    """Per-group convs + concat instead of feature_group_count: lets XLA
+    pick each group's layout independently (grouped convs halve the
+    contraction depth per pass under fgc)."""
+    cin_g = x.shape[-1] // groups
+    cout_g = w.shape[-1] // groups
+    return jnp.concatenate([
+        lax.conv_general_dilated(
+            x[..., i * cin_g:(i + 1) * cin_g],
+            w[..., i * cout_g:(i + 1) * cout_g],
+            window_strides=strides, padding=pad, dimension_numbers=_DN)
+        for i in range(groups)], axis=-1)
+
 
 @register_layer
 class ConvolutionLayer(Layer):
@@ -38,6 +79,8 @@ class ConvolutionLayer(Layer):
             raise ValueError('conv: must set kernel_size correctly')
         if s.c % p.num_group or p.num_channel % p.num_group:
             raise ValueError('conv: channels must be divisible by ngroup')
+        if p.conv_lowering == 'im2col' and p.num_group != 1:
+            raise ValueError('conv_lowering=im2col requires ngroup=1')
         p.num_input_channel = s.c
         oy = (s.y + 2 * p.pad_y - p.kernel_height) // p.stride + 1
         ox = (s.x + 2 * p.pad_x - p.kernel_width) // p.stride + 1
@@ -58,18 +101,36 @@ class ConvolutionLayer(Layer):
             out['bias'] = jnp.full((p.num_channel,), p.init_bias, dtype)
         return out
 
+    def _lowering(self) -> str:
+        """Resolve the conv_lowering knob.  'auto' currently means native
+        for every shape — the im2col and split variants exist as measured
+        experiments (tools/conv_lowering_bench.py times THESE module
+        functions); auto flips per shape class only when an on-chip
+        receipt shows a win (same policy as
+        ops.pallas_kernels.lrn_auto_mode)."""
+        mode = self.param.conv_lowering
+        if mode == 'auto':
+            return 'native'
+        if mode == 'split' and self.param.num_group == 1:
+            return 'native'
+        return mode
+
     def forward(self, params, inputs, ctx):
         p = self.param
         x = inputs[0]  # (b, y, x, c)
         # operands share the activation dtype; the MXU accumulates in f32
         # internally for bf16 inputs, so no preferred_element_type needed
         # (which also trips the conv transpose rule on mixed cotangents)
-        out = lax.conv_general_dilated(
-            x, params['wmat'].astype(x.dtype),
-            window_strides=(p.stride, p.stride),
-            padding=((p.pad_y, p.pad_y), (p.pad_x, p.pad_x)),
-            dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
-            feature_group_count=p.num_group)
+        w = params['wmat'].astype(x.dtype)
+        strides = (p.stride, p.stride)
+        pad = ((p.pad_y, p.pad_y), (p.pad_x, p.pad_x))
+        mode = self._lowering()
+        if mode == 'im2col':
+            out = conv_im2col(x, w, strides, pad)
+        elif mode == 'split':
+            out = conv_split(x, w, strides, pad, p.num_group)
+        else:
+            out = conv_native(x, w, strides, pad, p.num_group)
         if p.no_bias == 0:
             out = out + params['bias'].astype(x.dtype)
         return [out.astype(x.dtype)]

@@ -1,0 +1,74 @@
+"""conv_lowering experiment knob: im2col (patches GEMM) and split
+(per-group convs) must be numerically equivalent to the native
+lax.conv_general_dilated lowering — forward AND gradients — so the
+on-chip A/B (tools/conv_lowering_bench.py) compares pure performance.
+Reference precedent: the im2col-GEMM convolution itself
+(``convolution_layer-inl.hpp:70-106``)."""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_string
+from cxxnet_tpu.io.data import DataBatch
+
+
+def _conf(lowering, ngroup):
+    return f"""
+netconfig=start
+layer[+1] = conv:cv1
+  kernel_size = 5
+  stride = 2
+  pad = 1
+  nchannel = 8
+  ngroup = {ngroup}
+  conv_lowering = {lowering}
+  init_sigma = 0.1
+layer[+1] = relu:rl1
+layer[+1] = flatten:fl1
+layer[+1] = fullc:fc1
+  nhidden = 3
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = {2 * ngroup},12,12
+batch_size = 8
+dev = cpu
+eta = 0.1
+metric[label] = error
+"""
+
+
+def _run(lowering, ngroup, steps=3):
+    rng = np.random.RandomState(0)
+    trainer = NetTrainer(parse_config_string(_conf(lowering, ngroup)))
+    trainer.init_model()
+    for _ in range(steps):
+        x = rng.randn(8, 2 * ngroup, 12, 12).astype(np.float32)
+        y = rng.randint(0, 3, (8, 1)).astype(np.float32)
+        trainer.update(DataBatch(x, y))
+    return {k: {f: np.asarray(v) for f, v in layer.items()}
+            for k, layer in trainer.params.items()}
+
+
+@pytest.mark.parametrize('lowering,ngroup', [('im2col', 1), ('split', 2)])
+def test_lowering_matches_native(lowering, ngroup):
+    ref = _run('native', ngroup)
+    got = _run(lowering, ngroup)
+    for k in ref:
+        for f in ref[k]:
+            np.testing.assert_allclose(got[k][f], ref[k][f],
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_im2col_rejects_grouped():
+    with pytest.raises(Exception, match='ngroup'):
+        _run('im2col', 2, steps=1)
+
+
+def test_auto_is_native_for_now():
+    ref = _run('native', 2, steps=2)
+    got = _run('auto', 2, steps=2)
+    for k in ref:
+        for f in ref[k]:
+            np.testing.assert_array_equal(got[k][f], ref[k][f])

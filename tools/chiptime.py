@@ -39,6 +39,18 @@ os.environ.setdefault(
                  '.jax_cache'))
 os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '2')
 
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:
+    # platform shim: makes JAX_PLATFORMS authoritative BEFORE backend
+    # discovery — a bare `import jax` can hang for minutes on plugin
+    # discovery when the tunnel is half-down, even for CPU-only runs
+    import cxxnet_tpu  # noqa: F401
+except Exception as _e:  # degraded: the very hang this guards may return
+    print(f'chiptime: platform shim unavailable ({_e!r}); '
+          f'jax import may hang on plugin discovery', file=sys.stderr)
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Chained behind run_chip_r4b.sh: waits for that runner to drain, then
+# lands the conv-lowering A/B receipt (native vs im2col at conv1, native
+# vs split at the grouped convs) that decides layers/conv.py's
+# conv_lowering auto policy.  Same per-step tunnel gate + durability
+# contract as r4b.
+set -x
+REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
+OUT=${OUT:-$REPO/receipts}
+cd "$REPO" || exit 1
+. tools/tunnel_lib.sh
+
+while pgrep -f run_chip_r4b.sh >/dev/null 2>&1; do
+    sleep 120
+done
+wait_tunnel "$OUT/r4c.marker"
+
+f="$OUT/conv_lowering.json"
+timeout 2700 python tools/conv_lowering_bench.py --json "$f" \
+    > "$OUT/conv_lowering.log" 2>&1 ||
+    [ -s "$f" ] || echo '{"error":"killed/timeout","results":[]}' > "$f"
+save_receipts "$f" "$OUT/conv_lowering.log"
+echo "conv lowering bench done"

@@ -17,14 +17,8 @@ cd "$REPO" || exit 1
 
 wait_tunnel "$OUT/remaining_r4.marker"
 
-save() {
-    for p in "$@"; do
-        [ -e "$p" ] && git add "$p"
-    done
-    if ! git diff --cached --quiet -- "$@"; then
-        git commit -q -m "receipts: $(basename "$1" .json)" -- "$@" ||
-            echo "WARNING: receipts NOT committed: $*" >&2
-    fi
+save() {    # shared impl in tunnel_lib.sh
+    save_receipts "$@"
 }
 
 micro() {

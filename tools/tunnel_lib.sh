@@ -15,6 +15,20 @@ tunnel_up() {
     timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1
 }
 
+# save_receipts <file>... — git-add the given receipt files and commit them
+# the moment they exist (the durability contract every watcher shares:
+# a kill can only lose the in-flight step, never a produced receipt).
+save_receipts() {
+    local p
+    for p in "$@"; do
+        [ -e "$p" ] && git add "$p"
+    done
+    if ! git diff --cached --quiet -- "$@"; then
+        git commit -q -m "receipts: $(basename "$1" .json)" -- "$@" ||
+            echo "WARNING: receipts NOT committed: $*" >&2
+    fi
+}
+
 wait_tunnel() {
     local marker="$1" waited=0
     until tunnel_up; do
