@@ -172,8 +172,8 @@ class LayerParam:
         if name == 'temp_col_max':
             self.temp_col_max = int(val) << 18
         if name == 'conv_lowering':
-            assert val in ('auto', 'native', 'im2col', 'split'), \
-                f'conv_lowering: unknown mode {val}'
+            if val not in ('auto', 'native', 'im2col', 'split'):
+                raise ValueError(f'conv_lowering: unknown mode {val}')
             self.conv_lowering = val
 
     def rand_init_weight(self, rng: jax.Array, shape: Tuple[int, ...],

@@ -79,8 +79,6 @@ class ConvolutionLayer(Layer):
             raise ValueError('conv: must set kernel_size correctly')
         if s.c % p.num_group or p.num_channel % p.num_group:
             raise ValueError('conv: channels must be divisible by ngroup')
-        if p.conv_lowering == 'im2col' and p.num_group != 1:
-            raise ValueError('conv_lowering=im2col requires ngroup=1')
         p.num_input_channel = s.c
         oy = (s.y + 2 * p.pad_y - p.kernel_height) // p.stride + 1
         ox = (s.x + 2 * p.pad_x - p.kernel_width) // p.stride + 1
@@ -111,7 +109,13 @@ class ConvolutionLayer(Layer):
         mode = self.param.conv_lowering
         if mode == 'auto':
             return 'native'
+        # each variant degrades to native on the shapes it does not
+        # target, so the knob is usable as a netconfig GLOBAL (replayed
+        # into every layer): im2col targets ungrouped convs, split
+        # grouped ones
         if mode == 'split' and self.param.num_group == 1:
+            return 'native'
+        if mode == 'im2col' and self.param.num_group != 1:
             return 'native'
         return mode
 

@@ -61,9 +61,20 @@ def test_lowering_matches_native(lowering, ngroup):
                                        rtol=1e-5, atol=1e-6)
 
 
-def test_im2col_rejects_grouped():
-    with pytest.raises(Exception, match='ngroup'):
-        _run('im2col', 2, steps=1)
+def test_im2col_grouped_falls_back_to_native():
+    """Each lowering degrades to native off-target, so the knob works as
+    a netconfig GLOBAL on mixed nets (im2col on AlexNet only touches the
+    ungrouped conv1; the grouped convs run native, bit-identically)."""
+    ref = _run('native', 2, steps=2)
+    got = _run('im2col', 2, steps=2)
+    for k in ref:
+        for f in ref[k]:
+            np.testing.assert_array_equal(got[k][f], ref[k][f])
+
+
+def test_unknown_lowering_rejected():
+    with pytest.raises(ValueError, match='conv_lowering'):
+        _run('imcol', 1, steps=1)
 
 
 def test_auto_is_native_for_now():

@@ -10,6 +10,8 @@ pin the contract: the deferred path must produce the SAME f32 pixels the
 host path produces, through train, eval and predict.
 """
 
+import os
+
 import numpy as np
 
 from cxxnet_tpu.io.data import create_iterator
@@ -208,6 +210,34 @@ def test_multi_step_applies_norm(tmp_path):
         for f in ref[k]:
             np.testing.assert_allclose(got[k][f], ref[k][f],
                                        rtol=1e-5, atol=1e-7)
+
+
+def test_imgbinx_chain_uint8_wire(tmp_path):
+    """The production e2e chain (imgbinx -> augment -> batch ->
+    threadbuffer) carries uint8 + spec through every wrapper — the exact
+    configuration bench.py e2e_alexnet runs."""
+    import subprocess
+    import sys as _sys
+    lst = make_img_dataset(str(tmp_path), n=10)
+    out_bin = str(tmp_path / 'a.bin')
+    tool = os.path.join(os.path.dirname(__file__), '..', 'tools',
+                        'im2bin.py')
+    subprocess.check_call([_sys.executable, tool, lst, str(tmp_path),
+                           out_bin])
+    cfg = [('iter', 'imgbinx'), ('image_list', lst),
+           ('image_bin', out_bin),
+           ('input_shape', '3,16,16'), ('batch_size', '4'),
+           ('round_batch', '1'), ('silent', '1'),
+           ('mean_value', '100,100,100'), ('device_normalize', '1'),
+           ('iter', 'threadbuffer')]
+    it = create_iterator(cfg)
+    it.init()
+    batches = list(it)
+    assert len(batches) == 3
+    for b in batches:
+        assert b.data.dtype == np.uint8
+        assert b.norm_spec is not None
+        assert b.norm_spec.mean_vals is not None
 
 
 def test_mean_image_spec(tmp_path):

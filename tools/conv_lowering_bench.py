@@ -97,12 +97,16 @@ def main():
                 print(f'{name:34s} {passname:7s} {lname:7s} '
                       f'{t * 1e6:9.1f}us  {tf:6.1f} TF/s{extra}',
                       flush=True)
-                # durability: dump partial results as each row lands
+                # durability: dump partial results as each row lands;
+                # atomic replace so a mid-write kill can't leave a
+                # truncated (non-empty but unparseable) receipt
                 if args.json:
-                    with open(args.json, 'w') as f:
+                    tmp = args.json + '.tmp'
+                    with open(tmp, 'w') as f:
                         json.dump({'device': dev.device_kind,
                                    'dtype': 'bfloat16',
                                    'results': results}, f, indent=1)
+                    os.replace(tmp, args.json)
     if args.json and results:
         print(f'wrote {args.json}')
     elif args.json:

@@ -10,7 +10,11 @@ OUT=${OUT:-$REPO/receipts}
 cd "$REPO" || exit 1
 . tools/tunnel_lib.sh
 
-while pgrep -f run_chip_r4b.sh >/dev/null 2>&1; do
+# match the interpreter invocation specifically, not any cmdline that
+# happens to contain the script name (a tail/editor would deadlock this
+# gate; a bare substring also fails open pre-spawn — launch r4c AFTER r4b)
+while pgrep -f "bash tools/run_chip_r4b.sh" >/dev/null 2>&1 ||
+      pgrep -f "bash .*/run_chip_r4b.sh" >/dev/null 2>&1; do
     sleep 120
 done
 wait_tunnel "$OUT/r4c.marker"
