@@ -430,9 +430,8 @@ def bench_io() -> int:
 
     batch_size = _bench_batch(256)
     n_images = int(os.environ.get('CXXNET_E2E_IMAGES', '1024'))
-    with tempfile.TemporaryDirectory() as tmp:
-        lst, binpath = _pack_synthetic_imgbin(tmp, n_images)
-        it = create_iterator(_imgbinx_chain(lst, binpath, batch_size))
+
+    def rate(it):
         it.init()
         for b in it:                 # warm: page cache, buffers, threads
             pass
@@ -440,15 +439,32 @@ def bench_io() -> int:
         for _round in range(2):
             for b in it:
                 n_done += b.batch_size - b.num_batch_padd
-        dt = time.perf_counter() - t0
-    ips = n_done / dt
+        return n_done, n_done / (time.perf_counter() - t0)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        lst, binpath = _pack_synthetic_imgbin(tmp, n_images)
+        n_done, ips = rate(
+            create_iterator(_imgbinx_chain(lst, binpath, batch_size)))
+        # B-side: uint8 wire (device_normalize) — the host skips the
+        # f32 convert + normalize, quantifying that stage's share.  A
+        # B-side failure must not discard the completed A-side number.
+        try:
+            _, ips_u8 = rate(
+                create_iterator(_imgbinx_chain(lst, binpath, batch_size,
+                                               device_normalize=True)))
+        except Exception as e:              # noqa: BLE001
+            ips_u8 = None
+            print(f'uint8-wire side failed: {e!r}', file=sys.stderr)
     _emit({
         'metric': 'host_io_images_per_sec',
         'value': round(ips, 1),
         'unit': 'images/sec',
         'vs_baseline': None,
         'images': n_done,
-        'note': 'imgbinx+decode+augment+threadbuffer, host only',
+        'uint8_wire_images_per_sec':
+            round(ips_u8, 1) if ips_u8 else None,
+        'note': 'imgbinx+decode+augment+threadbuffer, host only; '
+                'uint8_wire = same chain under device_normalize=1',
     })
     return 0
 
