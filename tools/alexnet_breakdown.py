@@ -35,11 +35,15 @@ os.environ.setdefault(            # persistent XLA cache — see chiptime.py
                  '.jax_cache'))
 os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '2')
 
+# chiptime FIRST: its preamble imports the cxxnet_tpu platform shim
+# before jax — a bare `import jax` hangs on plugin discovery when the
+# tunnel is half-down, even for CPU-only runs (this exact tool sat at
+# 0 output for 10+ minutes before the ordering mattered)
+from chiptime import time_op                                   # noqa: E402
+
 import jax                                                     # noqa: E402
 import jax.numpy as jnp                                        # noqa: E402
 import numpy as np                                             # noqa: E402
-
-from chiptime import time_op                                   # noqa: E402
 
 
 def _time_step_scan(tr, dstack, lstack, iters=10, reps=3):
@@ -78,6 +82,10 @@ def main() -> int:
     ap.add_argument('--model', default='alexnet', choices=sorted(_MODELS))
     ap.add_argument('--batch', type=int, default=None)
     ap.add_argument('--json', default=None)
+    ap.add_argument('--dtype', default='bfloat16',
+                    choices=('bfloat16', 'float32'),
+                    help='float32 for CPU pipe-clean runs — CPU bf16 is '
+                         'emulated and minutes-slow per conv')
     args = ap.parse_args()
 
     from cxxnet_tpu import models
@@ -94,8 +102,9 @@ momentum = 0.9
 metric = error
 eval_train = 0
 random_type = xavier
-compute_type = bfloat16
+compute_type = {args.dtype}
 """
+    cdtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
     tr = NetTrainer(parse_config_string(conf))
     tr.init_model()
     rng = np.random.RandomState(0)
@@ -105,17 +114,13 @@ compute_type = bfloat16
         rng.randint(0, 1000, (2, bs, 1)).astype(np.float32), cast=False)
     data, label = dstack[0], lstack[0]
 
-    # --- whole step & forward-only ------------------------------------
-    t_step = _time_step_scan(tr, dstack, lstack)
-    fwd = tr._forward_fn
-    params = tr.params
-    t_fwd = time_op(lambda d: fwd(params, d, (), 0), (data,))
-    step_flops = tr.train_step_flops(data, label)
-    print(f'full train step: {t_step * 1e3:8.2f} ms   '
-          f'({step_flops / t_step / 1e12:.1f} TFLOP/s achieved)')
-    print(f'forward only:    {t_fwd * 1e3:8.2f} ms')
-
-    # --- per-layer isolation ------------------------------------------
+    # Ordering: per-layer rows FIRST (cheap compiles, the attribution
+    # value unique to this tool), whole-step anchor LAST — three runs in
+    # a row were killed inside the expensive multi-step-scan compile
+    # before a single layer row existed.  pct_of_step is filled in once
+    # (if) the step time lands; the known-good step time from the
+    # bench_alexnet receipt anchors a partial file.
+    t_step = t_fwd = step_flops = None
     net = tr.net
     host = jax.device_get(tr.params)
     rows = []
@@ -128,9 +133,11 @@ compute_type = bfloat16
         if not args.json:
             return
         payload = {'model': args.model, 'batch': bs,
-                   'step_ms': round(t_step * 1e3, 2),
-                   'fwd_ms': round(t_fwd * 1e3, 2),
-                   'achieved_tflops': round(step_flops / t_step / 1e12, 2),
+                   'step_ms': round(t_step * 1e3, 2) if t_step else None,
+                   'fwd_ms': round(t_fwd * 1e3, 2) if t_fwd else None,
+                   'achieved_tflops':
+                       round(step_flops / t_step / 1e12, 2)
+                       if t_step and step_flops else None,
                    'layers': rows}
         if partial:
             payload['partial'] = True
@@ -149,11 +156,11 @@ compute_type = bfloat16
         for sp in spec_in:
             shape = ((bs, sp.flat_size) if sp.is_mat
                      else (bs, sp.y, sp.x, sp.c))
-            xs.append(jnp.asarray(rng.randn(*shape) * 0.1, jnp.bfloat16))
+            xs.append(jnp.asarray(rng.randn(*shape) * 0.1, cdtype))
         lp = {k: jnp.asarray(v) for k, v in
               host.get(str(net.layer_primary[i]), {}).items()}
         ctx = ForwardContext(is_train=True, rng=jax.random.PRNGKey(0),
-                             layer_index=i, compute_dtype=jnp.bfloat16)
+                             layer_index=i, compute_dtype=cdtype)
 
         def f(*inputs, _layer=layer, _lp=lp, _ctx=ctx):
             return _layer.forward(_lp, list(inputs), _ctx)[0]
@@ -176,17 +183,31 @@ compute_type = bfloat16
                 return jax.grad(loss, argnums=(0, 1))(_lp, inputs)
             return jax.grad(lambda ins: loss(_lp, ins))(inputs)
 
-        t_f = time_op(f, tuple(xs))
-        t_g = time_op(g, tuple(xs))
         name = f'{i:2d} {layer.type_name}:{info.name or ""}'
+        print(f'... timing {name.strip()} fwd', flush=True)
+        t_f = time_op(f, tuple(xs))
+        print(f'... timing {name.strip()} fwd+bwd', flush=True)
+        t_g = time_op(g, tuple(xs))
         rows.append({'layer': name.strip(), 'fwd_us': round(t_f * 1e6, 1),
-                     'fwd_bwd_us': round(t_g * 1e6, 1),
-                     'pct_of_step': round(100 * t_g / t_step, 1)})
+                     'fwd_bwd_us': round(t_g * 1e6, 1)})
         print(f'{name:26s} fwd {t_f * 1e6:9.1f}us   '
-              f'fwd+bwd {t_g * 1e6:9.1f}us   {100 * t_g / t_step:5.1f}% '
-              f'of step', flush=True)
+              f'fwd+bwd {t_g * 1e6:9.1f}us', flush=True)
         dump(partial=True)
 
+    # --- whole step & forward-only (the expensive compiles) -----------
+    print('timing full train step (multi-step scan compile)...',
+          flush=True)
+    t_step = _time_step_scan(tr, dstack, lstack)
+    for r in rows:
+        r['pct_of_step'] = round(100 * r['fwd_bwd_us'] / 1e6 / t_step, 1)
+    dump(partial=True)      # t_step is the costliest number: persist NOW
+    fwd = tr._forward_fn
+    params = tr.params
+    t_fwd = time_op(lambda d: fwd(params, d, (), 0), (data,))
+    step_flops = tr.train_step_flops(data, label)
+    print(f'full train step: {t_step * 1e3:8.2f} ms   '
+          f'({step_flops / t_step / 1e12:.1f} TFLOP/s achieved)')
+    print(f'forward only:    {t_fwd * 1e3:8.2f} ms')
     covered = sum(r['fwd_bwd_us'] for r in rows) / 1e6
     print(f'sum of isolated layers (fwd+bwd): {covered * 1e3:.2f} ms '
           f'of {t_step * 1e3:.2f} ms step '

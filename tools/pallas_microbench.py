@@ -29,11 +29,14 @@ os.environ.setdefault(            # persistent XLA cache — see chiptime.py
                  '.jax_cache'))
 os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '2')
 
+# chiptime FIRST: its preamble imports the cxxnet_tpu platform shim
+# before jax, so CPU-mode runs can't hang on plugin discovery during
+# tunnel outages
+from chiptime import grad_probe, time_op                       # noqa: E402
+
 import jax                                                     # noqa: E402
 import jax.numpy as jnp                                        # noqa: E402
 import numpy as np                                             # noqa: E402
-
-from chiptime import grad_probe, time_op                       # noqa: E402
 
 
 _PASS_WRAPS = {'fwd': lambda f: f, 'fwd+bwd': None, 'bwd-op': lambda f: f}
