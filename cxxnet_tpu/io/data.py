@@ -35,6 +35,26 @@ class NormSpec:
         self.mean_vals = mean_vals          # (c,) float32 or None
         self.scale = float(scale)
 
+    def resolved_mean(self) -> np.ndarray:
+        """The mean actually subtracted, with the host augment path's
+        priority (per-channel ``mean_value`` outranks a mean image),
+        broadcastable against (..., c, y, x).  Single source of truth for
+        host ``apply`` and the trainer's device constants."""
+        if self.mean_vals is not None:
+            return np.asarray(self.mean_vals, np.float32)[:, None, None]
+        if self.mean_img is not None:
+            return np.asarray(self.mean_img, np.float32)
+        return np.zeros((1, 1, 1), np.float32)
+
+    def apply(self, data: np.ndarray) -> np.ndarray:
+        """Host-side application of the deferred normalization — the same
+        (x - mean) * scale the jitted step runs (trainer._apply_input_norm).
+        Used where raw batches leave the device path, e.g. the C-ABI
+        ``CXNIOGetData`` contract, which hands out post-augment float
+        data."""
+        out = np.asarray(data, np.float32)
+        return (out - self.resolved_mean()) * self.scale
+
 
 class DataBatch:
     """One minibatch (``src/io/data.h:83-181``)."""

@@ -235,14 +235,7 @@ class NetTrainer:
         cached = self._norm_dev.get(id(spec))
         if cached is not None and cached[0] is spec:
             return cached[1]
-        # host-path priority: per-channel mean_value wins over a mean
-        # image when both are configured (iter_augment __iter__ order)
-        if spec.mean_vals is not None:
-            mean = np.asarray(spec.mean_vals, np.float32)[:, None, None]
-        elif spec.mean_img is not None:
-            mean = np.asarray(spec.mean_img, np.float32)
-        else:
-            mean = np.zeros((1, 1, 1), np.float32)
+        mean = spec.resolved_mean()
         sh = replicated_sharding(self._mesh)
         consts = (jax.device_put(jnp.asarray(mean), sh),
                   jax.device_put(jnp.float32(spec.scale), sh))

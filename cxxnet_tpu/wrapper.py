@@ -80,7 +80,14 @@ class DataIter:
         return self._batch
 
     def get_data(self) -> np.ndarray:
-        return np.asarray(self.value.data, np.float32)
+        # CXNIOGetData hands out POST-augment float data (reference
+        # wrapper contract).  Under device_normalize=1 the batch carries
+        # raw pixels + the deferred spec — apply it here so wrapper
+        # consumers see the same values either way.
+        batch = self.value
+        if batch.norm_spec is not None:
+            return batch.norm_spec.apply(batch.data)
+        return np.asarray(batch.data, np.float32)
 
     def get_label(self) -> np.ndarray:
         return np.asarray(self.value.label, np.float32)

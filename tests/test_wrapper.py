@@ -55,6 +55,31 @@ def test_dataiter_protocol(tmp_path):
     assert it.next()
 
 
+def test_get_data_applies_deferred_normalize(tmp_path):
+    """CXNIOGetData hands out POST-augment float data; under
+    device_normalize=1 the wrapper must apply the deferred spec so
+    consumers see the same values as the host-normalize path."""
+    from tests.test_io import make_img_dataset
+    lst = make_img_dataset(str(tmp_path))
+    base = f"""
+iter = img
+  image_list = "{lst}"
+  image_root = "{tmp_path}"
+  input_shape = 3,16,16
+  batch_size = 4
+  round_batch = 1
+  silent = 1
+  mean_value = 120,118,122
+  scale = 0.0078125
+"""
+    host = wrapper.DataIter(base + "iter = end\n")
+    dev = wrapper.DataIter(base + "  device_normalize = 1\niter = end\n")
+    assert host.next() and dev.next()
+    np.testing.assert_allclose(dev.get_data(), host.get_data(),
+                               rtol=0, atol=1e-5)
+    assert dev.value.data.dtype == np.uint8      # wire stays uint8
+
+
 def test_net_train_eval_weights(tmp_path):
     it = wrapper.DataIter(make_iter_cfg(tmp_path))
     net = wrapper.Net(dev='cpu', cfg=NET_CFG)
