@@ -99,19 +99,29 @@ def main():
                       flush=True)
                 # durability: dump partial results as each row lands;
                 # atomic replace so a mid-write kill can't leave a
-                # truncated (non-empty but unparseable) receipt
+                # truncated (non-empty but unparseable) receipt.  The
+                # 'partial' flag comes off only in the final dump below,
+                # so an idempotent relaunch (run_chip_pending.sh) re-runs
+                # an interrupted sweep instead of skipping it forever.
                 if args.json:
-                    tmp = args.json + '.tmp'
-                    with open(tmp, 'w') as f:
-                        json.dump({'device': dev.device_kind,
-                                   'dtype': 'bfloat16',
-                                   'results': results}, f, indent=1)
-                    os.replace(tmp, args.json)
+                    _dump_json(args.json, dev, results, partial=True)
     if args.json and results:
+        _dump_json(args.json, dev, results, partial=False)
         print(f'wrote {args.json}')
     elif args.json:
         print(f'NOTHING matched --only={args.only}: {args.json} NOT written')
     return 0
+
+
+def _dump_json(path, dev, results, partial):
+    payload = {'device': dev.device_kind, 'dtype': 'bfloat16',
+               'results': results}
+    if partial:
+        payload['partial'] = True
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)
 
 
 if __name__ == '__main__':
