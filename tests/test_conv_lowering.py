@@ -47,8 +47,8 @@ def _run(lowering, ngroup, steps=3):
         x = rng.randn(8, 2 * ngroup, 12, 12).astype(np.float32)
         y = rng.randint(0, 3, (8, 1)).astype(np.float32)
         trainer.update(DataBatch(x, y))
-    return {k: {f: np.asarray(v) for f, v in layer.items()}
-            for k, layer in trainer.params.items()}
+    from test_device_normalize import snap_params
+    return snap_params(trainer)
 
 
 @pytest.mark.parametrize('lowering,ngroup', [('im2col', 1), ('split', 2)])
@@ -75,6 +75,32 @@ def test_im2col_grouped_falls_back_to_native():
 def test_unknown_lowering_rejected():
     with pytest.raises(ValueError, match='conv_lowering'):
         _run('imcol', 1, steps=1)
+
+
+@pytest.mark.parametrize('lowering,ngroup', [('im2col', 1), ('split', 2)])
+def test_lowering_on_sharded_mesh(lowering, ngroup):
+    """The alternative lowerings must survive GSPMD: im2col's
+    (b*oy*ox, k) reshape merges the data-sharded batch axis into the GEMM
+    row dim — numerics must still match the 1-device native result on an
+    8-device data-parallel mesh (layout cost is the chip A/B's concern,
+    correctness is this test's)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 2 * ngroup, 12, 12).astype(np.float32)
+    y = rng.randint(0, 3, (8, 1)).astype(np.float32)
+
+    def run(lower, dev_line):
+        conf = _conf(lower, ngroup).replace('dev = cpu', dev_line)
+        trainer = NetTrainer(parse_config_string(conf))
+        trainer.init_model()
+        for _ in range(2):
+            trainer.update(DataBatch(x.copy(), y.copy()))
+        from test_device_normalize import snap_params
+        return snap_params(trainer)
+
+    ref = run('native', 'dev = cpu')
+    got = run(lowering, 'dev = tpu:0-7')
+    from test_device_normalize import assert_params_equal
+    assert_params_equal(got, ref, rtol=2e-5, atol=1e-6)
 
 
 def test_auto_is_native_for_now():

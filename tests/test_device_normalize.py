@@ -20,6 +20,18 @@ from cxxnet_tpu.utils.config import parse_config_string
 
 from test_io import make_img_dataset
 
+
+def snap_params(trainer):
+    return {k: {f: np.asarray(v) for f, v in layer.items()}
+            for k, layer in trainer.params.items()}
+
+
+def assert_params_equal(got, ref, rtol=1e-5, atol=1e-7):
+    for k in ref:
+        for f in ref[k]:
+            np.testing.assert_allclose(got[k][f], ref[k][f],
+                                       rtol=rtol, atol=atol)
+
 CONV_CONF = """
 netconfig=start
 layer[+1] = conv:cv1
@@ -101,9 +113,7 @@ def test_train_eval_predict_equivalence(tmp_path):
             trainer.update(b)
         ev = trainer.evaluate(iter(batches), 'x')
         preds = np.concatenate([trainer.predict(b) for b in batches])
-        params = {k: {f: np.asarray(v) for f, v in layer.items()}
-                  for k, layer in trainer.params.items()}
-        return ev, preds, params
+        return ev, preds, snap_params(trainer)
 
     ev_h, preds_h, params_h = run(False)
     ev_d, preds_d, params_d = run(True)
@@ -184,16 +194,12 @@ def test_multi_step_applies_norm(tmp_path):
     host_batches = list(_chain(lst, str(tmp_path), False))
     spec = dev_batches[0].norm_spec
 
-    def snap(trainer):
-        return {k: {f: np.asarray(v) for f, v in layer.items()}
-                for k, layer in trainer.params.items()}
-
     # reference trajectory: per-batch updates on the host-normalized data
     t_ref = NetTrainer(parse_config_string(CONV_CONF))
     t_ref.init_model()
     for b in host_batches[:2]:
         t_ref.update(b)
-    ref = snap(t_ref)
+    ref = snap_params(t_ref)
 
     # multi-step trajectory: one dispatch over the raw uint8 stack + norm
     t_dev = NetTrainer(parse_config_string(CONV_CONF))
@@ -205,11 +211,29 @@ def test_multi_step_applies_norm(tmp_path):
     t_dev.update_n_on_device(
         multi_fn, t_dev.shard_batch_stack(stack),
         t_dev.shard_batch_stack(labels, cast=False), norm=norm)
-    got = snap(t_dev)
+    got = snap_params(t_dev)
     for k in ref:
         for f in ref[k]:
             np.testing.assert_allclose(got[k][f], ref[k][f],
                                        rtol=1e-5, atol=1e-7)
+
+
+def test_update_period_accumulation_equivalence(tmp_path):
+    """device_normalize composed with update_period>1: the deferred
+    normalize happens per-minibatch inside grad accumulation, so the
+    accumulated update must match the host-normalized path exactly."""
+    lst = make_img_dataset(str(tmp_path))
+    conf = CONV_CONF + 'update_period = 3\n'
+
+    def run(dev_norm):
+        trainer = NetTrainer(parse_config_string(conf))
+        trainer.init_model()
+        for b in _chain(lst, str(tmp_path), dev_norm):
+            trainer.update(b)
+        return snap_params(trainer)
+
+    ref, got = run(False), run(True)
+    assert_params_equal(got, ref)
 
 
 def test_imgbinx_chain_uint8_wire(tmp_path):
