@@ -44,6 +44,7 @@ class LearnTask:
         self.silent = 0
         self.device = 'tpu'
         self.test_io = 0
+        self.exact_ckpt = 0
         self.extract_node_name = ''
         self.name_pred = 'pred.txt'
         self.output_format = 1
@@ -65,6 +66,7 @@ class LearnTask:
             'num_round': ('num_round', int), 'max_round': ('max_round', int),
             'silent': ('silent', int), 'task': ('task', str), 'dev': ('device', str),
             'test_io': ('test_io', int), 'extract_node_name': ('extract_node_name', str),
+            'exact_ckpt': ('exact_ckpt', int),
         }
         if name in simple:
             attr, typ = simple[name]
@@ -95,6 +97,21 @@ class LearnTask:
             self.net_trainer = self._create_net()
             self.net_trainer.load_model(f)
         self.start_counter = s
+        if self.exact_ckpt:
+            from .nnet.sharded_ckpt import step_dir
+            # ask for EXACTLY the loaded model's step: newer leftover
+            # sidecars (e.g. after rolling back by deleting model files)
+            # must not block restoring the matching one
+            if os.path.isdir(step_dir(self._exact_dir(), s - 1)):
+                self.net_trainer.load_training_state(self._exact_dir(),
+                                                     s - 1)
+                if not self.silent:
+                    print(f'Init: exact optimizer state restored from '
+                          f'{self._exact_dir()} step {s - 1}', flush=True)
+            elif not self.silent:
+                print(f'Init: no exact state for step {s - 1} — resuming '
+                      f'with reset momentum (reference behavior)',
+                      flush=True)
         return True
 
     def _load_model(self) -> None:
@@ -114,8 +131,12 @@ class LearnTask:
             f.read(4)
             self.net_trainer.copy_model_from(f)
 
+    def _exact_dir(self) -> str:
+        return os.path.join(self.name_model_dir, 'exact_state')
+
     def _save_model(self) -> None:
-        path = self._model_path(self.start_counter)
+        counter = self.start_counter
+        path = self._model_path(counter)
         self.start_counter += 1
         if self.save_period == 0 or self.start_counter % self.save_period != 0:
             return
@@ -123,6 +144,19 @@ class LearnTask:
         with open(path, 'wb') as f:
             f.write(int(self.net_type).to_bytes(4, 'little', signed=True))
             self.net_trainer.save_model(f)
+        if self.exact_ckpt:
+            # beyond reference: sidecar with optimizer state + counters so
+            # continue=1 resumes bit-exact mid-momentum (the reference
+            # model file drops momentum by design — trainer.save_model)
+            self.net_trainer.save_training_state(self._exact_dir(), counter)
+            # only the sidecar matching the newest model file is ever
+            # restored: prune older ones (~3x model size each)
+            from .nnet.sharded_ckpt import step_dir
+            import shutil
+            for old in range(counter):
+                d = step_dir(self._exact_dir(), old)
+                if os.path.isdir(d):
+                    shutil.rmtree(d, ignore_errors=True)
 
     def _create_iterators(self) -> None:
         flag = 0

@@ -211,11 +211,7 @@ def test_multi_step_applies_norm(tmp_path):
     t_dev.update_n_on_device(
         multi_fn, t_dev.shard_batch_stack(stack),
         t_dev.shard_batch_stack(labels, cast=False), norm=norm)
-    got = snap_params(t_dev)
-    for k in ref:
-        for f in ref[k]:
-            np.testing.assert_allclose(got[k][f], ref[k][f],
-                                       rtol=1e-5, atol=1e-7)
+    assert_params_equal(snap_params(t_dev), ref)
 
 
 def test_update_period_accumulation_equivalence(tmp_path):
