@@ -1,0 +1,75 @@
+"""One-screen digest of every receipt under receipts/ — the quick answer
+to "what is measured, what is pending, what is suspect".
+
+    python tools/receipts_digest.py [--dir receipts]
+
+Flags surfaced per receipt: partial (interrupted run), superseded
+(marked for re-measure, with reason), error.  Bench receipts print their
+headline metric; micro/breakdown receipts print row counts and the
+best/worst speedup.
+"""
+
+import argparse
+import json
+import os
+
+
+def describe(path):
+    name = os.path.basename(path)
+    try:
+        d = json.load(open(path))
+    except Exception as e:
+        return f'{name:34s} UNPARSEABLE ({type(e).__name__})'
+    flags = []
+    if d.get('error') is not None:
+        flags.append(f'ERROR: {d["error"]}')
+    if d.get('partial'):
+        flags.append('PARTIAL')
+    if d.get('superseded'):
+        why = str(d['superseded'])
+        flags.append('SUPERSEDED: '
+                     + (why[:60] + '...' if len(why) > 60 else why))
+    flag = ('  [' + '; '.join(flags) + ']') if flags else ''
+
+    if 'value' in d:                      # bench.py schema
+        unit = d.get('unit') or ''
+        extra = ''
+        for k in ('mfu', 'step_ms', 'host_link_mb_per_s',
+                  'uint8_wire_images_per_sec'):
+            if d.get(k) is not None:
+                extra += f'  {k}={d[k]}'
+        return f'{name:34s} {d.get("value")} {unit}{extra}{flag}'
+    if 'results' in d:                    # micro/conv-lowering schema
+        rows = d['results']
+        bad = sum(1 for r in rows if r.get('error') is not None)
+        sp = [next((r[k] for k in
+                    ('pallas_speedup', 'speedup_vs_native', 'vs_xla')
+                    if r.get(k) is not None), None) for r in rows]
+        sp = [s for s in sp if s is not None]
+        rng = (f'  speedup {min(sp):.2f}x..{max(sp):.2f}x' if sp else '')
+        err = f'  ({bad} ERROR rows)' if bad else ''
+        return f'{name:34s} {len(rows)} rows{err}{rng}{flag}'
+    if 'layers' in d:                     # breakdown schema
+        top = sorted(d['layers'], key=lambda r: -r.get('fwd_bwd_us', 0))[:3]
+        tops = ', '.join(f'{r["layer"]}={r["fwd_bwd_us"]}us' for r in top)
+        step = d.get('step_ms')
+        return (f'{name:34s} {len(d["layers"])} layers'
+                f'{f"  step={step}ms" if step else ""}'
+                f'{"  top: " + tops if tops else ""}{flag}')
+    return f'{name:34s} (unrecognized schema){flag}'
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--dir', default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'receipts'))
+    args = ap.parse_args()
+    names = sorted(n for n in os.listdir(args.dir) if n.endswith('.json'))
+    for n in names:
+        print(describe(os.path.join(args.dir, n)))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())
