@@ -261,7 +261,7 @@ class AugmentIterator(IIterator):
     def __iter__(self):
         if self._device_norm_active():
             # raw crops go to the device untouched; normalization happens
-            # inside the jitted step (trainer._norm_input)
+            # inside the jitted step (trainer._apply_input_norm)
             yield from self._raw_iter_insts()
             return
         rng = np.random.RandomState(self.seed_data + 91)

@@ -234,13 +234,18 @@ class NetTrainer:
             return ()
         cached = self._norm_dev.get(id(spec))
         if cached is not None and cached[0] is spec:
+            self._norm_dev[id(spec)] = self._norm_dev.pop(id(spec))  # LRU
             return cached[1]
         mean = spec.resolved_mean()
         sh = replicated_sharding(self._mesh)
         consts = (jax.device_put(jnp.asarray(mean), sh),
                   jax.device_put(jnp.float32(spec.scale), sh))
         # keyed per spec instance (train and eval chains may normalize
-        # differently); the spec ref pins the id against reuse
+        # differently); the spec ref pins the id against reuse.  Bounded:
+        # a trainer cycling many iterators must not pin every spec's
+        # device consts for its lifetime
+        if len(self._norm_dev) >= 8:
+            self._norm_dev.pop(next(iter(self._norm_dev)))
         self._norm_dev[id(spec)] = (spec, consts)
         return consts
 

@@ -89,11 +89,11 @@ def main():
                      'us': round(t * 1e6, 1), 'tflops': round(tf, 1)}
                 if lname == 'native':
                     base[passname] = t
-                else:
+                elif base.get(passname):
                     r['speedup_vs_native'] = round(base[passname] / t, 3)
                 results.append(r)
                 extra = ('  %.3fx vs native' % (base[passname] / t)
-                         if lname != 'native' else '')
+                         if lname != 'native' and base.get(passname) else '')
                 print(f'{name:34s} {passname:7s} {lname:7s} '
                       f'{t * 1e6:9.1f}us  {tf:6.1f} TF/s{extra}',
                       flush=True)
