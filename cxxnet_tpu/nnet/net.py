@@ -83,6 +83,7 @@ class Net:
                 self.layers[i].set_num_outputs(len(info.nindex_out))
         self._infer_shapes()
         self._build_sibling_fusion()
+        self._build_blockdiag_fusion()
 
     # --- horizontal fusion ------------------------------------------------
     def _build_sibling_fusion(self) -> None:
@@ -161,6 +162,272 @@ class Net:
             out = out + b
         out = out.astype(x.dtype)
         splits = np.cumsum(widths)[:-1]
+        return jnp.split(out, splits, axis=-1)
+
+    # --- cross-input block-diagonal fusion --------------------------------
+    def _build_blockdiag_fusion(self) -> None:
+        """Fuse convolutions that read DIFFERENT inputs into one wide conv
+        with a block-diagonal weight.
+
+        Sibling fusion (above) only reaches convs sharing a trunk node; the
+        remaining narrow convs in an inception module (the 3x3/5x5 tower
+        convs, the pool projection) each consume their own reduce output,
+        so their 16..128-wide outputs underfill the 128-lane MXU per pass
+        no matter the batch (BASELINE.md "Why GoogLeNet sits at MFU 0.15").
+        Concatenating the inputs channel-wise and embedding each member's
+        weight as a diagonal block (smaller kernels zero-padded spatially
+        into the group's max kernel, with input padding grown to match)
+        computes exactly the same outputs while filling the array — at the
+        cost of the zero blocks' redundant FLOPs, which is why this is
+        OFF by default and flipped per measured receipt only.
+
+        ``fuse_blockdiag = in3a_3x3+in3a_5x5;in3b_3x3+in3b_5x5`` names the
+        groups explicitly (layer names, ``+`` within a group, ``;`` between
+        groups).  Members must be ungrouped single-in/single-out convs with
+        equal stride and bias-ness, equal input spatial dims, and a shared
+        ``2*pad - kernel`` extent on each axis (which makes the padded
+        output grids coincide).  Because config order may interleave a
+        member's producers between the members (the builder emits reduce
+        convs lazily), the execution order is re-scheduled to make group
+        members contiguous; a node-version simulation validates that the
+        reorder preserves the reference's sequential in-place semantics
+        (``layer[a->a]`` rewrites) exactly, and raises otherwise.
+        """
+        from ..layers.conv import ConvolutionLayer
+        spec_str, tp = '0', 1
+        for name, val in self.cfg.defcfg:
+            if name == 'fuse_blockdiag':
+                spec_str = str(val).strip()
+            if name == 'tensor_parallel':
+                tp = int(val)
+        self._blockdiag_groups: Dict[int, List[int]] = {}
+        self._exec_order: List[int] = list(range(len(self.cfg.layers)))
+        if spec_str in ('', '0'):
+            return
+        if tp > 1:
+            # unlike sibling fusion (default-on, silently skipped), this
+            # spec is explicit opt-in: refusing loudly keeps a "fused"
+            # receipt from actually measuring the unfused plan
+            raise ValueError(
+                'fuse_blockdiag is incompatible with tensor_parallel>1 '
+                '(member wmats are sharded on the output-channel axis the '
+                'fusion concatenates); remove one of the two settings')
+        byname: Dict[str, int] = {}
+        for i, info in enumerate(self.cfg.layers):
+            if info.name and info.name not in byname:
+                byname[info.name] = i
+        reads, writes = self._node_version_maps()
+        for gspec in spec_str.split(';'):
+            names = [s.strip() for s in gspec.split('+') if s.strip()]
+            if len(names) < 2:
+                raise ValueError(
+                    f'fuse_blockdiag: group {gspec!r} needs >=2 layer names')
+            members = []
+            for nm in names:
+                if nm not in byname:
+                    raise ValueError(
+                        f'fuse_blockdiag: no layer named {nm!r}')
+                members.append(byname[nm])
+            members.sort()
+            self._check_blockdiag_group(members, ConvolutionLayer,
+                                        reads, writes)
+            self._exec_order = self._reorder_contiguous(
+                self._exec_order, members, reads, writes)
+            for m in members:
+                if m in self._blockdiag_groups:
+                    raise ValueError(
+                        f'fuse_blockdiag: layer '
+                        f'{self.cfg.layers[m].name!r} appears in two '
+                        f'groups')
+                self._blockdiag_groups[m] = members
+        self._verify_blockdiag_final(reads, writes)
+
+    def _node_version_maps(self):
+        """Per-layer (node, version) read/write sets under the sequential
+        config-order semantics; versions count in-place rewrites."""
+        ver: Dict[int, int] = {}
+        reads, writes = [], []
+        for info in self.cfg.layers:
+            reads.append(frozenset((n, ver.get(n, 0))
+                                   for n in info.nindex_in))
+            w = set()
+            for n in info.nindex_out:
+                ver[n] = ver.get(n, 0) + 1
+                w.add((n, ver[n]))
+            writes.append(frozenset(w))
+        return reads, writes
+
+    def _check_blockdiag_group(self, members, conv_cls, reads, writes):
+        layers = [self.layers[m] for m in members]
+        infos = [self.cfg.layers[m] for m in members]
+        for m, l, info in zip(members, layers, infos):
+            if not isinstance(l, conv_cls):
+                raise ValueError(
+                    f'fuse_blockdiag: layer {info.name!r} is not a conv')
+            if (l.param.num_group != 1 or len(info.nindex_in) != 1
+                    or len(info.nindex_out) != 1):
+                raise ValueError(
+                    f'fuse_blockdiag: {info.name!r} must be an ungrouped '
+                    f'1-in/1-out conv')
+            if m in self._sibling_groups:
+                # explicit blockdiag spec wins: dissolve the sibling group
+                for s in self._sibling_groups.pop(m):
+                    self._sibling_groups.pop(s, None)
+        p0 = layers[0].param
+        for l, info in zip(layers[1:], infos[1:]):
+            p = l.param
+            if p.stride != p0.stride or p.no_bias != p0.no_bias:
+                raise ValueError(
+                    f'fuse_blockdiag: {info.name!r} stride/bias mismatch')
+            if (2 * p.pad_y - p.kernel_height
+                    != 2 * p0.pad_y - p0.kernel_height
+                    or 2 * p.pad_x - p.kernel_width
+                    != 2 * p0.pad_x - p0.kernel_width):
+                raise ValueError(
+                    f'fuse_blockdiag: {info.name!r} output grid mismatch '
+                    f'(2*pad-kernel must match across the group)')
+        s0 = self.node_specs[infos[0].nindex_in[0]]
+        for info in infos[1:]:
+            s = self.node_specs[info.nindex_in[0]]
+            if (s.y, s.x) != (s0.y, s0.x):
+                raise ValueError(
+                    f'fuse_blockdiag: {info.name!r} input spatial mismatch')
+        # chain fusion is semantically different (members run on the
+        # group's shared pre-state): no member may feed another member
+        member_writes = frozenset().union(*(writes[m] for m in members))
+        for m, info in zip(members, infos):
+            if reads[m] & member_writes:
+                raise ValueError(
+                    f'fuse_blockdiag: {info.name!r} consumes another '
+                    f'member\'s output — chain fusion is not supported')
+
+    def _verify_blockdiag_final(self, reads, writes) -> None:
+        """Cross-group safety net: a LATER group's reorder re-schedules the
+        whole order and could split an earlier group's members apart — and
+        the per-layer version validator cannot see that, because the fused
+        execution reads ALL member inputs at the first member's exec
+        position (not each member's own).  Re-verify every group against
+        the FINAL order: members contiguous, every input version produced
+        before the group starts, and no rewriter of an input node runs
+        before the group starts."""
+        pos = {l: k for k, l in enumerate(self._exec_order)}
+        for members in {tuple(g) for g in self._blockdiag_groups.values()}:
+            names = [self.cfg.layers[m].name for m in members]
+            ps = sorted(pos[m] for m in members)
+            if ps != list(range(ps[0], ps[-1] + 1)):
+                raise ValueError(
+                    f'fuse_blockdiag: groups {names} were torn apart by a '
+                    'later group\'s reorder — no safe combined schedule; '
+                    'reorder or split the group specs')
+            start = ps[0]
+            need = set().union(*(reads[m] for m in members))
+            for l in range(len(self.cfg.layers)):
+                for (n, v) in writes[l]:
+                    for (n2, v2) in need:
+                        if n != n2:
+                            continue
+                        if v <= v2 and pos[l] >= start:
+                            raise ValueError(
+                                f'fuse_blockdiag: group {names} input is '
+                                'not produced before the fused execution '
+                                'point in the combined schedule')
+                        if v > v2 and pos[l] < start:
+                            raise ValueError(
+                                f'fuse_blockdiag: group {names} would read '
+                                'a stale in-place-rewritten input in the '
+                                'combined schedule')
+
+    def _reorder_contiguous(self, order, members, reads, writes):
+        """Move the non-member layers between the group's members out of
+        the way (dependents after, independents before), then verify the
+        new order replays the exact same node-version reads/writes as
+        config order."""
+        pos = {l: k for k, l in enumerate(order)}
+        lo = min(pos[m] for m in members)
+        hi = max(pos[m] for m in members)
+        seg = [order[k] for k in range(lo, hi + 1) if order[k] not in members]
+        # version-aware dependence closure: the members (plus anything
+        # transitively forced after them) form a "moved-later" set; a
+        # segment layer must follow it iff it (a) reads a version the set
+        # writes, (b) rewrites a node past a version the set still reads,
+        # or (c) writes a later version of a node the set writes.  Node
+        # versions give the direction — a producer of a member's input
+        # writes an EARLIER version and correctly stays in front.
+        after: List[int] = []
+        after_reads = set().union(*(reads[m] for m in members))
+        after_writes = set().union(*(writes[m] for m in members))
+        before: List[int] = []
+        for l in seg:
+            true_dep = bool(set(reads[l]) & after_writes)
+            anti_dep = any(n1 == n2 and v2 < v1
+                           for (n1, v1) in writes[l]
+                           for (n2, v2) in after_reads)
+            ww_dep = any(n1 == n2 and v2 < v1
+                         for (n1, v1) in writes[l]
+                         for (n2, v2) in after_writes)
+            if true_dep or anti_dep or ww_dep:
+                after.append(l)
+                after_reads |= set(reads[l])
+                after_writes |= set(writes[l])
+            else:
+                before.append(l)
+        new_order = (order[:lo] + before + sorted(members, key=pos.get)
+                     + after + order[hi + 1:])
+        # full semantic validation: every layer must read/write the same
+        # node versions as in config order
+        ver: Dict[int, int] = {}
+        for l in new_order:
+            info = self.cfg.layers[l]
+            got_r = frozenset((n, ver.get(n, 0)) for n in info.nindex_in)
+            got_w = set()
+            for n in info.nindex_out:
+                ver[n] = ver.get(n, 0) + 1
+                got_w.add((n, ver[n]))
+            if got_r != reads[l] or frozenset(got_w) != writes[l]:
+                raise ValueError(
+                    'fuse_blockdiag: no safe schedule exists for group '
+                    f'{[self.cfg.layers[m].name for m in members]} — layer '
+                    f'{info.name or l!r} would observe different node '
+                    'versions after the reorder')
+        return new_order
+
+    def _fused_blockdiag_outputs(self, params: Params, values,
+                                 members: List[int]):
+        """One conv over channel-concatenated inputs and a block-diagonal
+        weight, split back into the member layers' outputs."""
+        infos = [self.cfg.layers[m] for m in members]
+        layers = [self.layers[m] for m in members]
+        xs = [values[info.nindex_in[0]] for info in infos]
+        x = jnp.concatenate(xs, axis=-1)
+        kh = max(l.param.kernel_height for l in layers)
+        kw = max(l.param.kernel_width for l in layers)
+        p0 = layers[0].param
+        ph = p0.pad_y + (kh - p0.kernel_height) // 2
+        pw = p0.pad_x + (kw - p0.kernel_width) // 2
+        cins = [v.shape[-1] for v in xs]
+        couts = [l.param.num_channel for l in layers]
+        w = jnp.zeros((kh, kw, sum(cins), sum(couts)), x.dtype)
+        ci = co = 0
+        for l, m, cin in zip(layers, members, cins):
+            wm = self._layer_params(params, m)['wmat'].astype(x.dtype)
+            oh = (kh - l.param.kernel_height) // 2
+            ow = (kw - l.param.kernel_width) // 2
+            w = w.at[oh:oh + l.param.kernel_height,
+                     ow:ow + l.param.kernel_width,
+                     ci:ci + cin, co:co + l.param.num_channel].set(wm)
+            ci += cin
+            co += l.param.num_channel
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(p0.stride, p0.stride),
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+        if p0.no_bias == 0:
+            b = jnp.concatenate(
+                [self._layer_params(params, m)['bias'] for m in members]
+            ).astype(x.dtype)
+            out = out + b
+        out = out.astype(x.dtype)
+        splits = np.cumsum(couts)[:-1]
         return jnp.split(out, splits, axis=-1)
 
     # --- shape inference --------------------------------------------------
@@ -257,7 +524,9 @@ class Net:
                 values[1 + k] = ex
         total_loss = jnp.asarray(0.0, jnp.float32)
         fused: Dict[int, jax.Array] = {}
-        for i, info in enumerate(cfg.layers):
+        fused_bd: Dict[int, jax.Array] = {}
+        for i in self._exec_order:
+            info = cfg.layers[i]
             layer = self.layers[i]
             lctx = ForwardContext(is_train=ctx.is_train, rng=ctx.rng,
                                   layer_index=i, round=ctx.round,
@@ -276,6 +545,13 @@ class Net:
                             params, ins[0], members)):
                         fused[m] = v
                 outs = [fused[i]]
+            elif i in self._blockdiag_groups:
+                if i not in fused_bd:   # first member in exec order
+                    members = self._blockdiag_groups[i]
+                    for m, v in zip(members, self._fused_blockdiag_outputs(
+                            params, values, members)):
+                        fused_bd[m] = v
+                outs = [fused_bd[i]]
             else:
                 outs = layer.forward(lp, ins, lctx)
             for j, v in zip(info.nindex_out, outs):

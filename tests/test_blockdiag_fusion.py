@@ -1,0 +1,230 @@
+"""Cross-input block-diagonal conv fusion (``fuse_blockdiag``).
+
+The fused path must be mathematically identical to the unfused graph:
+each member conv's contraction only ever sees its own input block (the
+off-diagonal weight blocks are zero) and the spatial zero-embedding of
+a smaller kernel with grown input padding leaves the output grid
+untouched.  These tests pin equality of forwards, losses, and gradients
+against the plain per-layer execution, plus the scheduling validator's
+rejections (the mechanism ships OFF by default; the GoogLeNet default
+flip is gated on the per-tower breakdown receipt — BASELINE.md).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config_string
+
+
+# An inception-v1-shaped module: two reduce convs off the trunk, then a
+# 3x3(pad1) and a 5x5(pad2) tower conv on DIFFERENT inputs.  The config
+# order interleaves the 5x5 reduce between the tower convs, exactly like
+# models/builders._inception_v1 — so fusing t3+t5 also exercises the
+# schedule reorder + validator.
+_MODULE_CONF = """
+netconfig = start
+layer[0->r3] = conv:r3
+  nchannel = 6
+  kernel_size = 1
+layer[r3->r3] = relu
+layer[r3->t3] = conv:t3
+  nchannel = 8
+  kernel_size = 3
+  pad = 1
+layer[t3->t3] = relu
+layer[0->r5] = conv:r5
+  nchannel = 4
+  kernel_size = 1
+layer[r5->r5] = relu
+layer[r5->t5] = conv:t5
+  nchannel = 5
+  kernel_size = 5
+  pad = 2
+layer[t5->t5] = relu
+layer[0->t0] = conv:t0
+  nchannel = 2
+  kernel_size = 3
+layer[t0->t0] = relu
+layer[t3,t5->cat] = ch_concat
+layer[cat->flat] = flatten
+layer[flat->fc] = fullc:fc
+  nhidden = 3
+layer[fc->fc] = softmax
+netconfig = end
+%s
+input_shape = 3,9,9
+batch_size = 4
+dev = cpu
+eta = 0.05
+momentum = 0.0
+metric[label] = error
+"""
+
+
+def _make_trainer(extra: str) -> NetTrainer:
+    tr = NetTrainer(parse_config_string(_MODULE_CONF % extra))
+    tr.init_model()
+    return tr
+
+
+def _batch(seed=0, n=4):
+    rng = np.random.RandomState(seed)
+    return DataBatch(rng.rand(n, 3, 9, 9).astype(np.float32),
+                     rng.randint(0, 3, n).astype(np.float32).reshape(-1, 1))
+
+
+def _copy_params(src: NetTrainer, dst: NetTrainer) -> None:
+    # real copies: the train step donates param buffers, so aliasing the
+    # source trainer's arrays would delete them out from under it
+    dst.params = jax.tree_util.tree_map(
+        lambda x: jnp.array(x, copy=True), src.params)
+
+
+class TestBlockdiagEquivalence:
+    def test_forward_and_loss_match_unfused(self):
+        plain = _make_trainer('')
+        fused = _make_trainer('fuse_blockdiag = t3+t5')
+        assert fused.net._blockdiag_groups, 'group did not form'
+        _copy_params(plain, fused)
+        b = _batch()
+        pp = np.asarray(plain.predict(b))
+        pf = np.asarray(fused.predict(b))
+        np.testing.assert_allclose(pf, pp, rtol=0, atol=0)
+
+    def test_training_trajectories_match(self):
+        # gradients flow through the block-diagonal assembly (at[].set is
+        # linear): several SGD steps must track the unfused run to fp eps
+        plain = _make_trainer('')
+        fused = _make_trainer('fuse_blockdiag = t3+t5')
+        _copy_params(plain, fused)
+        for i in range(3):
+            b = _batch(seed=i)
+            plain.update(b)
+            fused.update(b)
+        for kp, kf in zip(jax.tree_util.tree_leaves(plain.params),
+                          jax.tree_util.tree_leaves(fused.params)):
+            np.testing.assert_allclose(np.asarray(kf), np.asarray(kp),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_schedule_reorder_validated(self):
+        # the 5x5 reduce sits between t3 and t5 in config order; the
+        # reorder must pull it before the fused block and push t3's
+        # in-place relu after it
+        fused = _make_trainer('fuse_blockdiag = t3+t5')
+        order = fused.net._exec_order
+        assert order != list(range(len(order))), 'reorder must have moved'
+        names = [fused.net.cfg.layers[i].name for i in order]
+        # members contiguous in the new order
+        i3, i5 = names.index('t3'), names.index('t5')
+        assert abs(i3 - i5) == 1
+        # t5's producer chain (the r5 reduce conv) moved before the block
+        assert names.index('r5') < min(i3, i5)
+
+    def test_eval_path_matches(self):
+        plain = _make_trainer('')
+        fused = _make_trainer('fuse_blockdiag = t3+t5')
+        _copy_params(plain, fused)
+        b = _batch(seed=7)
+        ep = plain.evaluate(iter([b]), 'test')
+        ef = fused.evaluate(iter([b]), 'test')
+        assert ep == ef
+
+
+class TestBlockdiagRejections:
+    def test_unknown_layer_name(self):
+        with pytest.raises(ValueError, match='no layer named'):
+            _make_trainer('fuse_blockdiag = t3+nope')
+
+    def test_grid_mismatch(self):
+        # t3 (3x3 pad1, 2p-k=-1) and t0 (3x3 pad0, 2p-k=-3): the padded
+        # output grids differ, no zero-embedding can reconcile them
+        with pytest.raises(ValueError, match='output grid mismatch'):
+            _make_trainer('fuse_blockdiag = t3+t0')
+
+    def test_same_padded_one_by_one_fuses_with_3x3(self):
+        # 1x1 pad0 and 3x3 pad1 share 2p-k=-1: the 1x1 zero-embeds into
+        # the 3x3 center — a real inception pairing (pool-proj vs tower)
+        plain = _make_trainer('')
+        fused = _make_trainer('fuse_blockdiag = r3+t5')
+        _copy_params(plain, fused)
+        b = _batch(seed=11)
+        np.testing.assert_allclose(np.asarray(fused.predict(b)),
+                                   np.asarray(plain.predict(b)),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_chain_fusion_rejected(self):
+        # r5 feeds t5 (through an in-place relu): members may not consume
+        # each other's outputs
+        with pytest.raises(ValueError, match='chain fusion|different node'):
+            _make_trainer('fuse_blockdiag = r5+t5')
+
+    def test_duplicate_membership_rejected(self):
+        with pytest.raises(ValueError, match='appears in two groups'):
+            _make_trainer('fuse_blockdiag = t3+t5;t5+r3')
+
+    def test_tensor_parallel_conflict_raises(self):
+        with pytest.raises(ValueError, match='tensor_parallel'):
+            _make_trainer('fuse_blockdiag = t3+t5\ntensor_parallel = 2')
+
+    def test_cross_group_tear_apart_rejected(self):
+        # order X, A, B, Y with B reading X's output and spec 'a+b;x+y':
+        # group {X,Y}'s reorder classifies A 'before' and B 'after'
+        # (A, X, Y, B), splitting the already-registered {A,B} — the
+        # final-order verification must refuse
+        from cxxnet_tpu.nnet.net import Net
+        from cxxnet_tpu.nnet.net_config import NetConfig
+        conf = """
+netconfig = start
+layer[0->x1] = conv:xc
+  nchannel = 2
+  kernel_size = 1
+layer[0->a1] = conv:ac
+  nchannel = 2
+  kernel_size = 1
+layer[x1->b1] = conv:bc
+  nchannel = 2
+  kernel_size = 1
+layer[0->y1] = conv:yc
+  nchannel = 2
+  kernel_size = 1
+netconfig = end
+fuse_blockdiag = ac+bc;xc+yc
+input_shape = 3,5,5
+"""
+        cfg = NetConfig()
+        cfg.configure(parse_config_string(conf))
+        with pytest.raises(ValueError, match='torn apart|not produced'):
+            Net(cfg)
+
+    def test_off_by_default(self):
+        plain = _make_trainer('')
+        assert plain.net._blockdiag_groups == {}
+        assert plain.net._exec_order == list(range(len(plain.net.layers)))
+
+
+class TestBlockdiagOnGoogLeNetModule:
+    def test_builder_module_fuses_and_matches(self):
+        # the real builder emits in-place relus and lazy reduces; fuse the
+        # 3x3+5x5 towers of one module from the actual GoogLeNet conf and
+        # compare logits on tiny inputs
+        from cxxnet_tpu.models.builders import googlenet_conf
+        conf = googlenet_conf(num_class=4, aux_heads=False)
+        plain = NetTrainer(parse_config_string(
+            conf + '\nbatch_size = 1\ndev = cpu\n'))
+        plain.init_model()
+        fused = NetTrainer(parse_config_string(
+            conf + '\nbatch_size = 1\ndev = cpu\n'
+            'fuse_blockdiag = in3a_3x3+in3a_5x5\n'))
+        fused.init_model()
+        assert fused.net._blockdiag_groups
+        _copy_params(plain, fused)
+        rng = np.random.RandomState(3)
+        b = DataBatch(rng.rand(1, 3, 224, 224).astype(np.float32),
+                      np.zeros((1, 1), np.float32))
+        np.testing.assert_allclose(np.asarray(fused.predict(b)),
+                                   np.asarray(plain.predict(b)),
+                                   rtol=1e-5, atol=1e-6)
