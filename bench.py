@@ -252,6 +252,71 @@ compute_type = bfloat16
                        BASELINE_IMAGES_PER_SEC)
 
 
+def bench_eval_alexnet() -> int:
+    """Net-level EVAL (forward-only) throughput on AlexNet, A/B over the
+    fc8-class Pallas forward gate in ONE receipt.
+
+    The micro receipt (micro_matmul.json) shows the Pallas forward 4.28x
+    over XLA at fc8's non-lane-aligned 256x4096x1000 — this measures
+    whether that survives at net level (fc8 is a sub-ms slice of the
+    step), which decides if the ``fullc_use_pallas`` auto gate stays.
+    ``value`` is the gated (auto) img/s; ``gate_off_images_per_sec`` and
+    ``gate_speedup`` carry the A/B."""
+    from cxxnet_tpu.models import alexnet_conf
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    batch_size = _bench_batch(256)
+    conf = alexnet_conf() + f"""
+batch_size = {batch_size}
+metric = error
+eval_train = 0
+random_type = xavier
+compute_type = bfloat16
+"""
+    trainer = NetTrainer(parse_config_string(conf))
+    trainer.init_model()
+    rng = np.random.RandomState(0)
+    dstack = trainer.shard_batch_stack(
+        rng.randint(0, 256, (4, batch_size, 3, 227, 227), dtype=np.uint8))
+    steps = _bench_steps(30)
+
+    prev = os.environ.get('CXXNET_PALLAS')
+    rates = {}
+    try:
+        for gate, env in (('auto', None), ('off', '0')):
+            if env is None:
+                os.environ.pop('CXXNET_PALLAS', None)
+            else:
+                os.environ['CXXNET_PALLAS'] = env
+            # fresh jit objects per gate setting: the env is read at trace
+            # time, so reusing a compiled fn would ignore the toggle
+            fwd_1 = trainer.compile_multi_forward(1)
+            fwd_k = trainer.compile_multi_forward(steps)
+
+            def run(fn):
+                return float(np.asarray(fn(trainer.params, dstack)))
+
+            per_step, t1s = _quotient_per_step(
+                lambda: run(fwd_1), lambda: run(fwd_k), steps)
+            rates[gate] = batch_size / per_step
+    finally:
+        if prev is None:
+            os.environ.pop('CXXNET_PALLAS', None)
+        else:
+            os.environ['CXXNET_PALLAS'] = prev
+    _emit({
+        'metric': 'alexnet_eval_images_per_sec_per_chip',
+        'value': round(rates['auto'], 1),
+        'unit': 'images/sec',
+        'vs_baseline': None,
+        'gate_off_images_per_sec': round(rates['off'], 1),
+        'gate_speedup': round(rates['auto'] / rates['off'], 4),
+        'timing': 'scan-in-jit K-vs-1 quotient, fwd-only',
+    })
+    return 0
+
+
 def bench_inception_bn() -> int:
     from cxxnet_tpu.models import inception_bn_conf
     batch_size = _bench_batch(128)
@@ -789,6 +854,8 @@ _MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
           'vgg16': ('vgg16_images_per_sec_per_chip', bench_vgg16),
           'e2e_alexnet': ('alexnet_e2e_images_per_sec_per_chip',
                           bench_e2e_alexnet),
+          'eval_alexnet': ('alexnet_eval_images_per_sec_per_chip',
+                           bench_eval_alexnet),
           'io': ('host_io_images_per_sec', bench_io),
           'mnist_tta': ('mnist_time_to_2pct_error', bench_mnist_tta),
           'transformer': ('transformer_tokens_per_sec_per_chip',

@@ -65,8 +65,10 @@ class FullConnectLayer(Layer):
     def forward(self, params, inputs, ctx):
         x = as_mat(inputs[0])
         w = params['wmat'].astype(x.dtype)
-        from ..ops.pallas_kernels import pallas_enabled, pallas_matmul
-        if pallas_enabled():
+        from ..ops.pallas_kernels import fullc_use_pallas, pallas_matmul
+        if fullc_use_pallas(x.shape[0], w.shape[0], w.shape[1],
+                            is_train=ctx.is_train,
+                            spmd_devices=ctx.spmd_devices):
             out = pallas_matmul(x, w)
         else:
             out = jnp.dot(x, w)

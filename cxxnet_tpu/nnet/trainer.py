@@ -401,6 +401,47 @@ class NetTrainer:
         multi_fn.n_steps = n_steps
         return multi_fn
 
+    def compile_multi_forward(self, n_steps: int):
+        """Jitted ``n_steps``-forward-only function (the pred/extract/
+        evaluate compute path — ``is_train=False``, no grads, no
+        optimizer): ONE dispatch scans over a pre-staged batch stack and
+        returns a f32 checksum of the top node, whose fetch is the
+        completion barrier.  Same rationale as :meth:`compile_multi_step`
+        (per-dispatch timing over the dev-harness tunnel measures the
+        link); used by ``bench.py eval_alexnet`` to time eval throughput
+        at net level (the fc8-class Pallas forward gate —
+        ``ops.pallas_kernels.fullc_use_pallas`` — only ever engages on
+        this path)."""
+        net = self.net
+        compute_dtype = self.compute_dtype
+        max_round = self.max_round
+        spmd = self._mesh.devices.size
+        top = net.cfg.layers[-1].nindex_out[-1]
+
+        @jax.jit
+        def multi_fwd(params, data_stack, rnd, norm=()):
+            nstack = data_stack.shape[0]
+
+            def body(acc, t):
+                data = jax.lax.dynamic_index_in_dim(
+                    data_stack, t % nstack, keepdims=False)
+                data = _apply_input_norm(data, norm)
+                ctx = ForwardContext(is_train=False, rng=None, round=rnd,
+                                     max_round=max_round,
+                                     compute_dtype=compute_dtype,
+                                     spmd_devices=spmd)
+                values, _ = net.forward(params, data, ctx)
+                return acc + jnp.sum(values[top].astype(jnp.float32)), None
+
+            acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(n_steps))
+            return acc
+
+        def fwd_fn(params, data_stack, rnd=0, norm=()):
+            return multi_fwd(params, data_stack, rnd, norm)
+
+        fwd_fn.n_steps = n_steps
+        return fwd_fn
+
     def shard_batch_stack(self, stack: np.ndarray, cast: bool = True):
         """Stage a (nstack, batch, ...) stack of batches on device with the
         batch axis (axis 1) sharded over the mesh's data axis."""

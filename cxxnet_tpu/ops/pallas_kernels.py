@@ -83,6 +83,33 @@ def attn_use_flash(seq_len: int, batch: int = 1, heads: int = 1) -> bool:
             and score_bytes >= _FLASH_SCORE_BYTES)
 
 
+def fullc_use_pallas(m: int, k: int, n: int, *, is_train: bool,
+                     spmd_devices: int = 1) -> bool:
+    """Whether fullc's forward matmul should take the Pallas kernel.
+
+    Training keeps XLA everywhere: with honest (scatter-add-perturbed)
+    timing the fwd+bwd kernels lose at every production shape
+    (receipts/micro_matmul.json).  The exception this gate encodes is
+    the EVAL path at fc8's shape class: at 256x4096x1000 the Pallas
+    forward measured **4.28x** over XLA — XLA mishandles the
+    non-lane-aligned N=1000 (48.7 TF/s) while the padded Pallas tiles
+    don't care.  ``auto`` therefore engages only when no backward will
+    run (``is_train=False`` — pred/extract/evaluate forwards), on a
+    real single-device TPU program, at the measured shape class:
+    lane-ragged N (``n % 128 != 0``) big enough to matter
+    (m >= 128, k >= 1024, n >= 512).  Anything narrower was never
+    measured and stays on XLA; ``use_pallas=1`` still forces the
+    kernel everywhere, ``0`` disables it."""
+    mode = pallas_mode()
+    if mode == 'off':
+        return False
+    if mode == 'on':
+        return True
+    if is_train or _interpret() or spmd_devices != 1:
+        return False
+    return n % 128 != 0 and m >= 128 and k >= 1024 and n >= 512
+
+
 def lrn_auto_mode(c: int, spmd_devices: int = 1) -> str:
     """Which LRN implementation the ``auto`` Pallas mode picks at channel
     count ``c``: ``'full'`` (Pallas fwd+bwd), ``'hybrid'`` (Pallas fwd /

@@ -1,0 +1,88 @@
+"""The fc8-class eval-path Pallas forward gate (``fullc_use_pallas``).
+
+The auto gate may only engage where the receipt measured a win:
+forward-only (no backward will run), single-device, real TPU, at
+lane-ragged N big enough to matter (micro_matmul.json fc8 row, 4.28x).
+Everything else — training, SPMD, interpret/CPU, aligned or small
+shapes — stays on XLA.
+"""
+
+import numpy as np
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.ops.pallas_kernels import fullc_use_pallas
+from cxxnet_tpu.utils.config import parse_config_string
+
+
+class TestGateDecisions:
+    def test_training_never_engages(self):
+        assert not fullc_use_pallas(256, 4096, 1000, is_train=True)
+
+    def test_spmd_never_engages(self):
+        assert not fullc_use_pallas(256, 4096, 1000, is_train=False,
+                                    spmd_devices=8)
+
+    def test_aligned_n_stays_xla(self):
+        # fc6/fc7: N % 128 == 0 — XLA is at parity or better there
+        assert not fullc_use_pallas(256, 9216, 4096, is_train=False)
+        assert not fullc_use_pallas(256, 4096, 4096, is_train=False)
+
+    def test_small_ragged_shapes_stay_xla(self):
+        # 10-class MNIST head: ragged but narrow — never measured
+        assert not fullc_use_pallas(100, 128, 10, is_train=False)
+        assert not fullc_use_pallas(64, 512, 1000, is_train=False)
+
+    def test_forced_modes_win(self, monkeypatch):
+        monkeypatch.setenv('CXXNET_PALLAS', '1')
+        assert fullc_use_pallas(1, 1, 1, is_train=True)
+        monkeypatch.setenv('CXXNET_PALLAS', '0')
+        assert not fullc_use_pallas(256, 4096, 1000, is_train=False)
+
+    def test_fc8_class_gated_on_interpret_only_off_chip(self):
+        # on this CPU host the interpret guard keeps auto off; the shape
+        # class itself is the one the receipt measured (the on-chip run
+        # flips the remaining condition)
+        got = fullc_use_pallas(256, 4096, 1000, is_train=False)
+        assert got is False  # CPU/interpret environment
+
+
+_CONF = """
+netconfig = start
+layer[0->1] = fullc:fc1
+  nhidden = 32
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 4
+layer[3->3] = softmax
+netconfig = end
+input_shape = 1,1,64
+batch_size = 8
+dev = cpu
+eta = 0.1
+metric[label] = error
+"""
+
+
+class TestMultiForward:
+    def test_multi_forward_matches_repeated_predict_path(self):
+        # the scanned forward-only loop is the eval-bench compute path;
+        # its checksum over a 1-batch stack must equal the plain forward
+        tr = NetTrainer(parse_config_string(_CONF))
+        tr.init_model()
+        rng = np.random.RandomState(0)
+        data = rng.rand(8, 1, 1, 64).astype(np.float32)
+        stack = tr.shard_batch_stack(data[None])
+        fwd1 = tr.compile_multi_forward(1)
+        fwd3 = tr.compile_multi_forward(3)
+        a = float(np.asarray(fwd1(tr.params, stack)))
+        b = float(np.asarray(fwd3(tr.params, stack)))
+        # same batch scanned 3x: checksum triples exactly (eval path is
+        # deterministic — no dropout rng, no param mutation)
+        np.testing.assert_allclose(b, 3 * a, rtol=1e-5)
+        # and the checksum agrees with the ordinary predict-path forward
+        vals = tr._forward_nodes(DataBatch(data, None),
+                                 [tr.net.cfg.layers[-1].nindex_out[-1]])
+        np.testing.assert_allclose(a, np.asarray(vals[0],
+                                                 np.float32).sum(),
+                                   rtol=1e-5)
