@@ -281,14 +281,17 @@ compute_type = bfloat16
         rng.randint(0, 256, (4, batch_size, 3, 227, 227), dtype=np.uint8))
     steps = _bench_steps(30)
 
-    prev = os.environ.get('CXXNET_PALLAS')
+    # the off leg uses the fullc-only kill switch: CXXNET_PALLAS=0 would
+    # also disable the LRN auto winners and credit their delta to this
+    # gate
+    prev = os.environ.get('CXXNET_FULLC_PALLAS')
     rates = {}
     try:
         for gate, env in (('auto', None), ('off', '0')):
             if env is None:
-                os.environ.pop('CXXNET_PALLAS', None)
+                os.environ.pop('CXXNET_FULLC_PALLAS', None)
             else:
-                os.environ['CXXNET_PALLAS'] = env
+                os.environ['CXXNET_FULLC_PALLAS'] = env
             # fresh jit objects per gate setting: the env is read at trace
             # time, so reusing a compiled fn would ignore the toggle
             fwd_1 = trainer.compile_multi_forward(1)
@@ -302,9 +305,9 @@ compute_type = bfloat16
             rates[gate] = batch_size / per_step
     finally:
         if prev is None:
-            os.environ.pop('CXXNET_PALLAS', None)
+            os.environ.pop('CXXNET_FULLC_PALLAS', None)
         else:
-            os.environ['CXXNET_PALLAS'] = prev
+            os.environ['CXXNET_FULLC_PALLAS'] = prev
     _emit({
         'metric': 'alexnet_eval_images_per_sec_per_chip',
         'value': round(rates['auto'], 1),

@@ -105,8 +105,19 @@ def fullc_use_pallas(m: int, k: int, n: int, *, is_train: bool,
         return False
     if mode == 'on':
         return True
+    if os.environ.get('CXXNET_FULLC_PALLAS', '').strip() == '0':
+        # fullc-only kill switch: lets bench.py eval_alexnet A/B THIS
+        # gate in isolation — CXXNET_PALLAS=0 would also flip the LRN
+        # auto winners and confound the receipt
+        return False
     if is_train or _interpret() or spmd_devices != 1:
         return False
+    return fullc_pallas_shape_class(m, k, n)
+
+
+def fullc_pallas_shape_class(m: int, k: int, n: int) -> bool:
+    """The measured fc8 shape class (receipts/micro_matmul.json):
+    lane-ragged N big enough to matter."""
     return n % 128 != 0 and m >= 128 and k >= 1024 and n >= 512
 
 

@@ -39,12 +39,28 @@ class TestGateDecisions:
         monkeypatch.setenv('CXXNET_PALLAS', '0')
         assert not fullc_use_pallas(256, 4096, 1000, is_train=False)
 
-    def test_fc8_class_gated_on_interpret_only_off_chip(self):
-        # on this CPU host the interpret guard keeps auto off; the shape
-        # class itself is the one the receipt measured (the on-chip run
-        # flips the remaining condition)
-        got = fullc_use_pallas(256, 4096, 1000, is_train=False)
-        assert got is False  # CPU/interpret environment
+    def test_fc8_shape_class_predicate(self):
+        # the environment-independent half of the gate: the measured fc8
+        # class is in, fc6/fc7/narrow heads are out
+        from cxxnet_tpu.ops.pallas_kernels import fullc_pallas_shape_class
+        assert fullc_pallas_shape_class(256, 4096, 1000)
+        assert not fullc_pallas_shape_class(256, 9216, 4096)
+        assert not fullc_pallas_shape_class(100, 128, 10)
+
+    def test_interpret_hosts_keep_auto_off(self):
+        import jax
+        if jax.default_backend() != 'cpu':
+            import pytest
+            pytest.skip('gate legitimately engages on a real TPU backend')
+        assert not fullc_use_pallas(256, 4096, 1000, is_train=False)
+
+    def test_fullc_only_kill_switch(self, monkeypatch):
+        # the eval bench's off leg: disables this gate without touching
+        # pallas_mode (the LRN winners stay as-is)
+        from cxxnet_tpu.ops.pallas_kernels import pallas_mode
+        monkeypatch.setenv('CXXNET_FULLC_PALLAS', '0')
+        assert pallas_mode() == 'auto'
+        assert not fullc_use_pallas(256, 4096, 1000, is_train=False)
 
 
 _CONF = """

@@ -4,6 +4,9 @@
 # Safe to relaunch any number of times (e.g. as a round's first action
 # after a restart killed the previous watcher — the exact round-3/4
 # failure mode).  Per-step tunnel gate; receipts committed as they land.
+# Helpers (receipt_ok / run_bench_receipt / run_tool_receipt) live in
+# tools/tunnel_lib.sh — the shared home for the receipt-validity
+# contract.
 #
 #   nohup bash tools/run_chip_pending.sh &
 #
@@ -16,50 +19,14 @@ mkdir -p "$OUT"
 cd "$REPO" || exit 1
 . tools/tunnel_lib.sh
 
-# receipt_ok <file> — 0 when the receipt exists, parses, and is neither
-# partial nor error-marked (a null value also counts as failed)
-receipt_ok() {
-    python - "$1" <<'EOF'
-import json, sys
-try:
-    d = json.load(open(sys.argv[1]))
-except Exception:
-    raise SystemExit(1)
-bad = (d.get('error') is not None or d.get('partial')
-       or d.get('superseded')          # marked for re-measure (e.g.
-                                       # contended host, suspect baseline)
-       or ('value' in d and d['value'] is None))
-raise SystemExit(1 if bad else 0)
-EOF
-}
-
-run_bench() {    # $1 mode, $2 receipt basename — bench.py JSON-on-stdout
-    f="$OUT/$2"
-    if receipt_ok "$f"; then echo "skip $2 (receipt ok)"; return; fi
-    wait_tunnel "$OUT/pending.marker"
-    timeout 2700 python bench.py "$1" > "$f" 2>"$OUT/$2.log" ||
-        [ -s "$f" ] || echo '{"metric":"'"$1"'","value":null,"error":"killed/timeout"}' > "$f"
-    save_receipts "$f" "$OUT/$2.log"
-}
-
-run_tool() {     # $1 receipt basename, $2... command — tools with --json
-    f="$OUT/$1.json"
-    log="$OUT/$1.log"
-    shift
-    if receipt_ok "$f"; then echo "skip $(basename "$f") (receipt ok)"; return; fi
-    wait_tunnel "$OUT/pending.marker"
-    timeout 2700 "$@" --json "$f" > "$log" 2>&1
-    save_receipts "$f" "$log"
-}
-
 echo "=== WALL-CLOCK-SENSITIVE (keep host idle) ==="
-run_bench mnist_tta    bench_mnist_tta.json
-run_bench e2e_alexnet  bench_e2e_devnorm.json
+run_bench_receipt mnist_tta    bench_mnist_tta.json
+run_bench_receipt e2e_alexnet  bench_e2e_devnorm.json
 echo "=== ON-DEVICE-TIMED ==="
-run_tool micro_matmul_bwd    python tools/pallas_microbench.py --only matmul_bwd
-run_tool alexnet_breakdown   python tools/alexnet_breakdown.py
-run_tool googlenet_breakdown python tools/alexnet_breakdown.py --model googlenet
-run_tool micro_matmul_tiles  python tools/pallas_microbench.py --only matmul_tiles
-run_bench transformer  bench_transformer.json
-run_tool conv_lowering python tools/conv_lowering_bench.py
+run_tool_receipt micro_matmul_bwd    python tools/pallas_microbench.py --only matmul_bwd
+run_tool_receipt alexnet_breakdown   python tools/alexnet_breakdown.py
+run_tool_receipt googlenet_breakdown python tools/alexnet_breakdown.py --model googlenet
+run_tool_receipt micro_matmul_tiles  python tools/pallas_microbench.py --only matmul_tiles
+run_bench_receipt transformer  bench_transformer.json
+run_tool_receipt conv_lowering python tools/conv_lowering_bench.py
 echo "pending suite done"

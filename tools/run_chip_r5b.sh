@@ -2,8 +2,8 @@
 # Round-5 chained chip runner: waits for run_chip_pending.sh to drain,
 # then lands the NEW round-5 receipts (eval-path fc8 gate A/B).  Safe to
 # relaunch (receipt_ok skip); per-step tunnel gate; receipts committed
-# as they land.  Separate file because editing a script bash is
-# currently executing corrupts the running instance.
+# as they land.  Separate file because replacing a script bash is
+# currently executing needs a rename, not an in-place edit.
 #
 #   nohup bash tools/run_chip_r5b.sh &
 set -x
@@ -17,28 +17,5 @@ while pgrep -f 'bash tools/run_chip_pending.sh' > /dev/null; do
     sleep 120
 done
 
-receipt_ok() {
-    python - "$1" <<'EOF'
-import json, sys
-try:
-    d = json.load(open(sys.argv[1]))
-except Exception:
-    raise SystemExit(1)
-bad = (d.get('error') is not None or d.get('partial')
-       or d.get('superseded')
-       or ('value' in d and d['value'] is None))
-raise SystemExit(1 if bad else 0)
-EOF
-}
-
-run_bench() {
-    f="$OUT/$2"
-    if receipt_ok "$f"; then echo "skip $2 (receipt ok)"; return; fi
-    wait_tunnel "$OUT/pending.marker"
-    timeout 2700 python bench.py "$1" > "$f" 2>"$OUT/$2.log" ||
-        [ -s "$f" ] || echo '{"metric":"'"$1"'","value":null,"error":"killed/timeout"}' > "$f"
-    save_receipts "$f" "$OUT/$2.log"
-}
-
-run_bench eval_alexnet bench_eval_alexnet.json
+run_bench_receipt eval_alexnet bench_eval_alexnet.json
 echo "r5b suite done"

@@ -39,3 +39,42 @@ wait_tunnel() {
         echo "tunnel up at $(date -u) (waited ~${waited}s)" >> "$marker"
     fi
 }
+
+# receipt_ok <file> — 0 when the receipt exists, parses, and is neither
+# partial, superseded, nor error-marked (a null value also counts as
+# failed).  THE definition of "this step already ran" for every
+# idempotent runner — change it here, not in the runner scripts.
+receipt_ok() {
+    python - "$1" <<'EOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+except Exception:
+    raise SystemExit(1)
+bad = (d.get('error') is not None or d.get('partial')
+       or d.get('superseded')
+       or ('value' in d and d['value'] is None))
+raise SystemExit(1 if bad else 0)
+EOF
+}
+
+# run_bench_receipt <mode> <receipt-basename> — bench.py JSON-on-stdout
+# into $OUT/<basename>, skip-if-ok, tunnel-gated, committed on landing.
+run_bench_receipt() {
+    local f="$OUT/$2"
+    if receipt_ok "$f"; then echo "skip $2 (receipt ok)"; return; fi
+    wait_tunnel "$OUT/pending.marker"
+    timeout 2700 python bench.py "$1" > "$f" 2>"$OUT/$2.log" ||
+        [ -s "$f" ] || echo '{"metric":"'"$1"'","value":null,"error":"killed/timeout"}' > "$f"
+    save_receipts "$f" "$OUT/$2.log"
+}
+
+# run_tool_receipt <receipt-basename> <command>... — tools with --json
+run_tool_receipt() {
+    local f="$OUT/$1.json" log="$OUT/$1.log"
+    shift
+    if receipt_ok "$f"; then echo "skip $(basename "$f") (receipt ok)"; return; fi
+    wait_tunnel "$OUT/pending.marker"
+    timeout 2700 "$@" --json "$f" > "$log" 2>&1
+    save_receipts "$f" "$log"
+}
