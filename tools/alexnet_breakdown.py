@@ -39,7 +39,7 @@ os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '2')
 # before jax — a bare `import jax` hangs on plugin discovery when the
 # tunnel is half-down, even for CPU-only runs (this exact tool sat at
 # 0 output for 10+ minutes before the ordering mattered)
-from chiptime import time_op                                   # noqa: E402
+from chiptime import atomic_receipt_dump, time_op              # noqa: E402
 
 import jax                                                     # noqa: E402
 import jax.numpy as jnp                                        # noqa: E402
@@ -126,25 +126,17 @@ compute_type = {args.dtype}
     rows = []
 
     def dump(partial: bool) -> None:
-        # write (atomically) after EVERY layer: a killed/timed-out run
-        # must still leave the rows it produced — losing a finished
-        # measurement to a round-end kill is the round-3 failure mode
-        # the receipts discipline exists to prevent
-        if not args.json:
-            return
-        payload = {'model': args.model, 'batch': bs,
-                   'step_ms': round(t_step * 1e3, 2) if t_step else None,
-                   'fwd_ms': round(t_fwd * 1e3, 2) if t_fwd else None,
-                   'achieved_tflops':
-                       round(step_flops / t_step / 1e12, 2)
-                       if t_step and step_flops else None,
-                   'layers': rows}
-        if partial:
-            payload['partial'] = True
-        tmp = args.json + '.tmp'
-        with open(tmp, 'w') as f:
-            json.dump(payload, f, indent=1)
-        os.replace(tmp, args.json)
+        # after EVERY layer: a killed/timed-out run must still leave the
+        # rows it produced — losing a finished measurement to a
+        # round-end kill is the round-3 failure mode the receipts
+        # discipline exists to prevent
+        atomic_receipt_dump(args.json, {
+            'model': args.model, 'batch': bs,
+            'step_ms': round(t_step * 1e3, 2) if t_step else None,
+            'fwd_ms': round(t_fwd * 1e3, 2) if t_fwd else None,
+            'achieved_tflops': round(step_flops / t_step / 1e12, 2)
+                               if t_step and step_flops else None,
+            'layers': rows}, partial)
 
     dump(partial=True)
     for i, info in enumerate(net.cfg.layers):

@@ -153,3 +153,29 @@ def time_op(fn, args, iters: int = None, reps: int = 5,
         float(np.asarray(f_n(*args)))
         tns.append(time.perf_counter() - t0)
     return (min(tns) - min(t1s)) / (iters - 1)
+
+
+def atomic_receipt_dump(path, payload, partial: bool) -> None:
+    """Atomic (tmp + os.replace) JSON receipt write — THE dump helper for
+    every receipt-producing tool; keep the contract here, next to the
+    timing loop, not copy-pasted per tool.
+
+    ``partial=True`` keeps the receipt re-runnable by the idempotent
+    runners (tools/tunnel_lib.sh ``receipt_ok`` treats partial as
+    not-landed); call once more with ``partial=False`` only when every
+    row is final.  Rewrite after EVERY row: a tunnel wedge mid-suite
+    must never cost a finished measurement (the round-4 tile sweep lost
+    its JSON exactly this way), and the tmp+replace means a mid-write
+    kill can't leave a truncated non-empty unparseable file."""
+    import json
+    if not path:
+        return
+    payload = dict(payload)
+    if partial:
+        payload['partial'] = True
+    else:
+        payload.pop('partial', None)
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)

@@ -21,7 +21,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from chiptime import grad_probe, time_op  # noqa: E402
+from chiptime import atomic_receipt_dump, grad_probe, time_op  # noqa: E402
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -114,14 +114,9 @@ def main():
 
 
 def _dump_json(path, dev, results, partial):
-    payload = {'device': dev.device_kind, 'dtype': 'bfloat16',
-               'results': results}
-    if partial:
-        payload['partial'] = True
-    tmp = path + '.tmp'
-    with open(tmp, 'w') as f:
-        json.dump(payload, f, indent=1)
-    os.replace(tmp, path)
+    atomic_receipt_dump(path, {'device': dev.device_kind,
+                               'dtype': 'bfloat16', 'results': results},
+                        partial)
 
 
 if __name__ == '__main__':

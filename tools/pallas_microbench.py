@@ -32,7 +32,7 @@ os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '2')
 # chiptime FIRST: its preamble imports the cxxnet_tpu platform shim
 # before jax, so CPU-mode runs can't hang on plugin discovery during
 # tunnel outages
-from chiptime import grad_probe, time_op                       # noqa: E402
+from chiptime import atomic_receipt_dump, grad_probe, time_op  # noqa: E402
 
 import jax                                                     # noqa: E402
 import jax.numpy as jnp                                        # noqa: E402
@@ -107,19 +107,10 @@ def main() -> int:
     rng = np.random.RandomState(0)
 
     def dump(rows, partial: bool) -> None:
-        # rewrite (atomically) after every row: a tunnel wedge mid-suite
-        # already cost one tile sweep its JSON (only the .log survived) —
-        # finished measurements must not die with the process
-        if not args.json:
-            return
-        payload = {'device': dev.device_kind, 'dtype': args.dtype,
-                   'results': list(rows)}
-        if partial:
-            payload['partial'] = True
-        tmp = args.json + '.tmp'
-        with open(tmp, 'w') as f:
-            json.dump(payload, f, indent=1)
-        os.replace(tmp, args.json)
+        atomic_receipt_dump(args.json,
+                            {'device': dev.device_kind,
+                             'dtype': args.dtype, 'results': list(rows)},
+                            partial)
 
     class _DumpingList(list):
         def append(self, row):

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-5 chained chip runner, stage c: waits for r5b, then lands the
+# flash-engage receipt (VERDICT r4 task 5's second half).  Idempotent;
+# helpers from tools/tunnel_lib.sh.
+#
+#   nohup bash tools/run_chip_r5c.sh &
+set -x
+REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
+OUT=${OUT:-$REPO/receipts}
+mkdir -p "$OUT"
+cd "$REPO" || exit 1
+. tools/tunnel_lib.sh
+
+# wait for BOTH upstream stages: r5b alone is not enough — if r5b is
+# already done (or not yet in the process table) while the pending
+# suite's wall-clock-sensitive benches still run, the probe would share
+# the single host core with them and contaminate those receipts
+while pgrep -f 'bash tools/run_chip_pending.sh\|bash tools/run_chip_r5b.sh' > /dev/null; do
+    sleep 120
+done
+
+run_tool_receipt flash_engage python tools/flash_engage_probe.py
+echo "r5c suite done"
