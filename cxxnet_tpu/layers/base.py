@@ -172,7 +172,7 @@ class LayerParam:
         if name == 'temp_col_max':
             self.temp_col_max = int(val) << 18
         if name == 'conv_lowering':
-            if val not in ('auto', 'native', 'im2col', 'split'):
+            if val not in ('auto', 'native', 'im2col', 'split', 's2d'):
                 raise ValueError(f'conv_lowering: unknown mode {val}')
             self.conv_lowering = val
 

@@ -49,6 +49,47 @@ def conv_im2col(x, w, strides, pad):
     return (pat.reshape(b * oy * ox, k) @ w2).reshape(b, oy, ox, cout)
 
 
+def conv_s2d(x, w, strides, pad):
+    """Space-to-depth lowering for strided convs on shallow inputs
+    (AlexNet conv1: 11x11 s4 on c=3).  Rearranging each stride-sized
+    pixel block into channels turns the stride-s conv into a stride-1
+    conv whose contraction is ``s*s*c`` deep (conv1: 48, and the
+    ceil(k/s)=3-tap kernel contracts 3*3*48=432 per output) — the MXU
+    fill of im2col WITHOUT materializing the patch tensor (the s2d
+    input is the same bytes as the input; the kernel rearrangement is
+    weight-sized).  The MLPerf-era TPU ResNet entry-conv trick, applied
+    as a general lowering.  Math: with the kernel zero-padded to
+    ``K = ceil(k/s)*s``, ``y[o] = sum_u x[o*s+u] w[u]`` regroups by
+    ``u = a*s + r`` into a stride-1 conv over block index ``a`` with
+    ``(r, c)`` as channels — exact, so backward comes from AD through
+    the reshapes.  Requires pad % stride == 0 (the pad folds into
+    explicit zeros first); callers degrade to native otherwise."""
+    sy, sx = strides
+    (py_lo, py_hi), (px_lo, px_hi) = pad
+    b, _, _, c = x.shape
+    kh, kw, cin, cout = w.shape
+    x = jnp.pad(x, ((0, 0), (py_lo, py_hi), (px_lo, px_hi), (0, 0)))
+    h2, w2 = x.shape[1], x.shape[2]
+    out_h = (h2 - kh) // sy + 1
+    out_w = (w2 - kw) // sx + 1
+    bkh, bkw = -(-kh // sy), -(-kw // sx)       # kernel taps in blocks
+    wp = jnp.pad(w, ((0, bkh * sy - kh), (0, bkw * sx - kw),
+                     (0, 0), (0, 0)))
+    # input must cover block (out-1)+bk-1 on each axis
+    hp = max(-(-h2 // sy), out_h - 1 + bkh) * sy
+    wpx = max(-(-w2 // sx), out_w - 1 + bkw) * sx
+    x = jnp.pad(x, ((0, 0), (0, hp - h2), (0, wpx - w2), (0, 0)))
+    xb = x.reshape(b, hp // sy, sy, wpx // sx, sx, c)
+    xb = xb.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, hp // sy, wpx // sx, sy * sx * c)
+    wb = wp.reshape(bkh, sy, bkw, sx, cin, cout)
+    wb = wb.transpose(0, 2, 1, 3, 4, 5).reshape(
+        bkh, bkw, sy * sx * cin, cout)
+    out = lax.conv_general_dilated(xb, wb, (1, 1), ((0, 0), (0, 0)),
+                                   dimension_numbers=_DN)
+    return out[:, :out_h, :out_w, :]
+
+
 def conv_split(x, w, strides, pad, groups):
     """Per-group convs + concat instead of feature_group_count: lets XLA
     pick each group's layout independently (grouped convs halve the
@@ -112,10 +153,16 @@ class ConvolutionLayer(Layer):
         # each variant degrades to native on the shapes it does not
         # target, so the knob is usable as a netconfig GLOBAL (replayed
         # into every layer): im2col targets ungrouped convs, split
-        # grouped ones
+        # grouped ones, s2d ungrouped strided convs with stride-aligned
+        # padding
         if mode == 'split' and self.param.num_group == 1:
             return 'native'
         if mode == 'im2col' and self.param.num_group != 1:
+            return 'native'
+        if mode == 's2d' and (self.param.num_group != 1
+                              or self.param.stride <= 1
+                              or self.param.pad_y % self.param.stride
+                              or self.param.pad_x % self.param.stride):
             return 'native'
         return mode
 
@@ -131,6 +178,8 @@ class ConvolutionLayer(Layer):
         mode = self._lowering()
         if mode == 'im2col':
             out = conv_im2col(x, w, strides, pad)
+        elif mode == 's2d':
+            out = conv_s2d(x, w, strides, pad)
         elif mode == 'split':
             out = conv_split(x, w, strides, pad, p.num_group)
         else:

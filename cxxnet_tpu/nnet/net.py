@@ -486,11 +486,31 @@ class Net:
         casting on device quarters host->device traffic."""
         batch = batch.astype(compute_dtype)
         if batch.ndim == 2:
+            spec = self.node_specs[0]
+            if not spec.is_mat:
+                # a conv-shaped net fed flat vectors dies later inside a
+                # dot_general with a useless shape message — name the
+                # actual fix here (hit via iter=mnist, whose default
+                # input_flat=1 flattens, matching the reference)
+                raise ValueError(
+                    f'input batch is flat ({batch.shape[1]}-vectors) but '
+                    f'input_shape expects {spec.c}x{spec.y}x{spec.x} '
+                    f'images — set input_flat=0 on the data iterator or '
+                    f'use a flat input_shape')
             return batch
         if batch.ndim == 4:
             spec = self.node_specs[0]
             if spec.is_mat:
                 return batch.reshape(batch.shape[0], -1)
+            if batch.shape[1:] != (spec.c, spec.y, spec.x):
+                # a conv-shaped net fed mislaid data (classic: iter=mnist
+                # keeps its reference default input_flat=1 and emits
+                # (n,1,1,784)) dies later inside a dot_general/conv with
+                # a useless shape message — name the actual fix here
+                raise ValueError(
+                    f'input batch {batch.shape[1:]} does not match '
+                    f'input_shape {spec.c},{spec.y},{spec.x} — for '
+                    f'iter=mnist set input_flat=0 to keep images unflat')
             return jnp.transpose(batch, (0, 2, 3, 1))
         raise ValueError(f'bad input batch rank {batch.ndim}')
 

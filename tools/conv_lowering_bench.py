@@ -29,7 +29,7 @@ import jax.numpy as jnp  # noqa: E402
 # time the SHIPPED lowerings — the receipt decides conv.py's auto policy,
 # so it must measure the code that policy gates, not a copy
 from cxxnet_tpu.layers.conv import (conv_im2col, conv_native,  # noqa: E402
-                                    conv_split)
+                                    conv_s2d, conv_split)
 
 # (name, batch, in_y/x, cin, cout, kernel, stride, pad, ngroup)
 SHAPES = [
@@ -46,6 +46,8 @@ def lowering_fns(k, stride, pad, g):
     out = {'native': lambda x, w: conv_native(x, w, strides, padding, g)}
     if g == 1:
         out['im2col'] = lambda x, w: conv_im2col(x, w, strides, padding)
+        if stride > 1 and pad % stride == 0:
+            out['s2d'] = lambda x, w: conv_s2d(x, w, strides, padding)
     else:
         out['split'] = lambda x, w: conv_split(x, w, strides, padding, g)
     return out

@@ -1,7 +1,7 @@
 #!/bin/bash
-# Round-5 chained chip runner, stage c: waits for r5b, then lands the
-# flash-engage receipt (VERDICT r4 task 5's second half).  Idempotent;
-# helpers from tools/tunnel_lib.sh.
+# Round-5 chained chip runner, stage c: waits for the pending suite AND
+# r5b, then lands the flash-engage receipt (VERDICT r4 task 5's second
+# half).  Idempotent; helpers from tools/tunnel_lib.sh.
 #
 #   nohup bash tools/run_chip_r5c.sh &
 set -x
@@ -11,11 +11,13 @@ mkdir -p "$OUT"
 cd "$REPO" || exit 1
 . tools/tunnel_lib.sh
 
-# wait for BOTH upstream stages: r5b alone is not enough — if r5b is
-# already done (or not yet in the process table) while the pending
-# suite's wall-clock-sensitive benches still run, the probe would share
-# the single host core with them and contaminate those receipts
-while pgrep -f 'bash tools/run_chip_pending.sh\|bash tools/run_chip_r5b.sh' > /dev/null; do
+# wait for BOTH upstream stages (two pgrep calls: a \| inside one -f
+# pattern is a literal pipe in pgrep's ERE and never matches): if the
+# pending suite's wall-clock-sensitive benches still run, the probe
+# would share the single host core with them and contaminate those
+# receipts
+while pgrep -f '^bash tools/run_chip_pending.sh' > /dev/null ||
+      pgrep -f '^bash tools/run_chip_r5b.sh' > /dev/null; do
     sleep 120
 done
 
