@@ -233,6 +233,15 @@ def _bench_batch(default: int) -> int:
     return int(os.environ.get('CXXNET_BENCH_BATCH', default))
 
 
+def _extra_conf() -> str:
+    """``CXXNET_BENCH_CONF_EXTRA`` appends config lines (';'-separated)
+    to every model bench conf — the A/B hook for execution-plan knobs
+    (e.g. ``fuse_blockdiag = auto`` for the GoogLeNet tower-fusion
+    receipt) without a bench.py edit per experiment."""
+    extra = os.environ.get('CXXNET_BENCH_CONF_EXTRA', '').strip()
+    return (extra.replace(';', '\n') + '\n') if extra else ''
+
+
 def bench_alexnet() -> int:
     from cxxnet_tpu.models import alexnet_conf
     batch_size = _bench_batch(256)
@@ -246,7 +255,7 @@ metric = error
 eval_train = 0
 random_type = xavier
 compute_type = bfloat16
-"""
+""" + _extra_conf()
     return _throughput(conf, batch_size, (3, 227, 227),
                        'alexnet_images_per_sec_per_chip',
                        BASELINE_IMAGES_PER_SEC)
@@ -273,7 +282,7 @@ metric = error
 eval_train = 0
 random_type = xavier
 compute_type = bfloat16
-"""
+""" + _extra_conf()
     trainer = NetTrainer(parse_config_string(conf))
     trainer.init_model()
     rng = np.random.RandomState(0)
@@ -331,7 +340,7 @@ metric = error
 eval_train = 0
 random_type = xavier
 compute_type = bfloat16
-"""
+""" + _extra_conf()
     return _throughput(conf, batch_size, (3, 224, 224),
                        'inception_bn_images_per_sec_per_chip',
                        BASELINE_INCEPTION_IMAGES_PER_SEC)
@@ -348,7 +357,7 @@ metric = error
 eval_train = 0
 random_type = xavier
 compute_type = bfloat16
-"""
+""" + _extra_conf()
     return _throughput(conf, batch_size, (3, 224, 224),
                        'googlenet_images_per_sec_per_chip',
                        BASELINE_GOOGLENET_IMAGES_PER_SEC)
@@ -365,7 +374,7 @@ metric = error
 eval_train = 0
 random_type = xavier
 compute_type = bfloat16
-"""
+""" + _extra_conf()
     return _throughput(conf, batch_size, (3, 224, 224),
                        'vgg16_images_per_sec_per_chip',
                        BASELINE_VGG16_IMAGES_PER_SEC)
@@ -568,7 +577,7 @@ metric = error
 eval_train = 0
 random_type = xavier
 compute_type = bfloat16
-"""
+""" + _extra_conf()
         trainer = NetTrainer(parse_config_string(conf))
         trainer.init_model()
         # default: uint8 on the wire + device-side normalize (half the

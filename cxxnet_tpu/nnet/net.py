@@ -212,26 +212,49 @@ class Net:
                 'fuse_blockdiag is incompatible with tensor_parallel>1 '
                 '(member wmats are sharded on the output-channel axis the '
                 'fusion concatenates); remove one of the two settings')
-        byname: Dict[str, int] = {}
-        for i, info in enumerate(self.cfg.layers):
-            if info.name and info.name not in byname:
-                byname[info.name] = i
         reads, writes = self._node_version_maps()
-        for gspec in spec_str.split(';'):
-            names = [s.strip() for s in gspec.split('+') if s.strip()]
-            if len(names) < 2:
-                raise ValueError(
-                    f'fuse_blockdiag: group {gspec!r} needs >=2 layer names')
-            members = []
-            for nm in names:
-                if nm not in byname:
+        if spec_str == 'auto' or spec_str.startswith('auto:'):
+            # auto:<maxwidth> — one candidate group per concat layer: the
+            # member convs feeding it whose output width <= maxwidth
+            # (the MXU-underfilling towers).  Groups that fail any
+            # eligibility/schedule check are skipped, not fatal — auto
+            # must hold on arbitrary nets.  Default maxwidth 96: <128
+            # lanes AND at/below the narrowest width class the GoogLeNet
+            # breakdown receipt can indict.
+            maxw = int(spec_str.split(':', 1)[1]) if ':' in spec_str else 96
+            for members in self._auto_blockdiag_candidates(
+                    ConvolutionLayer, writes, maxw):
+                self._register_blockdiag_group(
+                    members, ConvolutionLayer, reads, writes, strict=False)
+        else:
+            byname: Dict[str, int] = {}
+            for i, info in enumerate(self.cfg.layers):
+                if info.name and info.name not in byname:
+                    byname[info.name] = i
+            for gspec in spec_str.split(';'):
+                names = [s.strip() for s in gspec.split('+') if s.strip()]
+                if len(names) < 2:
                     raise ValueError(
-                        f'fuse_blockdiag: no layer named {nm!r}')
-                members.append(byname[nm])
-            members.sort()
-            self._check_blockdiag_group(members, ConvolutionLayer,
-                                        reads, writes)
-            self._exec_order = self._reorder_contiguous(
+                        f'fuse_blockdiag: group {gspec!r} needs >=2 '
+                        f'layer names')
+                members = []
+                for nm in names:
+                    if nm not in byname:
+                        raise ValueError(
+                            f'fuse_blockdiag: no layer named {nm!r}')
+                    members.append(byname[nm])
+                self._register_blockdiag_group(
+                    sorted(members), ConvolutionLayer, reads, writes,
+                    strict=True)
+        self._verify_blockdiag_final(reads, writes)
+
+    def _register_blockdiag_group(self, members, conv_cls, reads, writes,
+                                  strict: bool) -> bool:
+        """Validate + schedule one group; ``strict`` raises on failure
+        (explicit specs fail loud), else the group is skipped."""
+        try:
+            self._check_blockdiag_group(members, conv_cls, reads, writes)
+            new_order = self._reorder_contiguous(
                 self._exec_order, members, reads, writes)
             for m in members:
                 if m in self._blockdiag_groups:
@@ -239,8 +262,38 @@ class Net:
                         f'fuse_blockdiag: layer '
                         f'{self.cfg.layers[m].name!r} appears in two '
                         f'groups')
-                self._blockdiag_groups[m] = members
-        self._verify_blockdiag_final(reads, writes)
+        except ValueError:
+            if strict:
+                raise
+            return False
+        self._exec_order = new_order
+        for m in members:
+            self._blockdiag_groups[m] = members
+        return True
+
+    def _auto_blockdiag_candidates(self, conv_cls, writes, maxw: int):
+        """One candidate group per concat layer: the convs producing its
+        input nodes (through in-place activations) with output width
+        <= maxw, not already sibling-fused."""
+        producer: Dict[int, int] = {}     # node -> conv layer writing v1
+        for i, layer in enumerate(self.layers):
+            if isinstance(layer, conv_cls):
+                for (n, v) in writes[i]:
+                    if v == 1:
+                        producer[n] = i
+        for i, info in enumerate(self.cfg.layers):
+            if self.layers[i].type_name not in ('concat', 'ch_concat'):
+                continue
+            members = []
+            for n in info.nindex_in:
+                m = producer.get(n)
+                if (m is None or m in self._sibling_groups
+                        or m in self._blockdiag_groups):
+                    continue
+                if self.layers[m].param.num_channel <= maxw:
+                    members.append(m)
+            if len(members) >= 2:
+                yield sorted(members)
 
     def _node_version_maps(self):
         """Per-layer (node, version) read/write sets under the sequential

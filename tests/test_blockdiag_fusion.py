@@ -206,6 +206,56 @@ input_shape = 3,5,5
         assert plain.net._exec_order == list(range(len(plain.net.layers)))
 
 
+class TestBlockdiagAuto:
+    def test_auto_groups_form_on_module_conf(self):
+        # auto: one candidate per concat; t3 (8) and t5 (5) feed cat and
+        # are narrow; the reduces are not concat producers
+        fused = _make_trainer('fuse_blockdiag = auto')
+        groups = {tuple(g) for g in fused.net._blockdiag_groups.values()}
+        assert len(groups) == 1
+        (g,) = groups
+        names = {fused.net.cfg.layers[m].name for m in g}
+        assert names == {'t3', 't5'}
+
+    def test_auto_matches_unfused(self):
+        plain = _make_trainer('')
+        fused = _make_trainer('fuse_blockdiag = auto')
+        _copy_params(plain, fused)
+        b = _batch(seed=5)
+        np.testing.assert_allclose(np.asarray(fused.predict(b)),
+                                   np.asarray(plain.predict(b)),
+                                   rtol=0, atol=0)
+
+    def test_auto_width_filter(self):
+        # auto:4 excludes t3 (8 channels) -> no group of >=2 remains
+        fused = _make_trainer('fuse_blockdiag = auto:4')
+        assert fused.net._blockdiag_groups == {}
+
+    def test_auto_is_silent_on_concat_free_nets(self):
+        from cxxnet_tpu.models.builders import alexnet_conf
+        tr = NetTrainer(parse_config_string(
+            alexnet_conf(num_class=4)
+            + '\nbatch_size = 1\ndev = cpu\nfuse_blockdiag = auto\n'))
+        tr.init_model()
+        assert tr.net._blockdiag_groups == {}
+
+    def test_auto_on_googlenet_groups_every_module(self):
+        from cxxnet_tpu.models.builders import googlenet_conf
+        tr = NetTrainer(parse_config_string(
+            googlenet_conf(num_class=4, aux_heads=False)
+            + '\nbatch_size = 1\ndev = cpu\nfuse_blockdiag = auto\n'))
+        tr.init_model()
+        groups = {tuple(g) for g in tr.net._blockdiag_groups.values()}
+        # the six modules whose 5x5+proj towers are <= 96 wide (in4e/
+        # in5a/in5b are 128-wide — correctly above the default cutoff)
+        assert len(groups) == 6
+        names = {frozenset(tr.net.cfg.layers[m].name for m in g)
+                 for g in groups}
+        assert names == {
+            frozenset({f'{p}_5x5', f'{p}_proj'})
+            for p in ('in3a', 'in3b', 'in4a', 'in4b', 'in4c', 'in4d')}
+
+
 class TestBlockdiagOnGoogLeNetModule:
     def test_builder_module_fuses_and_matches(self):
         # the real builder emits in-place relus and lazy reduces; fuse the

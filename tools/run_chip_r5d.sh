@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-5 chained chip runner, stage d: net-level execution-plan A/Bs.
+# Waits for the r5c stage (which itself waits on pending + r5b), then
+# lands, each vs the committed baseline receipts:
+#   bench_googlenet_blockdiag.json — inception tower fusion (auto:96)
+#     vs bench_googlenet.json (VERDICT r4 task 4's measured gate)
+#   bench_alexnet_{s2d,im2col,split}.json — conv-lowering variants vs
+#     bench_alexnet_lrngate.json (VERDICT r4 task 3's net-level confirm;
+#     the micro conv_lowering receipt attributes, these decide)
+# Idempotent; helpers from tools/tunnel_lib.sh.
+#
+#   nohup bash tools/run_chip_r5d.sh &
+set -x
+REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
+OUT=${OUT:-$REPO/receipts}
+mkdir -p "$OUT"
+cd "$REPO" || exit 1
+. tools/tunnel_lib.sh
+
+while pgrep -f '^bash tools/run_chip_pending.sh' > /dev/null ||
+      pgrep -f '^bash tools/run_chip_r5b.sh' > /dev/null ||
+      pgrep -f '^bash tools/run_chip_r5c.sh' > /dev/null; do
+    sleep 120
+done
+
+run_ab() {    # $1 receipt basename, $2 bench mode, $3 CXXNET_BENCH_CONF_EXTRA
+    local f="$OUT/$1"
+    if receipt_ok "$f"; then echo "skip $1 (receipt ok)"; return; fi
+    wait_tunnel "$OUT/pending.marker"
+    timeout 2700 env CXXNET_BENCH_CONF_EXTRA="$3" python bench.py "$2" \
+        > "$f" 2>"$OUT/$1.log" ||
+        [ -s "$f" ] || echo '{"metric":"'"$2"'","value":null,"error":"killed/timeout"}' > "$f"
+    save_receipts "$f" "$OUT/$1.log"
+}
+
+run_ab bench_googlenet_blockdiag.json googlenet 'fuse_blockdiag = auto'
+run_ab bench_alexnet_s2d.json    alexnet 'conv_lowering = s2d'
+run_ab bench_alexnet_im2col.json alexnet 'conv_lowering = im2col'
+run_ab bench_alexnet_split.json  alexnet 'conv_lowering = split'
+echo "r5d suite done"
