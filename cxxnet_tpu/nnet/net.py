@@ -17,6 +17,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import sys
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -221,7 +223,15 @@ class Net:
             # must hold on arbitrary nets.  Default maxwidth 96: <128
             # lanes AND at/below the narrowest width class the GoogLeNet
             # breakdown receipt can indict.
-            maxw = int(spec_str.split(':', 1)[1]) if ':' in spec_str else 96
+            if ':' in spec_str:
+                try:
+                    maxw = int(spec_str.split(':', 1)[1])
+                except ValueError:
+                    raise ValueError(
+                        f'fuse_blockdiag: bad auto maxwidth in '
+                        f'{spec_str!r} — use auto or auto:<int>') from None
+            else:
+                maxw = 96
             for members in self._auto_blockdiag_candidates(
                     ConvolutionLayer, writes, maxw):
                 self._register_blockdiag_group(
@@ -247,6 +257,13 @@ class Net:
                     sorted(members), ConvolutionLayer, reads, writes,
                     strict=True)
         self._verify_blockdiag_final(reads, writes)
+        # a fusion receipt must be able to tell "measured" from "never
+        # engaged": with the knob set, say what actually formed (lands in
+        # the committed bench .log next to the receipt JSON)
+        groups = {tuple(g) for g in self._blockdiag_groups.values()}
+        print(f'fuse_blockdiag={spec_str}: {len(groups)} group(s) formed'
+              + ('' if groups else ' — NO fusion engaged'),
+              file=sys.stderr)
 
     def _register_blockdiag_group(self, members, conv_cls, reads, writes,
                                   strict: bool) -> bool:

@@ -23,18 +23,8 @@ while pgrep -f '^bash tools/run_chip_pending.sh' > /dev/null ||
     sleep 120
 done
 
-run_ab() {    # $1 receipt basename, $2 bench mode, $3 CXXNET_BENCH_CONF_EXTRA
-    local f="$OUT/$1"
-    if receipt_ok "$f"; then echo "skip $1 (receipt ok)"; return; fi
-    wait_tunnel "$OUT/pending.marker"
-    timeout 2700 env CXXNET_BENCH_CONF_EXTRA="$3" python bench.py "$2" \
-        > "$f" 2>"$OUT/$1.log" ||
-        [ -s "$f" ] || echo '{"metric":"'"$2"'","value":null,"error":"killed/timeout"}' > "$f"
-    save_receipts "$f" "$OUT/$1.log"
-}
-
-run_ab bench_googlenet_blockdiag.json googlenet 'fuse_blockdiag = auto'
-run_ab bench_alexnet_s2d.json    alexnet 'conv_lowering = s2d'
-run_ab bench_alexnet_im2col.json alexnet 'conv_lowering = im2col'
-run_ab bench_alexnet_split.json  alexnet 'conv_lowering = split'
+run_bench_receipt googlenet bench_googlenet_blockdiag.json 'fuse_blockdiag = auto'
+run_bench_receipt alexnet bench_alexnet_s2d.json    'conv_lowering = s2d'
+run_bench_receipt alexnet bench_alexnet_im2col.json 'conv_lowering = im2col'
+run_bench_receipt alexnet bench_alexnet_split.json  'conv_lowering = split'
 echo "r5d suite done"

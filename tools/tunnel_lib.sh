@@ -58,13 +58,16 @@ raise SystemExit(1 if bad else 0)
 EOF
 }
 
-# run_bench_receipt <mode> <receipt-basename> — bench.py JSON-on-stdout
-# into $OUT/<basename>, skip-if-ok, tunnel-gated, committed on landing.
+# run_bench_receipt <mode> <receipt-basename> [extra-conf] — bench.py
+# JSON-on-stdout into $OUT/<basename>, skip-if-ok, tunnel-gated,
+# committed on landing.  $3 (optional) rides CXXNET_BENCH_CONF_EXTRA
+# (';'-separated config lines) for execution-plan A/Bs.
 run_bench_receipt() {
     local f="$OUT/$2"
     if receipt_ok "$f"; then echo "skip $2 (receipt ok)"; return; fi
     wait_tunnel "$OUT/pending.marker"
-    timeout 2700 python bench.py "$1" > "$f" 2>"$OUT/$2.log" ||
+    timeout 2700 env CXXNET_BENCH_CONF_EXTRA="${3:-}" python bench.py "$1" \
+        > "$f" 2>"$OUT/$2.log" ||
         [ -s "$f" ] || echo '{"metric":"'"$1"'","value":null,"error":"killed/timeout"}' > "$f"
     save_receipts "$f" "$OUT/$2.log"
 }
