@@ -163,7 +163,8 @@ class TestSpaceToDepth:
                 np.testing.assert_array_equal(got[kk][f], ref[kk][f])
 
 
-@pytest.mark.parametrize('lowering,ngroup', [('im2col', 1), ('split', 2)])
+@pytest.mark.parametrize('lowering,ngroup',
+                         [('im2col', 1), ('split', 2), ('s2d', 1)])
 def test_lowering_on_sharded_mesh(lowering, ngroup):
     """The alternative lowerings must survive GSPMD: im2col's
     (b*oy*ox, k) reshape merges the data-sharded batch axis into the GEMM

@@ -1,0 +1,19 @@
+#!/bin/bash
+# One-shot (re)launcher for the whole round-5 chip-receipt chain.  Each
+# stage is idempotent (receipt_ok skip) and self-orders via pgrep waits,
+# so this is safe to run at any time — after a session restart, a
+# tunnel recovery, or just to be sure everything is armed.
+#
+#   bash tools/run_chip_r5_all.sh
+set -e
+cd "$(dirname "$(dirname "$(readlink -f "$0")")")"
+for s in run_chip_pending run_chip_r5b run_chip_r5c run_chip_r5d; do
+    if pgrep -f "^bash tools/$s.sh" > /dev/null; then
+        echo "$s: already running"
+    else
+        nohup bash "tools/$s.sh" > "/tmp/${s}_driver.log" 2>&1 &
+        echo "$s: launched ($!)"
+    fi
+    sleep 1
+done
+pgrep -af '^bash tools/run_chip'
