@@ -260,13 +260,17 @@ class Net:
         # a fusion receipt must be able to tell "measured" from "never
         # engaged": with the knob set, say what actually formed (lands in
         # the committed bench .log next to the receipt JSON)
-        groups = {tuple(g) for g in self._blockdiag_groups.values()}
+        groups = self._blockdiag_group_set()
         print(f'fuse_blockdiag={spec_str}: {len(groups)} group(s) formed'
               + ('' if groups else ' — NO fusion engaged'),
               file=sys.stderr)
 
+    def _blockdiag_group_set(self):
+        """The distinct groups (each member maps to its whole group)."""
+        return {tuple(g) for g in self._blockdiag_groups.values()}
+
     def _register_blockdiag_group(self, members, conv_cls, reads, writes,
-                                  strict: bool) -> bool:
+                                  strict: bool) -> None:
         """Validate + schedule one group; ``strict`` raises on failure
         (explicit specs fail loud), else the group is skipped."""
         try:
@@ -282,11 +286,10 @@ class Net:
         except ValueError:
             if strict:
                 raise
-            return False
+            return
         self._exec_order = new_order
         for m in members:
             self._blockdiag_groups[m] = members
-        return True
 
     def _auto_blockdiag_candidates(self, conv_cls, writes, maxw: int):
         """One candidate group per concat layer: the convs producing its
@@ -381,7 +384,7 @@ class Net:
         before the group starts, and no rewriter of an input node runs
         before the group starts."""
         pos = {l: k for k, l in enumerate(self._exec_order)}
-        for members in {tuple(g) for g in self._blockdiag_groups.values()}:
+        for members in self._blockdiag_group_set():
             names = [self.cfg.layers[m].name for m in members]
             ps = sorted(pos[m] for m in members)
             if ps != list(range(ps[0], ps[-1] + 1)):

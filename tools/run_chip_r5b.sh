@@ -13,9 +13,7 @@ mkdir -p "$OUT"
 cd "$REPO" || exit 1
 . tools/tunnel_lib.sh
 
-while pgrep -f 'bash tools/run_chip_pending.sh' > /dev/null; do
-    sleep 120
-done
+wait_for_runners run_chip_pending
 
 run_bench_receipt eval_alexnet bench_eval_alexnet.json
 echo "r5b suite done"

@@ -11,15 +11,10 @@ mkdir -p "$OUT"
 cd "$REPO" || exit 1
 . tools/tunnel_lib.sh
 
-# wait for BOTH upstream stages (two pgrep calls: a \| inside one -f
-# pattern is a literal pipe in pgrep's ERE and never matches): if the
-# pending suite's wall-clock-sensitive benches still run, the probe
-# would share the single host core with them and contaminate those
-# receipts
-while pgrep -f '^bash tools/run_chip_pending.sh' > /dev/null ||
-      pgrep -f '^bash tools/run_chip_r5b.sh' > /dev/null; do
-    sleep 120
-done
+# wait for BOTH upstream stages: if the pending suite's wall-clock-
+# sensitive benches still run, the probe would share the single host
+# core with them and contaminate those receipts
+wait_for_runners run_chip_pending run_chip_r5b
 
 run_tool_receipt flash_engage python tools/flash_engage_probe.py
 echo "r5c suite done"

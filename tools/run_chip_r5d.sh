@@ -17,11 +17,7 @@ mkdir -p "$OUT"
 cd "$REPO" || exit 1
 . tools/tunnel_lib.sh
 
-while pgrep -f '^bash tools/run_chip_pending.sh' > /dev/null ||
-      pgrep -f '^bash tools/run_chip_r5b.sh' > /dev/null ||
-      pgrep -f '^bash tools/run_chip_r5c.sh' > /dev/null; do
-    sleep 120
-done
+wait_for_runners run_chip_pending run_chip_r5b run_chip_r5c
 
 run_bench_receipt googlenet bench_googlenet_blockdiag.json 'fuse_blockdiag = auto'
 run_bench_receipt alexnet bench_alexnet_s2d.json    'conv_lowering = s2d'

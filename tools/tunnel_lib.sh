@@ -40,6 +40,22 @@ wait_tunnel() {
     fi
 }
 
+# wait_for_runners <script-basename>... — block until none of the named
+# runner stages is alive.  Two pgreps, not one with \| (a \| inside a
+# pgrep -f pattern is a literal pipe in its ERE and never matches);
+# '^bash tools/' anchors past wrapper shells whose cmdline merely
+# mentions the script.
+wait_for_runners() {
+    local s alive=1
+    while [ "$alive" -eq 1 ]; do
+        alive=0
+        for s in "$@"; do
+            pgrep -f "^bash tools/$s.sh" > /dev/null && alive=1
+        done
+        [ "$alive" -eq 1 ] && sleep 120
+    done
+}
+
 # receipt_ok <file> — 0 when the receipt exists, parses, and is neither
 # partial, superseded, nor error-marked (a null value also counts as
 # failed).  THE definition of "this step already ran" for every
