@@ -1,0 +1,18 @@
+#!/bin/bash
+# Round-5 chained chip runner, stage e: Inception-BN tower-fusion A/B —
+# the second concat-tower family (MFU 0.299 vs GoogLeNet's 0.152); its
+# fuse_blockdiag default is gated on THIS receipt, not GoogLeNet's.
+# Idempotent; helpers from tools/tunnel_lib.sh.
+#
+#   nohup bash tools/run_chip_r5e.sh &
+set -x
+REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
+OUT=${OUT:-$REPO/receipts}
+mkdir -p "$OUT"
+cd "$REPO" || exit 1
+. tools/tunnel_lib.sh
+
+wait_for_runners run_chip_pending run_chip_r5b run_chip_r5c run_chip_r5d
+
+run_bench_receipt inception_bn bench_inception_bn_blockdiag.json 'fuse_blockdiag = auto'
+echo "r5e suite done"
