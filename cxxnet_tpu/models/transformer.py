@@ -25,6 +25,7 @@ so optimizer state never leaves the device that owns the shard.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import math
 from dataclasses import dataclass
@@ -358,6 +359,178 @@ def abstract_params(params, cfg: TransformerConfig, mesh: Mesh):
         params, param_specs(cfg))
 
 
+def _stage_attn(p, h, cfg: TransformerConfig, mask):
+    """One block's attention half on a single device: ln1 -> qkv ->
+    attention -> residual out-proj -> ln2.  THE single copy of the
+    block math — :func:`reference_loss` and :func:`generate`'s prefill
+    both run through here, so they cannot drift.  Returns
+    ``(h, y2, k, v)`` (k/v for the decode cache)."""
+    mb, s, d = h.shape
+    hd = d // cfg.num_heads
+    y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
+    q = (y @ p['wq']).reshape(mb, s, cfg.num_heads, hd)
+    k = (y @ p['wk']).reshape(mb, s, cfg.num_heads, hd)
+    v = (y @ p['wv']).reshape(mb, s, cfg.num_heads, hd)
+    attn = _local_attention(q, k, v, 1.0 / math.sqrt(hd), mask)
+    h = h + attn.reshape(mb, s, d) @ p['wo']
+    y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
+    return h, y2, k, v
+
+
+def _nodrop_moe_ffn(y2, p, gather: bool):
+    """No-drop top-1 switch routing: gate-probability-scaled expert
+    output (the same per-token math as ``switch_gate``'s
+    ``combine = dispatch * gate_prob``, parallel/moe.py) WITHOUT the
+    capacity bound — at inference the capacity bucket is a training-time
+    load-balancing artifact (a handful of live tokens makes
+    ``capacity = ceil(cf*N/E)`` round to 0-1 and drop arbitrarily).
+
+    ``gather=True`` gathers each token's expert weights directly —
+    O(tokens) weight copies, right for the decode step's single live
+    token.  ``gather=False`` uses a one-hot dispatch einsum (no weight
+    duplication, E-way activation buffer like ``moe_ffn_local``) —
+    right for the prefill's b*s0 tokens."""
+    probs = jax.nn.softmax((y2 @ p['gate']).astype(jnp.float32), axis=-1)
+    ex = jnp.argmax(probs, axis=-1)                        # (n,)
+    pg = jnp.take_along_axis(probs, ex[:, None], axis=-1)  # (n, 1)
+    if gather:
+        w1 = jnp.take(p['w1'], ex, axis=0)                 # (n, d, f)
+        w2 = jnp.take(p['w2'], ex, axis=0)                 # (n, f, d)
+        hmid = jax.nn.relu(jnp.einsum('nd,ndf->nf', y2, w1))
+        out = jnp.einsum('nf,nfd->nd', hmid, w2)
+    else:
+        oh = jax.nn.one_hot(ex, p['w1'].shape[0], dtype=y2.dtype)
+        buf = jnp.einsum('ne,nd->end', oh, y2)             # (E, n, d)
+        hmid = jax.nn.relu(jnp.einsum('end,edf->enf', buf, p['w1']))
+        out = jnp.einsum('enf,efd,ne->nd', hmid, p['w2'], oh)
+    return (pg * out.astype(jnp.float32)).astype(y2.dtype)
+
+
+# compiled decode programs keyed by (cfg, shapes, sampling): generate()
+# is called repeatedly (sampling loops, tests) and must not re-trace —
+# and the jitted fn takes params as an ARGUMENT so weights are inputs,
+# not baked-in XLA constants
+_GEN_CACHE: dict = {}
+
+
+def generate(params, prompt, max_new: int, cfg: TransformerConfig,
+             temperature: float = 0.0, rng=None):
+    """KV-cached autoregressive decode (single device) — the LM family's
+    ``task=pred`` analog (the reference predicts with ``TransformPred``
+    argmax, ``nnet_impl:286-298``; an LM predicts by decoding).
+
+    Two phases under one jit: a vectorized prefill runs the whole prompt
+    through :func:`_stage_attn` (the same block math as
+    :func:`reference_loss`) capturing each stage's K/V, then
+    ``lax.scan`` emits ``max_new`` tokens, each step attending over the
+    cache — O(total) work per token instead of re-running the full
+    forward.  Dense configs match the training forward exactly; MoE
+    configs route through :func:`_nodrop_moe_ffn` (gate-prob-scaled
+    top-1, NO capacity drops), which equals the training math except at
+    tokens training's capacity bound would have dropped.
+    ``temperature=0`` is greedy argmax; ``>0`` samples
+    ``jax.random.categorical(logits/T, rng)``.  Requires
+    ``cfg.causal`` (autoregressive decode is meaningless for a
+    bidirectional model).
+
+    ``prompt``: (batch, s0) int32; returns (batch, max_new) int32.
+    """
+    if not cfg.causal:
+        raise ValueError('generate() requires a causal config')
+    if temperature > 0 and rng is None:
+        raise ValueError('temperature>0 sampling needs an rng key')
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, s0 = prompt.shape
+    key = (dataclasses.astuple(cfg), b, s0, max_new, float(temperature))
+    run = _GEN_CACHE.get(key)
+    if run is None:
+        run = _GEN_CACHE[key] = _build_generate(
+            cfg, b, s0, max_new, temperature)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return run(params, prompt, rng)
+
+
+def _build_generate(cfg: TransformerConfig, b: int, s0: int,
+                    max_new: int, temperature: float):
+    total = s0 + max_new
+    hd = cfg.d_model // cfg.num_heads
+    scale = 1.0 / math.sqrt(hd)
+
+    def ffn(p, y2, gather):
+        mb, s, d = y2.shape
+        if cfg.num_experts:
+            return _nodrop_moe_ffn(y2.reshape(mb * s, d), p,
+                                   gather).reshape(mb, s, d)
+        return jax.nn.relu(y2 @ p['w1']) @ p['w2']
+
+    def pick(logits, r):
+        if temperature > 0:
+            return jax.random.categorical(r, logits / temperature,
+                                          axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    @jax.jit
+    def run(params, prompt, rng):
+        stage_ps = [jax.tree.map(lambda a, i=i: a[i], params['stages'])
+                    for i in range(cfg.num_stages)]
+        # --- prefill: full prompt in one pass, K/V captured per stage
+        h = jnp.take(params['embed'], prompt, axis=0)
+        kc = jnp.zeros((cfg.num_stages, b, total, cfg.num_heads, hd),
+                       h.dtype)
+        vc = jnp.zeros_like(kc)
+        mask = jnp.tril(jnp.ones((s0, s0), bool))[None, None]
+        for i, p in enumerate(stage_ps):
+            h, y2, k, v = _stage_attn(p, h, cfg, mask)
+            kc = kc.at[i, :, :s0].set(k)
+            vc = vc.at[i, :, :s0].set(v)
+            h = h + ffn(p, y2, gather=False)
+        logits0 = (h[:, -1] @ params['head']).astype(jnp.float32)
+
+        keys = (jax.random.split(rng, max_new + 1) if temperature > 0
+                else jnp.zeros((max_new + 1, 2), jnp.uint32))
+        tok0 = pick(logits0, keys[0] if temperature > 0 else None)
+        rngs = keys[1:]
+
+        # --- decode: one token per scan step, attending over the cache
+        def step(carry, inp):
+            tok, kc, vc = carry
+            t, r = inp
+            h = jnp.take(params['embed'], tok[:, None], axis=0)
+            live = (jnp.arange(total) <= t)[None, None, None, :]
+            for i, p in enumerate(stage_ps):
+                y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
+                q = (y @ p['wq']).reshape(b, 1, cfg.num_heads, hd)
+                k = (y @ p['wk']).reshape(b, 1, cfg.num_heads, hd)
+                v = (y @ p['wv']).reshape(b, 1, cfg.num_heads, hd)
+                kc = jax.lax.dynamic_update_slice(
+                    kc, k[None], (i, 0, t, 0, 0))
+                vc = jax.lax.dynamic_update_slice(
+                    vc, v[None], (i, 0, t, 0, 0))
+                ki, vi = kc[i], vc[i]
+                # (b, heads, 1, total) scores over the cache
+                s_ = jnp.einsum('bqhd,bkhd->bhqk', q, ki) * scale
+                s_ = jnp.where(live, s_, -jnp.inf)
+                attn = jnp.einsum(
+                    'bhqk,bkhd->bqhd',
+                    jax.nn.softmax(s_.astype(jnp.float32),
+                                   axis=-1).astype(ki.dtype), vi)
+                h = h + attn.reshape(b, 1, cfg.d_model) @ p['wo']
+                y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
+                h = h + ffn(p, y2, gather=True)
+            logits = (h[:, -1] @ params['head']).astype(jnp.float32)
+            nxt = pick(logits, r if temperature > 0 else None)
+            return (nxt, kc, vc), tok
+
+        ts = jnp.arange(s0, total)
+        _, toks = jax.lax.scan(step, (tok0, kc, vc), (ts, rngs))
+        # step j consumes generated token j and emits it; the carry's
+        # final pick (token max_new) is past the requested horizon
+        return toks.T
+
+    return run
+
+
 def reference_loss(params, tokens, labels, cfg: TransformerConfig):
     """Single-device oracle: same math, no mesh, sequential stages —
     including the weighted MoE balance loss the distributed step adds."""
@@ -366,17 +539,10 @@ def reference_loss(params, tokens, labels, cfg: TransformerConfig):
     for i in range(cfg.num_stages):
         p = jax.tree.map(lambda a: a[i], params['stages'])
         mb, s, d = h.shape
-        hd = d // cfg.num_heads
-        y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
-        q = (y @ p['wq']).reshape(mb, s, cfg.num_heads, hd)
-        k = (y @ p['wk']).reshape(mb, s, cfg.num_heads, hd)
-        v = (y @ p['wv']).reshape(mb, s, cfg.num_heads, hd)
         mask = None
         if cfg.causal:
             mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
-        attn = _local_attention(q, k, v, 1.0 / math.sqrt(hd), mask)
-        h = h + attn.reshape(mb, s, d) @ p['wo']
-        y = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
+        h, y, _, _ = _stage_attn(p, h, cfg, mask)
         if cfg.num_experts:
             from ..parallel.moe import moe_ffn_reference
             ff, aux = moe_ffn_reference(y.reshape(mb * s, d), p['gate'],

@@ -39,6 +39,12 @@ def main() -> int:
                     help='sharded orbax checkpoint dir; resumes from the '
                          'newest step when one exists')
     ap.add_argument('--save_every', type=int, default=10)
+    ap.add_argument('--generate', type=int, default=0, metavar='N',
+                    help='after training, greedy-decode N tokens from a '
+                         'training prompt (KV-cached transformer.generate '
+                         '— the LM analog of task=pred)')
+    ap.add_argument('--temperature', type=float, default=0.0,
+                    help='sampling temperature for --generate (0=greedy)')
     args = ap.parse_args()
     if args.save_every <= 0:
         ap.error('--save_every must be >= 1')
@@ -110,6 +116,21 @@ def main() -> int:
             save_sharded(args.ckpt_dir, i, params, block=False)
     if args.ckpt_dir:
         wait_for_saves()
+    if args.generate:
+        import jax
+        from cxxnet_tpu.models.transformer import generate
+
+        # decode happens on replicated single-logical-device params: pull
+        # the (tiny example) params off the mesh once
+        host_params = jax.tree.map(lambda a: np.asarray(a), params)
+        prompt = tokens[:2, :8]
+        out = np.asarray(generate(
+            host_params, prompt, args.generate, cfg,
+            temperature=args.temperature,
+            rng=jax.random.PRNGKey(0) if args.temperature > 0 else None))
+        for b in range(out.shape[0]):
+            print(f'prompt {list(map(int, prompt[b]))} -> '
+                  f'decoded {list(map(int, out[b]))}')
     return 0
 
 

@@ -1,0 +1,135 @@
+"""KV-cached decode (``models.transformer.generate``).
+
+The oracle is :func:`reference_loss`'s forward math on the FULL
+sequence: greedy decode must be self-consistent with it — every
+generated token equals the argmax of the full-forward logits at its
+position.  A wrong cache (stale K/V, off-by-one mask, bad position
+write) breaks this at the first decoded step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cxxnet_tpu.models import transformer as T
+
+
+def _oracle_nodrop_moe(y2, p):
+    """Independent no-drop switch route: python loop over experts, numpy
+    selection — shares NO code path with _nodrop_moe_ffn.  Math: top-1
+    expert by router softmax, output scaled by that probability (the
+    switch_gate combine = dispatch * gate_prob contract, minus the
+    capacity bound generate() documents away)."""
+    y2 = np.asarray(y2, np.float32)
+    probs = np.asarray(jax.nn.softmax(
+        jnp.asarray(y2) @ p['gate'].astype(jnp.float32), axis=-1))
+    ex = probs.argmax(-1)
+    outs = []
+    for e in range(p['w1'].shape[0]):
+        w1 = np.asarray(p['w1'][e], np.float32)
+        w2 = np.asarray(p['w2'][e], np.float32)
+        outs.append(np.maximum(y2 @ w1, 0.0) @ w2)
+    outs = np.stack(outs)                                 # (E, n, d)
+    sel = outs[ex, np.arange(len(ex))]                    # (n, d)
+    return jnp.asarray(sel * probs[np.arange(len(ex)), ex][:, None])
+
+
+def _full_logits(params, tokens, cfg):
+    """Forward logits for every position — the block math re-derived
+    independently (duplicated here deliberately: the test oracle must
+    not share code with the implementation under test)."""
+    import math
+    h = jnp.take(params['embed'], tokens, axis=0)
+    for i in range(cfg.num_stages):
+        p = jax.tree.map(lambda a, i=i: a[i], params['stages'])
+        mb, s, d = h.shape
+        hd = d // cfg.num_heads
+        y = T._layer_norm(h, p['ln1_scale'], p['ln1_bias'])
+        q = (y @ p['wq']).reshape(mb, s, cfg.num_heads, hd)
+        k = (y @ p['wk']).reshape(mb, s, cfg.num_heads, hd)
+        v = (y @ p['wv']).reshape(mb, s, cfg.num_heads, hd)
+        mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+        sc = jnp.einsum('bqhd,bkhd->bhqk', q, k) / math.sqrt(hd)
+        sc = jnp.where(mask, sc, -jnp.inf)
+        attn = jnp.einsum('bhqk,bkhd->bqhd',
+                          jax.nn.softmax(sc.astype(jnp.float32),
+                                         axis=-1).astype(k.dtype), v)
+        h = h + attn.reshape(mb, s, d) @ p['wo']
+        y2 = T._layer_norm(h, p['ln2_scale'], p['ln2_bias'])
+        if cfg.num_experts:
+            ff = _oracle_nodrop_moe(y2.reshape(mb * s, d), p)
+            h = h + ff.reshape(mb, s, d).astype(h.dtype)
+        else:
+            h = h + jax.nn.relu(y2 @ p['w1']) @ p['w2']
+    return (h @ params['head']).astype(jnp.float32)
+
+
+def _setup(num_experts=0):
+    cfg = T.TransformerConfig(vocab_size=64, d_model=32, num_heads=4,
+                              d_ff=48, num_stages=3, seq_len=32,
+                              num_experts=num_experts, attn='local')
+    params = T.init_params(np.random.RandomState(0), cfg)
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(0, 64, (2, 5)).astype(np.int32)
+    return cfg, params, prompt
+
+
+class TestGreedyDecode:
+    def test_greedy_is_self_consistent_with_full_forward(self):
+        cfg, params, prompt = _setup()
+        out = np.asarray(T.generate(params, prompt, 8, cfg))
+        assert out.shape == (2, 8)
+        full = jnp.concatenate([jnp.asarray(prompt), jnp.asarray(out)], 1)
+        logits = np.asarray(_full_logits(params, full, cfg))
+        # token at position s0+j must be the argmax of position s0+j-1
+        s0 = prompt.shape[1]
+        for j in range(8):
+            np.testing.assert_array_equal(
+                out[:, j], logits[:, s0 + j - 1].argmax(-1),
+                err_msg=f'decode step {j} diverged from full forward')
+
+    def test_moe_greedy_self_consistent(self):
+        cfg, params, prompt = _setup(num_experts=4)
+        out = np.asarray(T.generate(params, prompt, 6, cfg))
+        full = jnp.concatenate([jnp.asarray(prompt), jnp.asarray(out)], 1)
+        logits = np.asarray(_full_logits(params, full, cfg))
+        s0 = prompt.shape[1]
+        for j in range(6):
+            np.testing.assert_array_equal(
+                out[:, j], logits[:, s0 + j - 1].argmax(-1))
+
+    def test_deterministic(self):
+        cfg, params, prompt = _setup()
+        a = np.asarray(T.generate(params, prompt, 5, cfg))
+        b = np.asarray(T.generate(params, prompt, 5, cfg))
+        np.testing.assert_array_equal(a, b)
+
+
+class TestSampling:
+    def test_sampling_needs_rng(self):
+        cfg, params, prompt = _setup()
+        import pytest
+        with pytest.raises(ValueError, match='rng'):
+            T.generate(params, prompt, 3, cfg, temperature=1.0)
+
+    def test_sampling_shape_and_seed_stability(self):
+        cfg, params, prompt = _setup()
+        k = jax.random.PRNGKey(7)
+        a = np.asarray(T.generate(params, prompt, 6, cfg,
+                                  temperature=1.0, rng=k))
+        b = np.asarray(T.generate(params, prompt, 6, cfg,
+                                  temperature=1.0, rng=k))
+        c = np.asarray(T.generate(params, prompt, 6, cfg,
+                                  temperature=1.0,
+                                  rng=jax.random.PRNGKey(8)))
+        assert a.shape == (2, 6)
+        np.testing.assert_array_equal(a, b)
+        assert (a != c).any(), 'different seeds should diverge somewhere'
+
+    def test_low_temperature_approaches_greedy(self):
+        cfg, params, prompt = _setup()
+        greedy = np.asarray(T.generate(params, prompt, 5, cfg))
+        cold = np.asarray(T.generate(params, prompt, 5, cfg,
+                                     temperature=1e-4,
+                                     rng=jax.random.PRNGKey(0)))
+        np.testing.assert_array_equal(cold, greedy)
