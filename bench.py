@@ -448,6 +448,51 @@ def bench_transformer() -> int:
         BASELINE_TRANSFORMER_TOKENS_PER_SEC)
 
 
+def bench_decode() -> int:
+    """Autoregressive decode throughput (tokens/sec/chip) on the
+    GPT-2-small-class LM — the inference-side counterpart of
+    ``transformer`` (training tok/s).  KV-cached ``transformer.generate``
+    runs prefill + the whole decode scan in ONE dispatch; per-token time
+    is the K-vs-1 difference quotient over the number of NEW tokens, so
+    the dispatch/link cost and the shared prefill cancel."""
+    import jax
+    import jax.numpy as jnp
+
+    from cxxnet_tpu.models import transformer as T
+
+    batch = _bench_batch(8)
+    seq0 = int(os.environ.get('CXXNET_BENCH_SEQ', '128'))
+    new_k = _bench_steps(256)
+    cfg = T.TransformerConfig(
+        vocab_size=32768, d_model=1024, num_heads=16, d_ff=4096,
+        num_stages=8, seq_len=seq0 + new_k, attn='local', causal=True,
+        num_microbatches=1, dtype=jnp.bfloat16)
+    params = T.init_params(np.random.RandomState(0), cfg)
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(0, cfg.vocab_size, (batch, seq0)).astype(np.int32)
+
+    def run(n):
+        return np.asarray(T.generate(params, prompt, n, cfg))
+
+    per_tok, t1s = _quotient_per_step(lambda: run(1), lambda: run(new_k),
+                                      new_k)
+    import statistics
+    _emit({
+        'metric': 'decode_tokens_per_sec_per_chip',
+        'value': round(batch / per_tok, 1),
+        'unit': 'tokens/sec',
+        'vs_baseline': None,
+        'batch': batch,
+        'prompt_len': seq0,
+        'new_tokens': new_k,
+        'per_token_ms': round(per_tok * 1e3, 3),
+        'dispatch_ms': round(statistics.median(t1s) * 1e3
+                             - per_tok * 1e3, 1),
+        'timing': 'KV-cached scan, K-vs-1 new-token quotient',
+    })
+    return 0
+
+
 def _pack_synthetic_imgbin(tmp: str, n_images: int):
     """Pack a synthetic JPEG imgbin dataset with the in-tree packer;
     returns (list_path, bin_path)."""
@@ -871,7 +916,8 @@ _MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
           'io': ('host_io_images_per_sec', bench_io),
           'mnist_tta': ('mnist_time_to_2pct_error', bench_mnist_tta),
           'transformer': ('transformer_tokens_per_sec_per_chip',
-                          bench_transformer)}
+                          bench_transformer),
+          'decode': ('decode_tokens_per_sec_per_chip', bench_decode)}
 
 
 def main() -> int:

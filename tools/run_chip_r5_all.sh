@@ -7,7 +7,7 @@
 #   bash tools/run_chip_r5_all.sh
 set -e
 cd "$(dirname "$(dirname "$(readlink -f "$0")")")"
-for s in run_chip_pending run_chip_r5b run_chip_r5c run_chip_r5d; do
+for s in run_chip_pending run_chip_r5b run_chip_r5c run_chip_r5d run_chip_r5e run_chip_r5f; do
     if pgrep -f "^bash tools/$s.sh" > /dev/null; then
         echo "$s: already running"
     else

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Round-5 chained chip runner, stage f: decode (inference) tokens/sec —
+# the KV-cached generate path on the GPT-2-small-class LM.  Idempotent;
+# helpers from tools/tunnel_lib.sh.
+#
+#   nohup bash tools/run_chip_r5f.sh &
+set -x
+REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
+OUT=${OUT:-$REPO/receipts}
+mkdir -p "$OUT"
+cd "$REPO" || exit 1
+. tools/tunnel_lib.sh
+
+wait_for_runners run_chip_pending run_chip_r5b run_chip_r5c run_chip_r5d run_chip_r5e
+
+run_bench_receipt decode bench_decode.json
+echo "r5f suite done"
