@@ -414,7 +414,7 @@ _GEN_CACHE: dict = {}
 
 
 def generate(params, prompt, max_new: int, cfg: TransformerConfig,
-             temperature: float = 0.0, rng=None):
+             temperature: float = 0.0, rng=None, eos_id: int = None):
     """KV-cached autoregressive decode (single device) — the LM family's
     ``task=pred`` analog (the reference predicts with ``TransformPred``
     argmax, ``nnet_impl:286-298``; an LM predicts by decoding).
@@ -434,6 +434,8 @@ def generate(params, prompt, max_new: int, cfg: TransformerConfig,
     bidirectional model).
 
     ``prompt``: (batch, s0) int32; returns (batch, max_new) int32.
+    ``eos_id``: per-row early stop — every position after a row's first
+    emitted eos is eos (shapes stay static under jit; trim host-side).
     """
     if not cfg.causal:
         raise ValueError('generate() requires a causal config')
@@ -441,18 +443,19 @@ def generate(params, prompt, max_new: int, cfg: TransformerConfig,
         raise ValueError('temperature>0 sampling needs an rng key')
     prompt = jnp.asarray(prompt, jnp.int32)
     b, s0 = prompt.shape
-    key = (dataclasses.astuple(cfg), b, s0, max_new, float(temperature))
+    key = (dataclasses.astuple(cfg), b, s0, max_new, float(temperature),
+           eos_id)
     run = _GEN_CACHE.get(key)
     if run is None:
         run = _GEN_CACHE[key] = _build_generate(
-            cfg, b, s0, max_new, temperature)
+            cfg, b, s0, max_new, temperature, eos_id)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return run(params, prompt, rng)
 
 
 def _build_generate(cfg: TransformerConfig, b: int, s0: int,
-                    max_new: int, temperature: float):
+                    max_new: int, temperature: float, eos_id=None):
     total = s0 + max_new
     hd = cfg.d_model // cfg.num_heads
     scale = 1.0 / math.sqrt(hd)
@@ -491,10 +494,12 @@ def _build_generate(cfg: TransformerConfig, b: int, s0: int,
                 else jnp.zeros((max_new + 1, 2), jnp.uint32))
         tok0 = pick(logits0, keys[0] if temperature > 0 else None)
         rngs = keys[1:]
+        done0 = (tok0 == eos_id if eos_id is not None
+                 else jnp.zeros((b,), bool))
 
         # --- decode: one token per scan step, attending over the cache
         def step(carry, inp):
-            tok, kc, vc = carry
+            tok, done, kc, vc = carry
             t, r = inp
             h = jnp.take(params['embed'], tok[:, None], axis=0)
             live = (jnp.arange(total) <= t)[None, None, None, :]
@@ -520,10 +525,15 @@ def _build_generate(cfg: TransformerConfig, b: int, s0: int,
                 h = h + ffn(p, y2, gather=True)
             logits = (h[:, -1] @ params['head']).astype(jnp.float32)
             nxt = pick(logits, r if temperature > 0 else None)
-            return (nxt, kc, vc), tok
+            if eos_id is not None:
+                # a finished row keeps emitting eos (static shapes under
+                # jit: the scan always runs max_new steps)
+                nxt = jnp.where(done, eos_id, nxt)
+                done = done | (nxt == eos_id)
+            return (nxt, done, kc, vc), tok
 
         ts = jnp.arange(s0, total)
-        _, toks = jax.lax.scan(step, (tok0, kc, vc), (ts, rngs))
+        _, toks = jax.lax.scan(step, (tok0, done0, kc, vc), (ts, rngs))
         # step j consumes generated token j and emits it; the carry's
         # final pick (token max_new) is past the requested horizon
         return toks.T

@@ -105,6 +105,33 @@ class TestGreedyDecode:
         np.testing.assert_array_equal(a, b)
 
 
+class TestEosStop:
+    def test_post_eos_positions_are_eos(self):
+        cfg, params, prompt = _setup()
+        base = np.asarray(T.generate(params, prompt, 8, cfg))
+        # choose row 0's 3rd token as the "eos": the rerun must emit the
+        # same tokens up to and including its first occurrence per row,
+        # then eos forever after
+        eos = int(base[0, 2])
+        out = np.asarray(T.generate(params, prompt, 8, cfg, eos_id=eos))
+        for r in range(out.shape[0]):
+            hits = np.nonzero(base[r] == eos)[0]
+            cut = hits[0] if len(hits) else 8
+            np.testing.assert_array_equal(out[r, :cut + 1],
+                                          base[r, :cut + 1])
+            assert (out[r, cut:] == eos).all()
+
+    def test_no_eos_matches_plain(self):
+        cfg, params, prompt = _setup()
+        base = np.asarray(T.generate(params, prompt, 6, cfg))
+        # an eos that never fires changes nothing
+        out = np.asarray(T.generate(params, prompt, 6, cfg,
+                                    eos_id=cfg.vocab_size - 1
+                                    if (base != cfg.vocab_size - 1).all()
+                                    else None))
+        np.testing.assert_array_equal(out, base)
+
+
 class TestSampling:
     def test_sampling_needs_rng(self):
         cfg, params, prompt = _setup()
