@@ -28,5 +28,13 @@ run_tool_receipt alexnet_breakdown   python tools/alexnet_breakdown.py
 run_tool_receipt googlenet_breakdown python tools/alexnet_breakdown.py --model googlenet
 run_tool_receipt micro_matmul_tiles  python tools/pallas_microbench.py --only matmul_tiles
 run_bench_receipt transformer  bench_transformer.json
+if ! receipt_ok "$OUT/bench_transformer.json"; then
+    # OOM guard: the b16 x s1024 config's (16,1024,32768) f32 logits are
+    # the biggest single tensor any bench allocates — if the full-size
+    # run died, land a half-batch receipt rather than nothing
+    echo "transformer bench failed at batch 16 — retrying at batch 8"
+    (export CXXNET_BENCH_BATCH=8
+     run_bench_receipt transformer bench_transformer.json)
+fi
 run_tool_receipt conv_lowering python tools/conv_lowering_bench.py
 echo "pending suite done"
