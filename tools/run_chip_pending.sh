@@ -10,8 +10,10 @@
 #
 #   nohup bash tools/run_chip_pending.sh &
 #
-# Wall-clock-sensitive steps (mnist_tta, e2e) run first: keep the single
-# host core idle until their receipts exist.
+# Order = priority under a short tunnel window: wall-clock-sensitive
+# steps first (they need the single host core idle), then the
+# VERDICT-critical never-measured transformer number, then attribution
+# and A/Bs.
 set -x
 REPO=$(dirname "$(dirname "$(readlink -f "$0")")")
 OUT=${OUT:-$REPO/receipts}
@@ -23,10 +25,6 @@ echo "=== WALL-CLOCK-SENSITIVE (keep host idle) ==="
 run_bench_receipt mnist_tta    bench_mnist_tta.json
 run_bench_receipt e2e_alexnet  bench_e2e_devnorm.json
 echo "=== ON-DEVICE-TIMED ==="
-run_tool_receipt micro_matmul_bwd    python tools/pallas_microbench.py --only matmul_bwd
-run_tool_receipt alexnet_breakdown   python tools/alexnet_breakdown.py
-run_tool_receipt googlenet_breakdown python tools/alexnet_breakdown.py --model googlenet
-run_tool_receipt micro_matmul_tiles  python tools/pallas_microbench.py --only matmul_tiles
 run_bench_receipt transformer  bench_transformer.json
 if ! receipt_ok "$OUT/bench_transformer.json"; then
     # OOM guard: the b16 x s1024 config's (16,1024,32768) f32 logits are
@@ -36,5 +34,9 @@ if ! receipt_ok "$OUT/bench_transformer.json"; then
     (export CXXNET_BENCH_BATCH=8
      run_bench_receipt transformer bench_transformer.json)
 fi
+run_tool_receipt alexnet_breakdown   python tools/alexnet_breakdown.py
+run_tool_receipt googlenet_breakdown python tools/alexnet_breakdown.py --model googlenet
+run_tool_receipt micro_matmul_bwd    python tools/pallas_microbench.py --only matmul_bwd
+run_tool_receipt micro_matmul_tiles  python tools/pallas_microbench.py --only matmul_tiles
 run_tool_receipt conv_lowering python tools/conv_lowering_bench.py
 echo "pending suite done"
