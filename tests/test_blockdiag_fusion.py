@@ -256,6 +256,76 @@ class TestBlockdiagAuto:
             for p in ('in3a', 'in3b', 'in4a', 'in4b', 'in4c', 'in4d')}
 
 
+class TestBlockdiagRandomizedProperty:
+    """Property: for ANY graph and ANY requested group, the mechanism
+    either refuses loudly (labeled ValueError) or produces bit-level
+    plan-equivalent results.  Randomized over branching graphs with
+    in-place rewrites, chained convs (direct and through relus), and
+    random member picks — the adversarial inputs for the schedule
+    reorder + version validator + final cross-checks."""
+
+    def _random_conf(self, rng):
+        lines = ['netconfig = start']
+        nodes = ['0']
+        convs = []
+        n = rng.randint(3, 7)
+        for i in range(n):
+            src = nodes[rng.randint(len(nodes))]
+            name = f'c{i}'
+            k = int(rng.choice([1, 3]))
+            lines += [f'layer[{src}->{name}] = conv:{name}',
+                      f'  nchannel = {int(rng.choice([2, 3, 4]))}',
+                      f'  kernel_size = {k}']
+            if k == 3:
+                lines += ['  pad = 1']
+            if rng.rand() < 0.5:
+                lines += [f'layer[{name}->{name}] = relu']
+            convs.append(name)
+            nodes.append(name)
+        cat = ','.join(convs[-min(4, len(convs)):])
+        lines += [f'layer[{cat}->cc] = ch_concat',
+                  'layer[cc->fl] = flatten',
+                  'layer[fl->fc] = fullc:fc', '  nhidden = 3',
+                  'layer[fc->fc] = softmax', 'netconfig = end']
+        return '\n'.join(lines), convs
+
+    def test_random_graphs_fused_or_refused(self):
+        rng = np.random.RandomState(42)
+        built = refused = 0
+        for trial in range(20):
+            conf, convs = self._random_conf(rng)
+            pick = list(rng.choice(convs, size=2, replace=False))
+            base = conf + """
+input_shape = 2,7,7
+batch_size = 3
+dev = cpu
+eta = 0.1
+metric[label] = error
+"""
+            plain = NetTrainer(parse_config_string(base))
+            plain.init_model()
+            try:
+                fused = NetTrainer(parse_config_string(
+                    base + f'fuse_blockdiag = {pick[0]}+{pick[1]}\n'))
+                fused.init_model()
+            except ValueError as e:
+                assert 'fuse_blockdiag' in str(e), (
+                    f'trial {trial}: unlabeled rejection: {e}')
+                refused += 1
+                continue
+            built += 1
+            _copy_params(plain, fused)
+            x = rng.rand(3, 2, 7, 7).astype(np.float32)
+            b = DataBatch(x, np.zeros((3, 1), np.float32))
+            np.testing.assert_allclose(
+                np.asarray(fused.predict(b)), np.asarray(plain.predict(b)),
+                rtol=1e-5, atol=1e-6,
+                err_msg=f'trial {trial}: fused {pick} diverged')
+        # the generator must actually exercise both outcomes
+        assert built >= 3, f'only {built} fusable graphs in 20 trials'
+        assert refused >= 3, f'only {refused} refusals in 20 trials'
+
+
 class TestBlockdiagOnGoogLeNetModule:
     def test_builder_module_fuses_and_matches(self):
         # the real builder emits in-place relus and lazy reduces; fuse the
