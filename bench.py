@@ -12,8 +12,16 @@ Extra modes for the BASELINE.md ledger (same JSON shape):
   python bench.py e2e_alexnet      # AlexNet through the FULL data path
                                    #   (imgbin+decode+augment+H2D included)
   python bench.py mnist_tta        # MNIST conv time-to-2%-test-error (sec)
+  python bench.py eval_alexnet     # AlexNet EVAL (forward-only) img/s —
+                                   #   fc8 Pallas gate A/B in one receipt
   python bench.py transformer      # TransformerLM tokens/sec (GPT-2-small
                                    #   class; beyond-reference family)
+  python bench.py decode           # LM inference tokens/sec (KV-cached
+                                   #   autoregressive generate)
+
+``CXXNET_BENCH_CONF_EXTRA`` appends config lines (';'-separated) to every
+model bench conf — the execution-plan A/B hook (e.g.
+``fuse_blockdiag = auto``, ``conv_lowering = s2d``).
 
 Robustness: the axon tunnel that fronts the TPU chip can wedge or report
 UNAVAILABLE transiently (it recovers by waiting).  Before importing jax in
