@@ -115,22 +115,68 @@ class IIterator:
         base = getattr(self, 'base', None)
         return base.get_norm_spec() if base is not None else None
 
+    def is_replay_stable(self) -> bool:
+        """True when every ``__iter__`` replays the SAME item sequence —
+        the contract supervised fault recovery relies on to re-wind to
+        batch k (doc/fault_tolerance.md).  Iterators that reshuffle per
+        epoch pass (imgbin/imgbinx with ``shuffle=1``) return False:
+        recovery still restores exact params, but the replayed pass sees
+        a fresh permutation.  Wrappers delegate to their wrapped
+        iterator."""
+        base = getattr(self, 'base', None)
+        return base.is_replay_stable() if base is not None else True
+
     def __iter__(self) -> Iterator:
         raise NotImplementedError
 
 
 class ThreadBufferIterator(IIterator):
-    """Batch-level prefetch (``iter_batch_proc-inl.hpp:136-224``)."""
+    """Batch-level prefetch (``iter_batch_proc-inl.hpp:136-224``).
+
+    ``buffer_deadline = <seconds>`` (config) arms a per-batch watchdog: a
+    producer that misses the deadline raises
+    ``runtime.faults.PipelineStallError`` instead of blocking the trainer
+    forever (0 disables).  The buffer is batch-scoped for deterministic
+    stall injection (doc/fault_tolerance.md)."""
 
     def __init__(self, base: IIterator, buffer_size: int = 2):
         self.base = base
-        self._buf = ThreadBuffer(lambda: iter(self.base), buffer_size)
+        self._buffer_size = buffer_size
+        self._deadline = None
+        self._first_deadline = None
+        self._buf = self._make_buf()
+
+    def _make_buf(self) -> ThreadBuffer:
+        # the FIRST batch of an epoch also pays epoch setup (page
+        # permutation, cold decode/augment paths), so it gets a grace
+        # multiple of the steady-state deadline unless the conf pins one
+        first = self._first_deadline
+        if first is None and self._deadline is not None:
+            first = self._deadline * 5
+        return ThreadBuffer(lambda: iter(self.base), self._buffer_size,
+                            deadline=self._deadline, first_deadline=first,
+                            fault_scope='batch')
 
     def set_param(self, name, val):
+        if name in ('buffer_deadline', 'buffer_first_deadline'):
+            if name == 'buffer_deadline':
+                self._deadline = float(val) if float(val) > 0 else None
+            else:
+                self._first_deadline = \
+                    float(val) if float(val) > 0 else None
+            # join the old buffer's producers before replacing it — a
+            # dropped-but-live producer would keep draining the shared
+            # base iterator underneath the new buffer
+            self._buf.close(timeout=1.0)
+            self._buf = self._make_buf()
         self.base.set_param(name, val)
 
     def init(self):
         self.base.init()
+
+    def close(self, timeout=None):
+        """Join any live prefetch producers (see ThreadBuffer.close)."""
+        return self._buf.close(timeout)
 
     def __iter__(self):
         return iter(self._buf)

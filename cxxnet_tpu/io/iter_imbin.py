@@ -186,6 +186,12 @@ class ImageBinIterator(IIterator):
                         labels[:self.label_width]
                         if self.label_width else labels)
 
+    def is_replay_stable(self) -> bool:
+        # shuffle=1 draws a fresh permutation per __iter__ (_epoch_rngs
+        # bumps the epoch ordinal), so a replayed pass is a different
+        # sequence; sequential reads are bit-stable
+        return not self.shuffle
+
     def _epoch_rngs(self):
         """Fresh deterministic RNGs for one epoch pass, seeded from
         (seed_data, epoch ordinal) on the consumer thread — so producer

@@ -21,6 +21,7 @@ import time
 from typing import List, Optional, Tuple
 
 from .io.data import create_iterator
+from .nnet import checkpoint as model_io
 from .nnet.trainer import NetTrainer
 from .utils.config import apply_cli_overrides, parse_config_file
 from .utils.profiler import TraceWindow
@@ -45,6 +46,14 @@ class LearnTask:
         self.device = 'tpu'
         self.test_io = 0
         self.exact_ckpt = 0
+        # fault-tolerant runtime knobs (doc/fault_tolerance.md)
+        self.fault_plan = ''           # train.fault_plan grammar
+        self.supervise = 0             # train.supervise=1 -> TrainSupervisor
+        self.watchdog_deadline = 60.0  # train.watchdog_deadline (s, 0=off)
+        self.max_restarts = 3          # train.max_restarts per round
+        self.nan_breaker = 3           # train.nan_breaker (consecutive NaNs)
+        self.save_every = 0            # train.save_every (steps, 0=per-round)
+        self.keep_last = 4             # train.keep_last ckpts kept (0=all)
         self.extract_node_name = ''
         self.name_pred = 'pred.txt'
         self.output_format = 1
@@ -67,6 +76,13 @@ class LearnTask:
             'silent': ('silent', int), 'task': ('task', str), 'dev': ('device', str),
             'test_io': ('test_io', int), 'extract_node_name': ('extract_node_name', str),
             'exact_ckpt': ('exact_ckpt', int),
+            'train.fault_plan': ('fault_plan', str),
+            'train.supervise': ('supervise', int),
+            'train.watchdog_deadline': ('watchdog_deadline', float),
+            'train.max_restarts': ('max_restarts', int),
+            'train.nan_breaker': ('nan_breaker', int),
+            'train.save_every': ('save_every', int),
+            'train.keep_last': ('keep_last', int),
         }
         if name in simple:
             attr, typ = simple[name]
@@ -92,10 +108,13 @@ class LearnTask:
             s += 1
         if last is None:
             return False
-        with open(last, 'rb') as f:
+
+        def _read(f):
             self.net_type = int.from_bytes(f.read(4), 'little', signed=True)
             self.net_trainer = self._create_net()
             self.net_trainer.load_model(f)
+
+        model_io.read_model_file(last, _read)
         self.start_counter = s
         if self.exact_ckpt:
             from .nnet.sharded_ckpt import step_dir
@@ -119,17 +138,23 @@ class LearnTask:
         stem = base.split('.')[0]
         if stem.isdigit():
             self.start_counter = int(stem)
-        with open(self.name_model_in, 'rb') as f:
+
+        def _read(f):
             self.net_type = int.from_bytes(f.read(4), 'little', signed=True)
             self.net_trainer = self._create_net()
             self.net_trainer.load_model(f)
+
+        model_io.read_model_file(self.name_model_in, _read)
         self.start_counter += 1
 
     def _copy_model(self) -> None:
         self.net_trainer = self._create_net()
-        with open(self.name_model_in, 'rb') as f:
+
+        def _read(f):
             f.read(4)
             self.net_trainer.copy_model_from(f)
+
+        model_io.read_model_file(self.name_model_in, _read)
 
     def _exact_dir(self) -> str:
         return os.path.join(self.name_model_dir, 'exact_state')
@@ -141,9 +166,14 @@ class LearnTask:
         if self.save_period == 0 or self.start_counter % self.save_period != 0:
             return
         os.makedirs(self.name_model_dir, exist_ok=True)
-        with open(path, 'wb') as f:
+
+        def _write(f):
             f.write(int(self.net_type).to_bytes(4, 'little', signed=True))
             self.net_trainer.save_model(f)
+
+        # atomic (temp+fsync+rename) + retried: a crash mid-save can never
+        # leave a truncated file where continue=1 would load it
+        model_io.save_model_file(path, _write)
         if self.exact_ckpt:
             # beyond reference: sidecar with optimizer state + counters so
             # continue=1 resumes bit-exact mid-momentum (the reference
@@ -241,38 +271,109 @@ class LearnTask:
         finally:
             tracer.stop()
 
+    def _make_supervisor(self):
+        from .io.data import ThreadBufferIterator
+        from .runtime import faults
+        from .runtime.supervisor import SupervisorConfig, TrainSupervisor
+        # the supervisor brings its own watchdog ThreadBuffer, so a
+        # conf-level `iter = threadbuffer` stage is unwrapped: batches
+        # would otherwise be double-buffered, and two producers would
+        # both register the 'batch' fault scope with different index
+        # bases — one-shot stall events would land on whichever thread
+        # races to the index first
+        self._sup_iter = self.itr_train
+        if isinstance(self._sup_iter, ThreadBufferIterator):
+            self._sup_iter = self._sup_iter.base
+        if self._sup_iter is not None \
+                and not self._sup_iter.is_replay_stable():
+            msg = ('train iterator reshuffles per pass (shuffle=1): '
+                   'recovery restores exact params, but the replayed '
+                   'pass draws a fresh permutation — the run is NOT '
+                   'bitwise-identical to an uninterrupted one')
+            faults.global_failure_log().record('replay_unstable', msg)
+            if not self.silent:
+                print(f'TrainSupervisor: {msg}', flush=True)
+        cfg = SupervisorConfig(
+            batch_deadline=self.watchdog_deadline or None,
+            max_restarts=self.max_restarts,
+            nan_breaker=self.nan_breaker,
+            save_every=self.save_every,
+            keep_last=self.keep_last)
+        return TrainSupervisor(
+            self.net_trainer,
+            os.path.join(self.name_model_dir, 'supervised_state'), cfg)
+
+    def _supervised_round(self, sup, tracer, batch_counter, start) -> int:
+        """One round's batches under the supervisor: watchdog on the
+        pipeline, divergence breaker on the loss, restore-and-resume from
+        the exact sidecar on recoverable faults.  ``batch_factory(k)``
+        re-winds a fresh epoch pass to batch k after a restore; bitwise
+        recovery additionally needs a replay-stable iterator
+        (``is_replay_stable`` — _make_supervisor warns otherwise).  The
+        supervised path trades the one-batch H2D lookahead for
+        recoverability."""
+        import itertools
+        it = self._sup_iter
+
+        def factory(k):
+            return itertools.islice(iter(it), k, None)
+
+        def before_step(i):
+            # same progress/trace cadence as the unsupervised loop
+            tracer.before_update(batch_counter + i)
+            if (i + 1) % self.print_step == 0 and not self.silent:
+                elapsed = int(time.time() - start)
+                print(f'round {self.start_counter - 1:8d}:'
+                      f'[{i + 1:8d}] {elapsed} sec elapsed', flush=True)
+
+        return sup.run(factory, before_step=before_step)
+
     def _train_rounds(self, tracer, batch_counter, start) -> None:
         cc = self.max_round
+        sup = None
+        if self.supervise and self.test_io == 0:
+            sup = self._make_supervisor()
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
             if not self.silent:
                 print(f'update round {self.start_counter - 1}', flush=True)
             sample_counter = 0
             self.net_trainer.start_round(self.start_counter)
-            # one-batch host->device lookahead: batch i+1's transfers are
-            # enqueued (stage_batch, async) before batch i's step is
-            # dispatched, so the host link rides behind device compute —
-            # the H2D half of the reference's prefetch pipeline
-            # (iter_thread_buffer covers the disk->host half)
-            pending = None
-            for batch in self.itr_train:
-                if self.test_io == 0:
-                    staged = self.net_trainer.stage_batch(batch)
-                    if pending is not None:
-                        tracer.before_update(batch_counter)
-                        self.net_trainer.update_staged(pending)
-                        batch_counter += 1
-                    pending = staged
-                sample_counter += 1
-                if sample_counter % self.print_step == 0 and not self.silent:
-                    elapsed = int(time.time() - start)
-                    print(f'round {self.start_counter - 1:8d}:'
-                          f'[{sample_counter:8d}] {elapsed} sec elapsed',
-                          flush=True)
+            if sup is not None:
+                n = self._supervised_round(sup, tracer, batch_counter,
+                                           start)
+                batch_counter += n
+                sample_counter = n
+                pending = None
+            else:
+                # one-batch host->device lookahead: batch i+1's transfers
+                # are enqueued (stage_batch, async) before batch i's step
+                # is dispatched, so the host link rides behind device
+                # compute — the H2D half of the reference's prefetch
+                # pipeline (iter_thread_buffer covers the disk->host half)
+                pending = None
+                for batch in self.itr_train:
+                    if self.test_io == 0:
+                        staged = self.net_trainer.stage_batch(batch)
+                        if pending is not None:
+                            tracer.before_update(batch_counter)
+                            self.net_trainer.update_staged(pending)
+                            batch_counter += 1
+                        pending = staged
+                    sample_counter += 1
+                    if sample_counter % self.print_step == 0 \
+                            and not self.silent:
+                        elapsed = int(time.time() - start)
+                        print(f'round {self.start_counter - 1:8d}:'
+                              f'[{sample_counter:8d}] {elapsed} sec elapsed',
+                              flush=True)
             if pending is not None:
                 tracer.before_update(batch_counter)
                 self.net_trainer.update_staged(pending)
                 batch_counter += 1
+            # settle the one-step-deferred divergence gate (no-op unless
+            # nan_action=halt / nan_breaker armed the check)
+            self.net_trainer.flush_divergence_check()
             if self.test_io == 0:
                 sys.stderr.write(f'[{self.start_counter}]')
                 if not self.itr_evals:
@@ -333,6 +434,15 @@ class LearnTask:
         cfg = apply_cli_overrides(cfg, argv[1:])
         for name, val in cfg:
             self.set_param(name, val)
+        plan = None
+        if self.fault_plan:
+            # deterministic fault injection (tests/chaos drills): the plan
+            # drives the SAME hooks production faults exercise
+            from .runtime import faults
+            plan = faults.FaultPlan.parse(self.fault_plan)
+            faults.install_plan(plan)
+            if not self.silent:
+                print(f'fault plan armed: {plan.describe()}', flush=True)
         self.init()
         if not self.silent:
             print('initializing end, start working')
@@ -344,6 +454,14 @@ class LearnTask:
             self.task_predict_raw()
         elif self.task == 'extract':
             self.task_extract()
+        if plan is not None and not self.silent:
+            # chaos-drill closure: which events actually fired, and what
+            # the runtime saw/did about them (doc/fault_tolerance.md)
+            from .runtime import faults
+            fired = plan.fired()
+            print(f"fault plan fired: {'; '.join(fired) or 'nothing'} "
+                  f'(failure log: {faults.global_failure_log().summary()})',
+                  flush=True)
         return 0
 
 

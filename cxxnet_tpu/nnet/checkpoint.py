@@ -20,8 +20,10 @@ reference (fullc, conv, bias, fixconn); batch_norm/prelu save tensors only.
 
 from __future__ import annotations
 
+import contextlib
+import os
 import struct
-from typing import Dict
+from typing import Callable, Dict
 
 import jax.numpy as jnp
 import numpy as np
@@ -155,6 +157,83 @@ def record_to_memory(layer, type_id: int,
             continue   # slot present on disk but unused in memory
         out[f] = jnp.asarray(from_disk_layout(type_id, f, arr, layer))
     return out
+
+
+# --- fault-tolerant model-file I/O ---------------------------------------
+#
+# The reference SaveModel wrote straight through the destination handle: a
+# crash mid-write left a truncated file under the final name, which a later
+# ``continue=1`` scan happily loaded.  All model-file writes now go through
+# write-to-temp + fsync + atomic rename (a reader can only ever observe a
+# complete file), and both directions are wrapped in the configurable
+# retry-with-backoff policy from ``runtime.faults`` (doc/fault_tolerance.md).
+
+
+@contextlib.contextmanager
+def atomic_write(path: str):
+    """Open a temp file next to ``path`` for writing; on clean exit fsync
+    it, atomically rename it over ``path``, and fsync the directory so the
+    rename itself survives a crash.  On error the temp file is removed and
+    ``path`` is untouched — a partially-written checkpoint is never
+    visible under the final name."""
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f'.{os.path.basename(path)}.tmp.{os.getpid()}')
+    try:
+        with open(tmp, 'wb') as f:
+            yield f
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass   # directory fsync is best-effort (not all FSes allow it)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def save_model_file(path: str, write_fn: Callable, retry=None) -> str:
+    """Atomically write a model file: ``write_fn(fileobj)`` produces the
+    bytes; the whole write retries under ``retry`` (default
+    ``faults.DEFAULT_IO_RETRY``), with each attempt first passing through
+    the fault-injection hook so injected storage errors exercise the same
+    retry path real ones take."""
+    from ..runtime import faults
+    retry = faults.DEFAULT_IO_RETRY if retry is None else retry
+
+    def attempt():
+        faults.checkpoint_write_attempt(path)
+        with atomic_write(path) as f:
+            write_fn(f)
+
+    retry.call(attempt, op_name=f'save_model:{os.path.basename(path)}')
+    return os.fspath(path)
+
+
+def read_model_file(path: str, read_fn: Callable, retry=None):
+    """Read a model file with retry: ``read_fn(fileobj)``'s return value is
+    passed through.  A missing file raises immediately (not retryable —
+    absence is a state, not a transient)."""
+    from ..runtime import faults
+    retry = faults.DEFAULT_IO_RETRY if retry is None else retry
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+
+    def attempt():
+        with open(path, 'rb') as f:
+            return read_fn(f)
+
+    return retry.call(attempt, op_name=f'read_model:{os.path.basename(path)}')
 
 
 def blob_to_params(net, blob: bytes):

@@ -17,11 +17,15 @@ for the newest complete one (the ``continue=1`` idiom, reborn sharded).
 
 from __future__ import annotations
 
+import json
 import os
 import re
-from typing import Optional
+import zlib
+from typing import List, Optional, Tuple
 
 import jax
+
+from ..runtime import faults
 
 
 def _checkpointer():
@@ -62,61 +66,250 @@ def _absolute(p) -> str:
     return s if '://' in s else os.path.abspath(s)
 
 
-def latest_step(ckpt_dir: str) -> Optional[int]:
-    """Newest complete checkpoint step in ``ckpt_dir`` (None if empty)."""
+def _scan_steps(ckpt_dir: str, suffix: str = '') -> List[int]:
+    """Step numbers of ``step_<n><suffix>`` dirs, newest first.  One
+    scan serves intact and quarantined sets alike; orbax writes into a
+    tmp dir and renames on commit, so a plain ``step_N`` dir is
+    complete, and anything else (temp, ``.corrupt``) fails the anchored
+    match."""
     base = _epath(ckpt_dir)
     if not base.exists():
-        return None
+        return []
     steps = []
     for child in base.iterdir():
-        m = _STEP_RE.match(child.name)
-        # orbax writes into a tmp dir and renames on commit, so a plain
-        # step_N dir is complete
+        name = child.name
+        if suffix:
+            if not name.endswith(suffix):
+                continue
+            name = name[:-len(suffix)]
+        m = _STEP_RE.match(name)
         if m and child.is_dir():
             steps.append(int(m.group(1)))
-    return max(steps) if steps else None
+    return sorted(steps, reverse=True)
 
 
-def save_sharded(ckpt_dir: str, step: int, params, block: bool = True) -> str:
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    """Newest complete checkpoint step in ``ckpt_dir`` (None if empty)."""
+    steps = _scan_steps(ckpt_dir)
+    return steps[0] if steps else None
+
+
+def all_steps(ckpt_dir: str) -> List[int]:
+    """Every complete checkpoint step in ``ckpt_dir``, newest first.
+    Quarantined (``.corrupt``-suffixed) and in-flight temp dirs don't
+    match ``step_<n>`` and are skipped."""
+    return _scan_steps(ckpt_dir)
+
+
+def quarantined_steps(ckpt_dir: str) -> List[int]:
+    """Steps with a ``step_<n>.corrupt`` quarantine dir, newest first —
+    the post-mortem set, so retention policies can bound it."""
+    return _scan_steps(ckpt_dir, '.corrupt')
+
+
+# --- integrity digest ----------------------------------------------------
+#
+# orbax's temp-dir + rename makes the *directory* appear atomically, but a
+# later bit-rot / truncation of a shard file inside it is silent:
+# tensorstore has no whole-file checksum we can rely on across drivers.
+# Every committed checkpoint therefore gets a ``ckpt_digest.json`` sidecar
+# (relpath -> [size, crc32]) written AFTER the commit lands; restore-side
+# verification (``verify_step_dir``) catches truncated/flipped shards and
+# lets ``restore_resilient`` fall back to the newest intact step.
+
+_DIGEST_NAME = 'ckpt_digest.json'
+_PENDING_DIGEST: List[Tuple[int, str]] = []
+
+
+def _payload_files(path: str) -> List[str]:
+    out = []
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            if f == _DIGEST_NAME:
+                continue
+            out.append(os.path.relpath(os.path.join(root, f), path))
+    return sorted(out)
+
+
+def _file_crc(p: str) -> int:
+    crc = 0
+    with open(p, 'rb') as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
+
+
+def write_digest(path: str) -> None:
+    digest = {rel: [os.path.getsize(os.path.join(path, rel)),
+                    _file_crc(os.path.join(path, rel))]
+              for rel in _payload_files(path)}
+    from .checkpoint import atomic_write
+    with atomic_write(os.path.join(path, _DIGEST_NAME)) as f:
+        f.write(json.dumps(digest).encode())
+
+
+def verify_step_dir(path: str) -> Optional[str]:
+    """Integrity-check one committed checkpoint dir; returns None when it
+    verifies, else a human-readable reason.  A checkpoint written before
+    digests existed (no sidecar) is treated as unverified-but-plausible:
+    restore may still try it (and fall back if orbax rejects it)."""
+    dig = os.path.join(path, _DIGEST_NAME)
+    if not os.path.exists(dig):
+        return None
+    try:
+        with open(dig) as f:
+            digest = json.load(f)
+    except (OSError, ValueError) as e:
+        return f'unreadable digest: {e!r}'
+    for rel, (size, crc) in digest.items():
+        p = os.path.join(path, rel)
+        if not os.path.exists(p):
+            return f'missing shard file: {rel}'
+        if os.path.getsize(p) != size:
+            return f'truncated shard file: {rel}'
+        if _file_crc(p) != crc:
+            return f'corrupt shard file: {rel}'
+    return None
+
+
+def _flush_pending_digests() -> None:
+    while _PENDING_DIGEST:
+        step, path = _PENDING_DIGEST.pop()
+        if os.path.isdir(path):
+            write_digest(path)
+            faults.shard_committed(step, path)
+
+
+def save_sharded(ckpt_dir: str, step: int, params, block: bool = True,
+                 retry: Optional[faults.RetryPolicy] = None) -> str:
     """Write ``params`` (a pytree of possibly-sharded jax.Arrays) at
     ``step``; returns the checkpoint path.  ``block=False`` lets the
     commit overlap subsequent training steps (the previous pending save is
     always completed first); callers must ``wait_for_saves()`` before
-    exit or before reading the checkpoint back."""
+    exit or before reading the checkpoint back.
+
+    The write is atomic (orbax temp-dir + rename: ``step_<n>`` only ever
+    names a complete checkpoint), retried under ``retry`` (default
+    ``faults.DEFAULT_IO_RETRY``), and followed by an integrity digest
+    sidecar once the commit lands."""
     path = _absolute(step_dir(ckpt_dir, step))
     ck = _shared_ck()
-    ck.wait_until_finished()          # at most one save in flight
-    ck.save(path, params)
+    retry = faults.DEFAULT_IO_RETRY if retry is None else retry
+
+    def attempt():
+        faults.checkpoint_write_attempt(path)
+        ck.wait_until_finished()      # at most one save in flight
+        _flush_pending_digests()
+        ck.save(path, params)
+
+    retry.call(attempt, op_name=f'save_sharded:step_{step}')
+    _PENDING_DIGEST.append((step, path))
     if block:
         ck.wait_until_finished()
+        _flush_pending_digests()
     return path
 
 
 def wait_for_saves() -> None:
     """Block until every async ``save_sharded(..., block=False)`` commit
-    has landed."""
+    has landed (and its integrity digest is written)."""
     if _CK is not None:
         _CK.wait_until_finished()
+        _flush_pending_digests()
 
 
-def restore_sharded(ckpt_dir: str, like, step: Optional[int] = None):
-    """Restore the checkpoint at ``step`` (default: latest) with every
-    leaf placed per ``like``'s shapes/dtypes/shardings — ``like`` is a
-    pytree of sharding-annotated ``jax.ShapeDtypeStruct`` (e.g.
-    ``models.transformer.abstract_params``) or of live sharded arrays.
-    Returns (params, step)."""
+def _abstract_like(like):
     ocp = _checkpointer()
-    if step is None:
-        step = latest_step(ckpt_dir)
-        if step is None:
-            raise FileNotFoundError(f'no checkpoints under {ckpt_dir}')
 
     def to_abstract(x):
         if isinstance(x, jax.ShapeDtypeStruct):
             return x
         return ocp.utils.to_shape_dtype_struct(x)
 
-    target = jax.tree.map(to_abstract, like)
-    params = _shared_ck().restore(_absolute(step_dir(ckpt_dir, step)),
-                                  target)
+    return jax.tree.map(to_abstract, like)
+
+
+def restore_sharded(ckpt_dir: str, like, step: Optional[int] = None,
+                    retry: Optional[faults.RetryPolicy] = None):
+    """Restore the checkpoint at ``step`` (default: latest) with every
+    leaf placed per ``like``'s shapes/dtypes/shardings — ``like`` is a
+    pytree of sharding-annotated ``jax.ShapeDtypeStruct`` (e.g.
+    ``models.transformer.abstract_params``) or of live sharded arrays.
+    The storage read retries under ``retry`` (default
+    ``faults.DEFAULT_IO_RETRY``).  Returns (params, step)."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f'no checkpoints under {ckpt_dir}')
+    path = _absolute(step_dir(ckpt_dir, step))
+    # absence is a state, not a transient — fail now instead of sleeping
+    # through the backoff schedule probing a dir that was never written
+    # (cloud URLs skip the check and rely on the backend's error)
+    if '://' not in path and not os.path.isdir(path):
+        raise FileNotFoundError(f'no checkpoint dir {path}')
+    target = _abstract_like(like)
+    retry = faults.DEFAULT_IO_RETRY if retry is None else retry
+    params = retry.call(
+        lambda: _shared_ck().restore(path, target),
+        op_name=f'restore_sharded:step_{step}')
     return params, step
+
+
+def quarantine_step(ckpt_dir: str, step: int, reason: str) -> None:
+    """Rename a bad ``step_<n>`` dir to ``step_<n>.corrupt`` so every
+    future ``latest_step``/``all_steps`` scan skips it without re-paying
+    verification, while the bytes stay around for post-mortem."""
+    src = _absolute(step_dir(ckpt_dir, step))
+    if os.path.isdir(src):
+        dst = src + '.corrupt'
+        if os.path.exists(dst):
+            import shutil
+            shutil.rmtree(dst, ignore_errors=True)
+        os.replace(src, dst)
+    faults.global_failure_log().record(
+        'ckpt_quarantined', f'step {step}: {reason}', step=step)
+
+
+def restore_resilient(ckpt_dir: str, like,
+                      retry: Optional[faults.RetryPolicy] = None):
+    """Restore the newest checkpoint that passes integrity verification,
+    falling back step by step: a corrupt/truncated shard (or an orbax
+    restore failure) quarantines that step and tries the next older one.
+    Raises ``faults.CheckpointCorruptError`` when nothing under
+    ``ckpt_dir`` is restorable.  Returns (params, step)."""
+    steps = all_steps(ckpt_dir)
+    if not steps:
+        raise FileNotFoundError(f'no checkpoints under {ckpt_dir}')
+    log = faults.global_failure_log()
+    quarantined = 0
+    last_err: Optional[BaseException] = None
+    for step in steps:
+        path = _absolute(step_dir(ckpt_dir, step))
+        reason = verify_step_dir(path)
+        if reason is not None:
+            quarantine_step(ckpt_dir, step, reason)
+            quarantined += 1
+            continue
+        try:
+            return restore_sharded(ckpt_dir, like, step, retry=retry)
+        except (faults.RetryError, OSError, ValueError) as e:
+            # NOT a quarantine: the digest verified, so the bytes are
+            # intact — this failure is environmental (storage outage
+            # outlasting the retry budget) or caller-side (restoring
+            # under a changed net config raises ValueError on every
+            # step).  Renaming the dir would destroy the only good
+            # recovery point over a fault that may clear; skip it for
+            # this call and leave the scan state alone.
+            last_err = e
+            log.record('ckpt_restore_failed', repr(e), step=step)
+    if not quarantined and last_err is not None:
+        # zero corruption was found — reporting CheckpointCorruptError
+        # here would send the operator down the wrong runbook for what
+        # is an outage or a caller-side mismatch
+        raise last_err
+    raise faults.CheckpointCorruptError(
+        f'no intact checkpoint under {ckpt_dir} '
+        f'({quarantined} of {len(steps)} candidates quarantined, '
+        f'rest unrestorable)')

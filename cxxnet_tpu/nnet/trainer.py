@@ -90,6 +90,9 @@ class NetTrainer:
         self.tensor_parallel = 1
         self.test_on_server = 0
         self.nan_action = 'none'
+        self.nan_breaker = 0       # consecutive non-finite losses -> raise
+        self.nan_streak = 0        # current consecutive non-finite count
+        self._pending_loss = None  # (step, device loss) deferred one step
         self.compute_dtype = jnp.float32
         self.devices: List[int] = []
         self.metric = MetricSet()
@@ -130,9 +133,12 @@ class NetTrainer:
         if name == 'test_on_server':
             self.test_on_server = int(val)
         if name == 'nan_action':
-            if val not in ('none', 'skip'):
-                raise ValueError(f'nan_action must be none|skip, got {val}')
+            if val not in ('none', 'skip', 'halt'):
+                raise ValueError(
+                    f'nan_action must be none|skip|halt, got {val}')
             self.nan_action = val
+        if name == 'nan_breaker':
+            self.nan_breaker = int(val)
         if name == 'use_pallas':
             # process-wide tri-state read by ops.pallas_kernels.pallas_mode:
             # 1 = force every pallas path, 0 = disable even the measured
@@ -591,6 +597,7 @@ class NetTrainer:
                                 data, label, extra, mask, rng,
                                 self.epoch_counter, self.round,
                                 do_update=do_update, norm=norm)
+        self._observe_loss(loss)
         if host_label is not None:
             # defer this step's metric readback one step: by the next
             # update() (or evaluate()) the values are already on host, so
@@ -606,6 +613,72 @@ class NetTrainer:
         if do_update:
             self.epoch_counter += 1
         self.sample_counter += 1
+
+    def _observe_loss(self, loss) -> None:
+        """Host-side divergence gate over the step's loss.
+
+        Extends the ``nan_action`` gate beyond per-batch ``skip`` (which
+        only zeroes the poisoned gradients in-graph): ``halt`` raises
+        ``DivergenceError`` with step/loss context on the first
+        non-finite loss, and a nonzero ``nan_breaker`` is a
+        consecutive-NaN circuit breaker — after k non-finite losses in a
+        row the error raises regardless of ``nan_action``, so a
+        supervisor can skip transient spikes but abort-and-restore on
+        sustained divergence.  Only engages when something can act on
+        the value (halt, a breaker, or an active NaN-injection fault
+        plan).
+
+        The check is deferred ONE step (the same idiom as the deferred
+        train-metric readback above): this step's device value is
+        stashed and the previous step's — materialized on host by now —
+        is inspected, so the gate adds no per-step blocking sync.
+        Divergence therefore surfaces one update late; callers settle
+        the final pending value with :meth:`flush_divergence_check`."""
+        from ..runtime import faults
+        plan = faults.active_plan()
+        inject = plan is not None and plan.has_nan_events()
+        if self.nan_action != 'halt' and not self.nan_breaker and not inject:
+            return
+        prev, self._pending_loss = (self._pending_loss,
+                                    (self.sample_counter, loss))
+        if prev is not None:
+            self._check_loss(*prev)
+
+    def flush_divergence_check(self) -> None:
+        """Settle the deferred divergence gate — call after a batch
+        loop's last ``update``, or the final step's loss goes
+        unchecked."""
+        prev, self._pending_loss = self._pending_loss, None
+        if prev is not None:
+            self._check_loss(*prev)
+
+    def reset_transient_state(self) -> None:
+        """Clear per-step in-flight state a fault may have poisoned —
+        the supervisor calls this before restoring a checkpoint.  Keeps
+        the reset next to the state it protects: the deferred metric
+        readback, the deferred divergence gate, and the NaN streak.
+        Train metrics are not part of the exact-resume tree, so they are
+        cleared too — replayed batches must not double-count (the
+        recovered round reports metrics over the post-restore pass
+        only)."""
+        self._pending_train_eval = None
+        self._pending_loss = None
+        self.nan_streak = 0
+        self.train_metric.clear()
+
+    def _check_loss(self, step: int, loss) -> None:
+        from ..runtime import faults
+        lf = float(loss)
+        plan = faults.active_plan()
+        if plan is not None:
+            lf = plan.on_loss(step, lf)
+        if np.isfinite(lf):
+            self.nan_streak = 0
+            return
+        self.nan_streak += 1
+        if self.nan_action == 'halt' or (
+                self.nan_breaker and self.nan_streak >= self.nan_breaker):
+            raise faults.DivergenceError(step, lf, self.nan_streak)
 
     def flush_train_metrics(self) -> None:
         """Force the one-step-deferred train-metric readback (see
@@ -665,7 +738,14 @@ class NetTrainer:
             if isinstance(cost, (list, tuple)):
                 cost = cost[0] if cost else None
             return float(cost.get('flops', 0.0)) if cost else 0.0
-        except Exception:
+        except (AttributeError, KeyError, TypeError, ValueError,
+                NotImplementedError, RuntimeError) as e:
+            # backends without a cost model surface it many ways; record
+            # the miss instead of swallowing it so a supervisor's failure
+            # log shows why MFU reads 0
+            from ..runtime import faults
+            faults.global_failure_log().record(
+                'cost_analysis', f'train_step_flops unavailable: {e!r}')
             return 0.0
 
     # --- evaluation / prediction ------------------------------------------
@@ -768,7 +848,7 @@ class NetTrainer:
 
     # --- checkpointing ----------------------------------------------------
     def save_training_state(self, ckpt_dir: str, step: int,
-                            block: bool = True) -> str:
+                            block: bool = True, retry=None) -> str:
         """Beyond-reference EXACT resume state: params + optimizer state
         (momentum/Adam moments) + gradient accumulator + counters, via the
         sharded orbax path (nnet/sharded_ckpt.py).  The reference model
@@ -785,11 +865,13 @@ class NetTrainer:
                     'epoch': np.asarray(self.epoch_counter, np.int64),
                     'sample': np.asarray(self.sample_counter, np.int64),
                     'round': np.asarray(self.round, np.int64)}}
-        return sharded_ckpt.save_sharded(ckpt_dir, step, tree, block=block)
+        return sharded_ckpt.save_sharded(ckpt_dir, step, tree, block=block,
+                                         retry=retry)
 
     def load_training_state(self, ckpt_dir: str,
                             step: Optional[int] = None,
-                            restore_params: bool = False) -> int:
+                            restore_params: bool = False,
+                            fallback: bool = False, retry=None) -> int:
         """Restore :meth:`save_training_state` output (latest step by
         default) into this initialized trainer; returns the step.
 
@@ -800,14 +882,24 @@ class NetTrainer:
         older run in the same dir) at worst a wrong-momentum bug instead
         of silently resuming on the wrong WEIGHTS.  Pass
         ``restore_params=True`` to adopt the sidecar's params too (e.g.
-        when restoring without a model file)."""
+        when restoring without a model file).
+
+        ``fallback=True`` restores resiliently: the newest step that
+        passes integrity verification wins, corrupt ones are quarantined
+        (``sharded_ckpt.restore_resilient``) — the supervisor's
+        restore-last-good path."""
         from . import sharded_ckpt
         like = {'params': self.params, 'opt_state': self.opt_state,
                 'grad_acc': self.grad_acc,
                 'counters': {'epoch': np.asarray(0, np.int64),
                              'sample': np.asarray(0, np.int64),
                              'round': np.asarray(0, np.int64)}}
-        tree, got = sharded_ckpt.restore_sharded(ckpt_dir, like, step)
+        if fallback:
+            tree, got = sharded_ckpt.restore_resilient(ckpt_dir, like,
+                                                       retry=retry)
+        else:
+            tree, got = sharded_ckpt.restore_sharded(ckpt_dir, like, step,
+                                                     retry=retry)
         if restore_params:
             self.params = tree['params']
         self.opt_state = tree['opt_state']

@@ -1,3 +1,11 @@
-"""Native runtime bindings (C++ data loader, ctypes)."""
+"""Runtime services: native bindings and the fault-tolerant training
+runtime (retry/backoff, fault injection, train supervision)."""
 
 from .native import NativePageReader, decode_jpeg, native_available
+from . import faults  # noqa: F401
+from .faults import (CheckpointCorruptError, DivergenceError,  # noqa: F401
+                     FailureLog, FaultInjected, FaultPlan,
+                     PipelineStallError, RetryError, RetryPolicy,
+                     TrainingFault, active_plan, clear_plan,
+                     global_failure_log, install_plan)
+from .supervisor import SupervisorConfig, TrainSupervisor  # noqa: F401

@@ -1,0 +1,400 @@
+"""Fault-tolerance primitives: failure taxonomy, retry policy, failure log,
+and a deterministic fault-injection harness.
+
+The reference lineage assumes one uninterrupted process — the first
+transient storage error or stalled producer kills the run.  On preemptible
+TPU fleets the interesting operational regime is the opposite: faults are
+routine and recovery must be *provable*.  This module supplies the three
+shared building blocks:
+
+* a typed failure taxonomy (:class:`TrainingFault` and friends) so the
+  supervisor can tell "restore and resume" failures apart from fatal ones,
+* :class:`RetryPolicy` — bounded exponential backoff with deterministic,
+  seeded jitter, wrapped around every checkpoint storage read/write
+  (``nnet/checkpoint.py``, ``nnet/sharded_ckpt.py``),
+* :class:`FaultPlan` — a seeded, one-shot-per-event injection plan
+  (raise-on-Nth-write, stall-batch-K, corrupt-checkpoint-shard,
+  NaN-loss-at-step-S) that tests and the CLI (``train.fault_plan=`` config
+  key, grammar in ``doc/fault_tolerance.md``) drive through the same hooks
+  production code runs, so a recovery the suite proves is the recovery the
+  fleet gets.
+
+Injection hooks are ambient (:func:`install_plan` / :func:`active_plan`):
+call sites in checkpoint/pipeline code are no-ops unless a plan is
+installed, so the harness costs nothing when idle.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+# --- failure taxonomy -----------------------------------------------------
+
+
+class TrainingFault(RuntimeError):
+    """A failure the supervisor knows how to recover from (restore the
+    last good checkpoint and resume), as opposed to a programming error."""
+
+
+class DivergenceError(TrainingFault):
+    """Training diverged: non-finite loss under ``nan_action=halt`` or the
+    consecutive-NaN circuit breaker tripped."""
+
+    def __init__(self, step: int, loss: float, streak: int = 1):
+        self.step = int(step)
+        self.loss = float(loss)
+        self.streak = int(streak)
+        super().__init__(
+            f'divergence at step {step}: loss={loss!r} '
+            f'({streak} consecutive non-finite)')
+
+
+class PipelineStallError(TrainingFault):
+    """The data pipeline missed its per-batch deadline."""
+
+    def __init__(self, batch_index: int, deadline: float):
+        self.batch_index = int(batch_index)
+        self.deadline = float(deadline)
+        super().__init__(
+            f'data pipeline stalled: batch {batch_index} not produced '
+            f'within {deadline:g}s')
+
+
+class CheckpointCorruptError(TrainingFault):
+    """A checkpoint failed integrity verification on restore."""
+
+
+class FaultInjected(OSError):
+    """Deterministic injected fault.  Subclasses ``OSError`` so the
+    storage retry policies treat it exactly like a real transient I/O
+    error — the injection exercises the production retry path, not a
+    special-cased test path."""
+
+
+class RetryError(OSError):
+    """Raised when a :class:`RetryPolicy` exhausts its attempts; carries
+    the last underlying error as ``__cause__``."""
+
+    def __init__(self, op_name: str, attempts: int, last: BaseException):
+        self.op_name = op_name
+        self.attempts = attempts
+        super().__init__(
+            f'{op_name}: failed after {attempts} attempts: {last!r}')
+
+
+# --- failure log ----------------------------------------------------------
+
+
+@dataclass
+class FailureRecord:
+    kind: str                      # e.g. 'stall', 'divergence', 'io_retry'
+    detail: str
+    step: Optional[int] = None
+    monotonic: float = 0.0
+
+
+class FailureLog:
+    """Append-only, thread-safe record of faults seen and actions taken.
+    The supervisor owns one; subsystems without a supervisor reference
+    (e.g. ``trainer.train_step_flops``) report to the process-wide default
+    via :func:`global_failure_log`."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._records: List[FailureRecord] = []
+
+    def record(self, kind: str, detail: str,
+               step: Optional[int] = None) -> FailureRecord:
+        rec = FailureRecord(kind, detail, step, time.monotonic())
+        with self._lock:
+            self._records.append(rec)
+        return rec
+
+    def records(self, kind: Optional[str] = None) -> List[FailureRecord]:
+        with self._lock:
+            out = list(self._records)
+        if kind is not None:
+            out = [r for r in out if r.kind == kind]
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def summary(self) -> str:
+        counts: Dict[str, int] = {}
+        for r in self.records():
+            counts[r.kind] = counts.get(r.kind, 0) + 1
+        return ', '.join(f'{k}={v}' for k, v in sorted(counts.items())) \
+            or 'no failures'
+
+
+_GLOBAL_LOG = FailureLog()
+
+
+def global_failure_log() -> FailureLog:
+    return _GLOBAL_LOG
+
+
+# --- retry policy ---------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff for storage operations.
+
+    ``delay(k) = min(max_delay, base_delay * multiplier**k) * (1 + j)``
+    where ``j`` is uniform in ``[-jitter, +jitter]`` drawn from a seeded
+    stream — the schedule is a pure function of (seed, op_name), so runs
+    are reproducible.  ``sleep`` is injectable so tests assert the
+    schedule without waiting it out."""
+
+    max_attempts: int = 4
+    base_delay: float = 0.05
+    multiplier: float = 2.0
+    max_delay: float = 2.0
+    jitter: float = 0.1
+    seed: int = 0
+    retry_on: Tuple[type, ...] = (OSError, TimeoutError)
+    sleep: Callable[[float], None] = time.sleep
+
+    def delays(self, op_name: str = '') -> List[float]:
+        """The full deterministic backoff schedule (one entry per retry)."""
+        rng = random.Random((self.seed << 16)
+                            ^ zlib.crc32(op_name.encode()))
+        out = []
+        for k in range(max(0, self.max_attempts - 1)):
+            d = min(self.max_delay, self.base_delay * self.multiplier ** k)
+            out.append(d * (1.0 + rng.uniform(-self.jitter, self.jitter)))
+        return out
+
+    def call(self, fn: Callable, op_name: str = 'storage_op',
+             log: Optional[FailureLog] = None):
+        """Run ``fn()`` retrying on ``retry_on`` with the backoff
+        schedule; raises :class:`RetryError` (chained to the last error)
+        once attempts are exhausted."""
+        schedule = self.delays(op_name)
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn()
+            except self.retry_on as e:  # noqa: PERF203 — the slow path
+                last = e
+                # `is None`, not truthiness: an EMPTY FailureLog is falsy
+                (global_failure_log() if log is None else log).record(
+                    'io_retry', f'{op_name} attempt {attempt + 1}/' +
+                    f'{self.max_attempts} failed: {e!r}')
+                if attempt < len(schedule):
+                    self.sleep(schedule[attempt])
+        raise RetryError(op_name, self.max_attempts, last) from last
+
+
+#: Default policy for checkpoint storage; modules take a ``retry=`` param
+#: defaulting to this, so one knob retunes the whole I/O layer.
+DEFAULT_IO_RETRY = RetryPolicy()
+
+#: Zero-delay variant for tests that only care about attempt counts.
+NO_WAIT_RETRY = RetryPolicy(base_delay=0.0, max_delay=0.0, jitter=0.0,
+                            sleep=lambda _t: None)
+
+
+# --- deterministic fault injection ---------------------------------------
+
+
+def _parse_event(val: str) -> Tuple[int, Optional[float]]:
+    """``"7"`` -> (7, None); ``"5:0.25"`` -> (5, 0.25)."""
+    head, _, tail = val.partition(':')
+    return int(head), (float(tail) if tail else None)
+
+
+class FaultPlan:
+    """A seeded plan of one-shot fault events, driven by ambient hooks.
+
+    Event kinds (grammar ``kind=arg[;kind=arg...]``, parsed from the
+    ``train.fault_plan=`` config value by :meth:`parse`):
+
+    * ``raise_on_write=N`` — the N-th checkpoint storage write attempt
+      (1-based, counted across the process) raises :class:`FaultInjected`.
+    * ``stall_batch=K[:secs]`` — the pipeline producer sleeps ``secs``
+      (default 30) before handing over batch index K (0-based), tripping
+      any consumer deadline shorter than that.
+    * ``corrupt_shard=STEP`` — after the sharded checkpoint for ``STEP``
+      commits, one of its payload files (seeded choice) is truncated,
+      so integrity verification must catch it on restore.
+    * ``nan_at_step=S`` — the loss observed at sample step S reads as NaN,
+      exercising ``nan_action`` / the divergence circuit breaker without
+      needing genuinely divergent math.
+
+    Every event fires at most once; :meth:`fired` exposes what actually
+    triggered so tests can assert the plan executed.  All hooks are
+    thread-safe (the stall hook runs on the producer thread)."""
+
+    def __init__(self, seed: int = 0,
+                 raise_on_write: Tuple[int, ...] = (),
+                 stall_batch: Tuple[Tuple[int, Optional[float]], ...] = (),
+                 corrupt_shard: Tuple[int, ...] = (),
+                 nan_at_step: Tuple[int, ...] = ()):
+        self.seed = int(seed)
+        self._raise_on_write = set(raise_on_write)
+        self._stall = {k: (30.0 if s is None else s) for k, s in stall_batch}
+        self._corrupt = set(corrupt_shard)
+        self._nan = set(nan_at_step)
+        self._write_count = 0
+        self._fired: List[str] = []
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, text: str) -> 'FaultPlan':
+        from ..utils.config import parse_kv_list
+        seed = 0
+        raise_w: List[int] = []
+        stall: List[Tuple[int, Optional[float]]] = []
+        corrupt: List[int] = []
+        nan: List[int] = []
+        for key, val in parse_kv_list(text):
+            if key == 'seed':
+                seed = int(val)
+            elif key == 'raise_on_write':
+                raise_w.append(int(val))
+            elif key == 'stall_batch':
+                stall.append(_parse_event(val))
+            elif key == 'corrupt_shard':
+                corrupt.append(int(val))
+            elif key == 'nan_at_step':
+                nan.append(int(val))
+            else:
+                raise ValueError(f'unknown fault_plan event: {key!r}')
+        return cls(seed=seed, raise_on_write=tuple(raise_w),
+                   stall_batch=tuple(stall), corrupt_shard=tuple(corrupt),
+                   nan_at_step=tuple(nan))
+
+    # -- introspection --
+    def fired(self) -> List[str]:
+        with self._lock:
+            return list(self._fired)
+
+    def _mark(self, tag: str) -> None:
+        with self._lock:
+            self._fired.append(tag)
+
+    def describe(self) -> str:
+        parts = [f'seed={self.seed}']
+        parts += [f'raise_on_write={n}' for n in sorted(self._raise_on_write)]
+        parts += [f'stall_batch={k}:{s:g}'
+                  for k, s in sorted(self._stall.items())]
+        parts += [f'corrupt_shard={s}' for s in sorted(self._corrupt)]
+        parts += [f'nan_at_step={s}' for s in sorted(self._nan)]
+        return ';'.join(parts)
+
+    # -- hooks (called from production code when a plan is installed) --
+    def on_checkpoint_write(self, path: str) -> None:
+        """Every checkpoint storage write *attempt* calls this first; the
+        injected error is retryable by design (see :class:`FaultInjected`)."""
+        with self._lock:
+            self._write_count += 1
+            n = self._write_count
+            hit = n in self._raise_on_write
+            if hit:
+                self._raise_on_write.discard(n)
+                self._fired.append(f'raise_on_write={n}')
+        if hit:
+            raise FaultInjected(
+                f'injected fault: checkpoint write #{n} to {path}')
+
+    def on_pipeline_item(self, scope: str, index: int) -> None:
+        """Producer-side hook, per item; only batch-scoped buffers
+        participate (inner page/instance buffers pass other scopes)."""
+        if scope != 'batch':
+            return
+        with self._lock:
+            secs = self._stall.pop(index, None)
+            if secs is not None:
+                self._fired.append(f'stall_batch={index}:{secs:g}')
+        if secs is not None:
+            time.sleep(secs)
+
+    def has_nan_events(self) -> bool:
+        with self._lock:
+            return bool(self._nan)
+
+    def on_loss(self, step: int, loss: float) -> float:
+        with self._lock:
+            if step in self._nan:
+                self._nan.discard(step)
+                self._fired.append(f'nan_at_step={step}')
+                return float('nan')
+        return loss
+
+    def on_shard_committed(self, step: int, path: str) -> None:
+        """Truncate one payload file of a just-committed sharded
+        checkpoint (seeded pick) so restore-time verification must
+        reject it."""
+        with self._lock:
+            if step not in self._corrupt:
+                return
+            self._corrupt.discard(step)
+            self._fired.append(f'corrupt_shard={step}')
+        import os
+        victims = []
+        for root, _dirs, files in os.walk(path):
+            for f in sorted(files):
+                # corrupt a payload shard, not the integrity sidecar —
+                # the point is proving verification catches bad DATA
+                if f == 'ckpt_digest.json':
+                    continue
+                victims.append(os.path.join(root, f))
+        if not victims:
+            return
+        victim = victims[random.Random(self.seed ^ step).randrange(
+            len(victims))]
+        size = os.path.getsize(victim)
+        if size > 1:
+            with open(victim, 'r+b') as f:
+                f.truncate(size // 2)
+        else:
+            os.unlink(victim)
+
+
+# --- ambient plan ---------------------------------------------------------
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install ``plan`` as the process-wide active fault plan (None
+    clears); returns the previous one so tests can restore it."""
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, plan
+    return prev
+
+
+def clear_plan() -> None:
+    install_plan(None)
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def checkpoint_write_attempt(path: str) -> None:
+    """Call at the top of every checkpoint storage write attempt."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.on_checkpoint_write(path)
+
+
+def pipeline_item(scope: Optional[str], index: int) -> None:
+    plan = _ACTIVE
+    if plan is not None and scope is not None:
+        plan.on_pipeline_item(scope, index)
+
+
+def shard_committed(step: int, path: str) -> None:
+    plan = _ACTIVE
+    if plan is not None:
+        plan.on_shard_committed(step, path)

@@ -1,0 +1,274 @@
+"""Supervised training runtime: watchdog, circuit breaker, restore/resume.
+
+``TrainSupervisor`` runs the ``nnet.trainer`` step loop under supervision,
+turning "crashes cleanly" into "degrades gracefully and provably
+recovers".  The recovery state machine (doc/fault_tolerance.md):
+
+::
+
+    RUNNING --fault--> FAILED --(restarts left)--> RESTORING --> RUNNING
+                          |                                        ^
+                          +--(max_restarts exhausted)--> raise      |
+                                   anchor/periodic checkpoints -----+
+
+Fault detection (all surfaced as ``faults.TrainingFault`` subclasses):
+
+* **pipeline stall** — batches are pulled through a
+  ``utils.thread_buffer.ThreadBuffer`` with a per-batch ``deadline``;
+  a producer that misses it raises ``PipelineStallError``,
+* **divergence** — the trainer's ``nan_action=halt`` /
+  consecutive-NaN ``nan_breaker`` gate raises ``DivergenceError``
+  (the supervisor installs its ``nan_breaker`` on the trainer),
+* **corrupt checkpoint** — restore verifies integrity digests and falls
+  back to the newest intact step (``sharded_ckpt.restore_resilient``).
+
+Recovery restores the trainer's EXACT-resume sidecar (params + optimizer
+state + gradient accumulator + counters, ``trainer.save_training_state``)
+and resumes the batch stream at the restored ``sample_counter`` — because
+the trainer's per-step RNG is a pure function of the restored counters, a
+supervised run that faulted and recovered ends bitwise-identical to an
+uninterrupted run with the same seed (proved by
+``tests/test_fault_tolerance.py``).
+
+The batch source contract is a *restartable factory*: ``batch_factory(k)``
+returns an iterator yielding batch k, k+1, ... of the epoch.  Anything
+deterministic and replayable qualifies (a list slice, a seeded iterator
+chain re-wound with ``itertools.islice``); the factory is re-invoked after
+every restore, so one poisoned iterator never wedges the run.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+from ..utils.thread_buffer import ThreadBuffer
+from . import faults
+
+
+@dataclass
+class SupervisorConfig:
+    """Knobs for one supervised run (config-key mapping in main.py /
+    doc/fault_tolerance.md)."""
+
+    batch_deadline: Optional[float] = 60.0   # None disables the watchdog
+    max_restarts: int = 3                    # per run() call
+    nan_breaker: int = 3                     # 0 keeps the trainer's own
+    save_every: int = 0                      # steps between periodic saves
+    buffer_size: int = 2                     # watchdog prefetch depth
+    keep_last: int = 4                       # ckpt steps kept (0 = all);
+                                             # also the corrupt-fallback depth
+    retry: faults.RetryPolicy = field(
+        default_factory=lambda: faults.DEFAULT_IO_RETRY)
+
+
+class TrainSupervisor:
+    """Run a trainer's step loop under watchdog + recovery supervision.
+
+    One supervisor per trainer; ``run()`` may be called repeatedly (e.g.
+    once per round) — checkpoints accumulate in ``ckpt_dir`` and the
+    restart budget is per call.
+    """
+
+    #: fault classes that trigger restore-and-resume; anything else is a
+    #: programming error and propagates
+    RECOVERABLE = (faults.PipelineStallError, faults.DivergenceError,
+                   faults.CheckpointCorruptError)
+
+    def __init__(self, trainer, ckpt_dir: str,
+                 config: Optional[SupervisorConfig] = None,
+                 failure_log: Optional[faults.FailureLog] = None):
+        self.trainer = trainer
+        self.ckpt_dir = ckpt_dir
+        self.config = config or SupervisorConfig()
+        # `is None`, not truthiness: an EMPTY FailureLog is falsy
+        self.failure_log = (faults.global_failure_log()
+                            if failure_log is None else failure_log)
+        self.state = 'IDLE'
+        self.restarts_total = 0
+        if self.config.nan_breaker and not trainer.nan_breaker:
+            trainer.nan_breaker = self.config.nan_breaker
+
+    # --- checkpoint side --------------------------------------------------
+    def save(self) -> str:
+        """Checkpoint the trainer's exact-resume state at the current
+        sample step (atomic + retried inside the sharded path).  An
+        existing dir for the step is REPLACED, never trusted: post-restore
+        replay rewrites bitwise-identical state, but a same-step save from
+        a later round (or a stale dir left by an earlier process) carries
+        different counters — skipping it would make a later restore adopt
+        the wrong ``round``/RNG stream."""
+        import shutil
+        from ..nnet import sharded_ckpt
+        tr = self.trainer
+        step = tr.sample_counter
+        old = sharded_ckpt.step_dir(self.ckpt_dir, step)
+        if os.path.isdir(old):
+            shutil.rmtree(old, ignore_errors=True)
+        path = tr.save_training_state(self.ckpt_dir, step,
+                                      retry=self.config.retry)
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        """Bound disk growth: keep only the ``keep_last`` newest intact
+        checkpoints (the exact-sidecar pruning idiom, main.py), which is
+        also how deep the corrupt-fallback chain can reach.  Quarantined
+        ``.corrupt`` dirs get the same bound — they are full-size
+        checkpoints kept for post-mortem, and exactly the degraded-storage
+        deployments that produce them can least afford unbounded growth."""
+        keep = self.config.keep_last
+        if not keep:
+            return
+        import shutil
+        from ..nnet import sharded_ckpt
+        for step in sharded_ckpt.all_steps(self.ckpt_dir)[keep:]:
+            shutil.rmtree(sharded_ckpt.step_dir(self.ckpt_dir, step),
+                          ignore_errors=True)
+        for step in sharded_ckpt.quarantined_steps(self.ckpt_dir)[keep:]:
+            shutil.rmtree(
+                sharded_ckpt.step_dir(self.ckpt_dir, step) + '.corrupt',
+                ignore_errors=True)
+
+    def restore(self) -> int:
+        """Restore the newest intact checkpoint (quarantining corrupt
+        ones) into the trainer — params, optimizer state, counters — and
+        clear in-flight per-step state the fault may have poisoned."""
+        tr = self.trainer
+        tr.reset_transient_state()
+        step = tr.load_training_state(self.ckpt_dir, restore_params=True,
+                                      fallback=True,
+                                      retry=self.config.retry)
+        self.failure_log.record('restored', f'resumed from step {step}',
+                                step=step)
+        return step
+
+    # --- the supervised loop ----------------------------------------------
+    def run(self, batch_factory: Callable[[int], Iterator],
+            n_steps: Optional[int] = None,
+            before_step: Optional[Callable[[int], None]] = None) -> int:
+        """Supervised step loop over one epoch of batches.
+
+        ``batch_factory(k)`` must yield batch k, k+1, ... deterministically
+        (see module docstring).  Runs until ``n_steps`` updates have been
+        applied this call, or until the factory's iterator is exhausted
+        when ``n_steps`` is None.  Returns the number of updates applied.
+        ``before_step(i)`` (i = updates applied so far this call) runs
+        before each update — progress printing / trace windows hook here.
+
+        On a recoverable fault: log -> restore last good checkpoint ->
+        re-create the batch stream at the restored position -> continue.
+        After ``max_restarts`` recoveries the fault propagates (with the
+        failure log telling the whole story).
+        """
+        cfg = self.config
+        tr = self.trainer
+        base = tr.sample_counter
+        # anchor: recovery can never roll back past this run's entry
+        # state — unless that state is suspect: a NaN streak left open
+        # by a previous round means the params may already be poisoned,
+        # and anchoring them would make them the newest restore target
+        # (the death loop every other save guard exists to prevent).
+        # With no anchor, recovery may lawfully roll back PAST base to
+        # the last clean checkpoint.
+        anchored = tr.nan_streak == 0
+        last_saved = None
+        if anchored:
+            self.save()
+            last_saved = tr.sample_counter
+        else:
+            self.failure_log.record(
+                'save_skipped',
+                f'anchor skipped: {tr.nan_streak} non-finite loss(es) '
+                f'open at run() entry', step=tr.sample_counter)
+        restarts = 0
+        self.state = 'RUNNING'
+        while True:
+            start = tr.sample_counter - base
+            # the first batch after a (re)start lawfully includes epoch
+            # setup (page permutation, cold decode caches) and the
+            # re-wind — reproducing `start` batches takes up to `start`
+            # production intervals.  Grant at least the same 5x grace
+            # the io-level buffer gives epoch setup, more after a deep
+            # recovery, instead of letting either deterministically
+            # re-trip the watchdog and exhaust max_restarts
+            first = None if cfg.batch_deadline is None \
+                else cfg.batch_deadline * max(5, start + 1)
+            # fault_base keeps injected stall indices epoch-absolute
+            # across restarts (the producer's enumerate restarts at 0)
+            buf = ThreadBuffer(lambda s=start: batch_factory(s),
+                               buffer_size=cfg.buffer_size,
+                               deadline=cfg.batch_deadline,
+                               first_deadline=first,
+                               fault_scope='batch',
+                               fault_base=start)
+            try:
+                for batch in buf:
+                    if before_step is not None:
+                        before_step(tr.sample_counter - base)
+                    tr.update(batch)
+                    done = tr.sample_counter - base
+                    if cfg.save_every and done % cfg.save_every == 0:
+                        # a periodic save must never checkpoint
+                        # NaN-poisoned params — it would become the
+                        # "newest intact" restore target (a CRC digest
+                        # cannot see NaNs) and wedge recovery in a
+                        # death loop.  Settle the one-step-deferred
+                        # divergence gate first, and skip the save
+                        # while a non-finite streak is open (the
+                        # breaker may still be counting)
+                        tr.flush_divergence_check()
+                        if tr.nan_streak == 0:
+                            self.save()
+                            last_saved = tr.sample_counter
+                    if n_steps is not None and done >= n_steps:
+                        break
+                # the divergence gate is deferred one step: the LAST
+                # update's loss is still pending — settle it inside the
+                # try so a final-step NaN recovers like any other
+                tr.flush_divergence_check()
+            except self.RECOVERABLE as e:
+                self.state = 'FAILED'
+                # quiesce the pipeline BEFORE restoring: a still-running
+                # producer would keep pulling batches (and consuming
+                # injected fault events) underneath the recovery
+                buf.close(timeout=5.0)
+                self.failure_log.record(
+                    type(e).__name__, str(e), step=tr.sample_counter)
+                restarts += 1
+                self.restarts_total += 1
+                if restarts > cfg.max_restarts:
+                    self.failure_log.record(
+                        'giving_up',
+                        f'{restarts - 1} restarts exhausted '
+                        f'({self.failure_log.summary()})',
+                        step=tr.sample_counter)
+                    raise
+                self.state = 'RESTORING'
+                self.restore()
+                if anchored and tr.sample_counter < base:
+                    raise faults.CheckpointCorruptError(
+                        f'restored to step {tr.sample_counter}, before '
+                        f'this run\'s anchor {base}')
+                if tr.sample_counter < base:
+                    # un-anchored entry: rolling back past base to the
+                    # last clean checkpoint is the intended outcome
+                    base = tr.sample_counter
+                self.state = 'RUNNING'
+            else:
+                # same guard as the periodic save: never leave
+                # mid-NaN-streak params as the newest restore target —
+                # and skip the rewrite when a periodic save already
+                # committed this exact step
+                if tr.nan_streak != 0:
+                    self.failure_log.record(
+                        'save_skipped',
+                        f'final save skipped: {tr.nan_streak} non-finite '
+                        f'loss(es) open', step=tr.sample_counter)
+                elif last_saved != tr.sample_counter:
+                    self.save()
+                self.state = 'IDLE'
+                return tr.sample_counter - base
+            finally:
+                buf.close(timeout=5.0)

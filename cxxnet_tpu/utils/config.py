@@ -116,6 +116,26 @@ def apply_cli_overrides(cfg: List[ConfigEntry], argv: List[str]) -> List[ConfigE
     return out
 
 
+def parse_kv_list(text: str) -> List[ConfigEntry]:
+    """Parse a compact ``k=v[;k=v...]`` list (one config *value*, e.g. the
+    ``train.fault_plan=`` grammar) into ordered ``(key, value)`` pairs.
+
+    Separators are ``;`` or ``,``; whitespace around tokens is ignored;
+    empty segments are skipped so trailing separators are harmless.  Values
+    may carry ``:``-separated arguments (opaque here — consumers split).
+    """
+    out: List[ConfigEntry] = []
+    for seg in text.replace(',', ';').split(';'):
+        seg = seg.strip()
+        if not seg:
+            continue
+        if '=' not in seg:
+            raise ConfigError(f"kv list segment must be k=v, got: {seg!r}")
+        k, v = seg.split('=', 1)
+        out.append((k.strip(), v.strip()))
+    return out
+
+
 def cfg_get(cfg: List[ConfigEntry], name: str, default: str | None = None) -> str | None:
     """Last-value-wins lookup, skipping the literal value ``default``.
 

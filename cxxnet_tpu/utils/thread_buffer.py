@@ -7,13 +7,28 @@ data-pipeline latency behind device compute.  Python threads are adequate
 here because the producers (file IO, JPEG decode via PIL, numpy slicing)
 release the GIL in their hot paths; the native C++ loader (runtime/) can be
 swapped in for the page-decode stage.
+
+Fault-tolerance surface (doc/fault_tolerance.md):
+
+* ``deadline=`` — a per-item consumer deadline; missing it raises
+  ``runtime.faults.PipelineStallError``, which is how the train supervisor
+  detects a stalled input pipeline instead of blocking forever,
+* ``close(timeout=)`` — deterministic shutdown that joins every producer
+  thread this buffer ever started,
+* shutdown never drops the end-of-stream sentinel: the producer blocks
+  politely while the consumer is alive and drains-then-signals once the
+  consumer abandoned it (``stop`` set), so a consumer can never be left
+  hanging in ``q.get()`` after a completed producer,
+* ``fault_scope='batch'`` opts the buffer into the deterministic
+  stall-injection hook (``runtime.faults.FaultPlan``); page/instance-level
+  buffers stay out of scope.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Callable, Iterator, TypeVar
+from typing import Callable, Iterator, List, Optional, Tuple, TypeVar
 
 T = TypeVar('T')
 
@@ -23,13 +38,35 @@ _STOP = object()
 class ThreadBuffer:
     """Wraps a factory of iterators; prefetches ``buffer_size`` items ahead."""
 
-    def __init__(self, make_iter: Callable[[], Iterator[T]], buffer_size: int = 2):
+    def __init__(self, make_iter: Callable[[], Iterator[T]],
+                 buffer_size: int = 2, deadline: Optional[float] = None,
+                 first_deadline: Optional[float] = None,
+                 fault_scope: Optional[str] = None,
+                 fault_base: int = 0):
         self._make_iter = make_iter
         self._buffer_size = max(1, buffer_size)
+        self._deadline = deadline
+        # the FIRST item may lawfully take longer than the steady-state
+        # per-item deadline (epoch re-wind after a recovery, cold caches);
+        # None = same as deadline
+        self._first_deadline = first_deadline
+        self._fault_scope = fault_scope
+        # offset added to the producer-local item index before it reaches
+        # the fault-injection hook, so a consumer that re-winds mid-epoch
+        # (the supervisor) keeps injected stall indices epoch-absolute
+        self._fault_base = fault_base
+        self._lock = threading.Lock()
+        # every live (thread, stop, queue) from __iter__, for close()
+        self._runs: List[Tuple[threading.Thread, threading.Event,
+                               queue.Queue]] = []
 
     def _run(self, q: queue.Queue, stop: threading.Event, box: list) -> None:
         try:
-            for item in self._make_iter():
+            for i, item in enumerate(self._make_iter()):
+                if self._fault_scope is not None:
+                    from ..runtime import faults
+                    faults.pipeline_item(self._fault_scope,
+                                         self._fault_base + i)
                 while not stop.is_set():
                     try:
                         q.put(item, timeout=0.1)
@@ -41,16 +78,30 @@ class ThreadBuffer:
         except BaseException as e:  # propagate to consumer
             box.append(e)
         finally:
-            # the sentinel must not be dropped: a full queue usually means
-            # the consumer is merely slow, and losing _STOP would leave it
-            # blocked in q.get() forever once it drains the items.  Keep
-            # trying until it lands or the consumer abandons us (stop set).
-            while not stop.is_set():
+            # The sentinel must never be dropped — losing _STOP leaves the
+            # consumer blocked in q.get() forever once it drains the items.
+            # While the consumer is alive (stop unset) a full queue just
+            # means it is slow: wait for space.  Once the consumer has
+            # abandoned us (stop set) nobody will ever free a slot, so
+            # drain one ourselves, then signal — we are the sole producer,
+            # so each pass either lands the sentinel or makes room for it.
+            while True:
                 try:
-                    q.put(_STOP, timeout=0.1)
-                    break
+                    q.put_nowait(_STOP)
+                    return
                 except queue.Full:
-                    continue
+                    pass
+                if stop.is_set():
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        pass
+                else:
+                    try:
+                        q.put(_STOP, timeout=0.1)
+                        return
+                    except queue.Full:
+                        continue
 
     def __iter__(self):
         # restart semantics = BeforeFirst(): a fresh producer each epoch;
@@ -61,14 +112,54 @@ class ThreadBuffer:
         box: list = []
         thread = threading.Thread(target=self._run, args=(q, stop, box),
                                   daemon=True)
+        with self._lock:
+            # prune retired producers so an epoch-per-iteration consumer
+            # doesn't grow this list unboundedly
+            self._runs = [r for r in self._runs if r[0].is_alive()]
+            self._runs.append((thread, stop, q))
         thread.start()
+        index = 0
         try:
             while True:
-                item = q.get()
+                dl = self._deadline
+                if index == 0 and self._first_deadline is not None:
+                    dl = self._first_deadline
+                if dl is None:
+                    item = q.get()
+                else:
+                    try:
+                        item = q.get(timeout=dl)
+                    except queue.Empty:
+                        from ..runtime.faults import PipelineStallError
+                        raise PipelineStallError(index, dl) from None
                 if item is _STOP:
                     if box:
                         raise box[0]
                     return
                 yield item
+                index += 1
         finally:
             stop.set()
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Shut down every producer this buffer started: set their stop
+        events, drain their queues (freeing any producer blocked on a full
+        queue), and join the threads.  ``timeout`` bounds the TOTAL wait;
+        returns True when every producer thread exited."""
+        with self._lock:
+            runs, self._runs = self._runs, []
+        import time
+        end = None if timeout is None else time.monotonic() + timeout
+        ok = True
+        for thread, stop, q in runs:
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            remain = None if end is None else max(0.0, end - time.monotonic())
+            thread.join(remain)
+            if thread.is_alive():
+                ok = False
+        return ok

@@ -26,3 +26,7 @@ jax.config.update('jax_platforms', 'cpu')
 def pytest_configure(config):
     config.addinivalue_line('markers',
                             'slow: long-running end-to-end tests')
+    config.addinivalue_line(
+        'markers',
+        'faults: deterministic fault-injection / recovery suite '
+        '(seeded, tier-1: runs under -m "not slow"; select with -m faults)')

@@ -1,0 +1,610 @@
+"""Fault-tolerant training runtime: retry/backoff, atomic checkpoints,
+pipeline watchdog, divergence breaker, and supervised end-to-end recovery.
+
+Everything here is deterministic: fault plans are seeded one-shot event
+sets, retry jitter is a pure function of (seed, op_name), and the
+supervisor's recovery restores the EXACT-resume sidecar — so the headline
+assertions are *bitwise* equality between a faulted-and-recovered run and
+an uninterrupted run with the same seed.
+
+Select with ``-m faults``; the suite is tier-1 (runs under ``-m "not
+slow"`` with no extra infrastructure — see doc/fault_tolerance.md).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.nnet import checkpoint, sharded_ckpt
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.runtime import faults
+from cxxnet_tpu.runtime.supervisor import SupervisorConfig, TrainSupervisor
+from cxxnet_tpu.utils.config import ConfigError, parse_kv_list
+from cxxnet_tpu.utils.thread_buffer import ThreadBuffer
+
+from test_device_normalize import assert_params_equal, snap_params
+from test_net_mnist import MLP_CONF, synth_batches
+
+pytestmark = pytest.mark.faults
+
+NO_WAIT = faults.NO_WAIT_RETRY
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    prev = faults.install_plan(None)
+    yield
+    faults.install_plan(prev)
+
+
+def _fresh(extra=''):
+    from cxxnet_tpu.utils.config import parse_config_string
+    tr = NetTrainer(parse_config_string(MLP_CONF + extra))
+    tr.init_model()
+    return tr
+
+
+# --- retry policy ---------------------------------------------------------
+
+def test_retry_schedule_deterministic_and_bounded():
+    pol = faults.RetryPolicy(max_attempts=4, base_delay=0.1, multiplier=2.0,
+                             max_delay=0.3, jitter=0.1, seed=7)
+    a, b = pol.delays('save_model:x'), pol.delays('save_model:x')
+    assert a == b                       # pure function of (seed, op_name)
+    assert a != pol.delays('other_op')  # jitter stream is op-scoped
+    assert len(a) == 3
+    for k, d in enumerate(a):
+        nominal = min(0.3, 0.1 * 2.0 ** k)
+        assert nominal * 0.9 <= d <= nominal * 1.1
+
+
+def test_retry_recovers_from_transient_and_logs():
+    sleeps = []
+    pol = faults.RetryPolicy(max_attempts=3, base_delay=0.05, jitter=0.0,
+                             sleep=sleeps.append)
+    log = faults.FailureLog()
+    calls = {'n': 0}
+
+    def flaky():
+        calls['n'] += 1
+        if calls['n'] < 3:
+            raise OSError('transient')
+        return 42
+
+    assert pol.call(flaky, op_name='op', log=log) == 42
+    assert calls['n'] == 3
+    assert sleeps == [0.05, 0.10]       # exponential, jitter-free
+    assert len(log.records('io_retry')) == 2
+
+
+def test_retry_exhausts_then_raises_with_cause():
+    def broken():
+        raise OSError('still down')
+
+    with pytest.raises(faults.RetryError) as ei:
+        NO_WAIT.call(broken, op_name='op', log=faults.FailureLog())
+    assert isinstance(ei.value.__cause__, OSError)
+    assert ei.value.attempts == NO_WAIT.max_attempts
+
+
+def test_retry_does_not_catch_programming_errors():
+    with pytest.raises(ValueError):
+        NO_WAIT.call(lambda: (_ for _ in ()).throw(ValueError('bug')),
+                     op_name='op', log=faults.FailureLog())
+
+
+# --- fault plan grammar ---------------------------------------------------
+
+def test_fault_plan_parse_roundtrip():
+    plan = faults.FaultPlan.parse(
+        'seed=3; raise_on_write=2; stall_batch=5:0.75; '
+        'corrupt_shard=1; nan_at_step=7')
+    assert plan.describe() == ('seed=3;raise_on_write=2;stall_batch=5:0.75;'
+                               'corrupt_shard=1;nan_at_step=7')
+    assert plan.fired() == []
+
+
+def test_fault_plan_rejects_unknown_event():
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse('explode_at=3')
+    with pytest.raises(ConfigError):
+        parse_kv_list('not a pair')
+
+
+def test_fault_plan_events_fire_once():
+    plan = faults.FaultPlan(raise_on_write=(2,), nan_at_step=(5,))
+    plan.on_checkpoint_write('p')                      # write #1: clean
+    with pytest.raises(faults.FaultInjected):
+        plan.on_checkpoint_write('p')                  # write #2: injected
+    plan.on_checkpoint_write('p')                      # write #3: clean
+    assert np.isnan(plan.on_loss(5, 1.0))
+    assert plan.on_loss(5, 1.0) == 1.0                 # one-shot
+    assert plan.fired() == ['raise_on_write=2', 'nan_at_step=5']
+
+
+# --- atomic model-file I/O ------------------------------------------------
+
+def test_atomic_write_commits_complete_file(tmp_path):
+    path = str(tmp_path / 'm' / '0001.model')
+    with checkpoint.atomic_write(path) as f:
+        f.write(b'payload')
+    with open(path, 'rb') as f:
+        assert f.read() == b'payload'
+    assert os.listdir(os.path.dirname(path)) == ['0001.model']  # no temps
+
+
+def test_atomic_write_crash_leaves_no_partial_under_final_name(tmp_path):
+    """Crash-simulation: the writer dies mid-stream AFTER bytes hit the
+    temp file; the final name must never appear and the temp is cleaned."""
+    path = str(tmp_path / '0001.model')
+    with pytest.raises(RuntimeError):
+        with checkpoint.atomic_write(path) as f:
+            f.write(b'half a checkp')
+            raise RuntimeError('simulated kill mid-checkpoint')
+    assert not os.path.exists(path)
+    assert os.listdir(tmp_path) == []
+
+
+def test_atomic_write_crash_preserves_previous_checkpoint(tmp_path):
+    path = str(tmp_path / '0001.model')
+    checkpoint.save_model_file(path, lambda f: f.write(b'good-v1'),
+                               retry=NO_WAIT)
+    with pytest.raises(RuntimeError):
+        checkpoint.save_model_file(
+            path, lambda f: (_ for _ in ()).throw(RuntimeError('kill')),
+            retry=NO_WAIT)
+    with open(path, 'rb') as f:
+        assert f.read() == b'good-v1'   # old checkpoint intact, bitwise
+
+
+def test_save_model_file_injected_fault_rides_retry(tmp_path):
+    plan = faults.FaultPlan(raise_on_write=(1,))
+    faults.install_plan(plan)
+    path = str(tmp_path / '0002.model')
+    checkpoint.save_model_file(path, lambda f: f.write(b'v2'), retry=NO_WAIT)
+    assert plan.fired() == ['raise_on_write=1']
+    with open(path, 'rb') as f:
+        assert f.read() == b'v2'
+
+
+def test_read_model_file_ignores_stray_partial_temp(tmp_path):
+    path = str(tmp_path / '0003.model')
+    checkpoint.save_model_file(path, lambda f: f.write(b'v3'), retry=NO_WAIT)
+    # a stray partial temp (e.g. a SIGKILLed writer from another process)
+    (tmp_path / '.0003.model.tmp.999').write_bytes(b'par')
+    assert checkpoint.read_model_file(path, lambda f: f.read(),
+                                      retry=NO_WAIT) == b'v3'
+    with pytest.raises(FileNotFoundError):
+        checkpoint.read_model_file(str(tmp_path / 'absent.model'),
+                                   lambda f: f.read(), retry=NO_WAIT)
+
+
+# --- thread buffer: shutdown, sentinel, watchdog --------------------------
+
+def test_thread_buffer_full_drain_and_error_propagation():
+    buf = ThreadBuffer(lambda: iter([1, 2, 3]), buffer_size=1)
+    assert list(buf) == [1, 2, 3]
+
+    def boom():
+        yield 1
+        raise ValueError('producer died')
+
+    buf = ThreadBuffer(boom, buffer_size=1)
+    with pytest.raises(ValueError):
+        list(buf)
+
+
+def test_thread_buffer_sentinel_survives_full_queue_abandonment():
+    """Consumer takes one item of many and walks away: the producer must
+    land its sentinel (drain-then-signal) and close() must join it."""
+    buf = ThreadBuffer(lambda: iter(range(100)), buffer_size=1)
+    it = iter(buf)
+    assert next(it) == 0
+    it.close()                       # abandon: GeneratorExit sets stop
+    assert buf.close(timeout=5.0)    # every producer thread joined
+
+
+def test_thread_buffer_close_joins_slow_producer():
+    def slow():
+        for i in range(50):
+            time.sleep(0.01)
+            yield i
+
+    buf = ThreadBuffer(slow, buffer_size=1)
+    it = iter(buf)
+    assert next(it) == 0
+    assert buf.close(timeout=5.0)
+
+
+def test_thread_buffer_deadline_raises_pipeline_stall():
+    def stalling():
+        yield 'a'
+        time.sleep(1.5)
+        yield 'b'
+
+    buf = ThreadBuffer(stalling, buffer_size=2, deadline=0.2)
+    it = iter(buf)
+    assert next(it) == 'a'
+    with pytest.raises(faults.PipelineStallError) as ei:
+        next(it)
+    assert ei.value.batch_index == 1
+    assert ei.value.deadline == 0.2
+    buf.close(timeout=5.0)
+
+
+def test_thread_buffer_first_deadline_tolerates_rewind():
+    """The first item may lawfully take longer (epoch re-wind after a
+    recovery): it gets its own deadline, steady-state items keep the
+    tight one."""
+    def rewinding():
+        time.sleep(0.5)              # the re-wind
+        yield 'a'
+        time.sleep(0.5)              # a REAL stall
+        yield 'b'
+
+    buf = ThreadBuffer(rewinding, buffer_size=1, deadline=0.2,
+                       first_deadline=2.0)
+    it = iter(buf)
+    assert next(it) == 'a'           # slow first item passes
+    with pytest.raises(faults.PipelineStallError):
+        next(it)                     # steady-state stall still trips
+    buf.close(timeout=5.0)
+
+
+def test_thread_buffer_injected_stall_is_batch_scoped():
+    plan = faults.FaultPlan(stall_batch=((1, 0.6),))
+    faults.install_plan(plan)
+    # non-batch scope: the plan must NOT see these items
+    inner = ThreadBuffer(lambda: iter(range(3)), fault_scope='page')
+    assert list(inner) == [0, 1, 2]
+    assert plan.fired() == []
+    # batch scope: item 1 stalls past the deadline
+    outer = ThreadBuffer(lambda: iter(range(3)), deadline=0.15,
+                         fault_scope='batch')
+    with pytest.raises(faults.PipelineStallError):
+        list(outer)
+    assert plan.fired() == ['stall_batch=1:0.6']
+    outer.close(timeout=5.0)
+
+
+# --- divergence gate ------------------------------------------------------
+
+def test_nan_action_halt_raises_divergence_with_context():
+    tr = _fresh('nan_action = halt\n')
+    faults.install_plan(faults.FaultPlan(nan_at_step=(2,)))
+    batches = synth_batches(n_batches=4)
+    tr.update(batches[0])
+    tr.update(batches[1])
+    tr.update(batches[2])           # NaN produced (gate defers one step)
+    with pytest.raises(faults.DivergenceError) as ei:
+        tr.update(batches[3])       # step 2's loss checked here
+    assert ei.value.step == 2
+    assert not np.isfinite(ei.value.loss)
+    assert 'step 2' in str(ei.value)
+
+
+def test_flush_divergence_check_settles_final_step():
+    """A NaN on the LAST update of a loop has no next step to surface
+    it — flush_divergence_check must."""
+    tr = _fresh('nan_action = halt\n')
+    faults.install_plan(faults.FaultPlan(nan_at_step=(1,)))
+    batches = synth_batches(n_batches=2)
+    tr.update(batches[0])
+    tr.update(batches[1])           # NaN pending
+    with pytest.raises(faults.DivergenceError) as ei:
+        tr.flush_divergence_check()
+    assert ei.value.step == 1
+
+
+def test_nan_action_rejects_unknown_value():
+    with pytest.raises(ValueError):
+        NetTrainer([('nan_action', 'explode')])
+
+
+def test_nan_breaker_trips_on_consecutive_not_isolated():
+    tr = _fresh('nan_action = skip\nnan_breaker = 2\n')
+    faults.install_plan(faults.FaultPlan(nan_at_step=(1, 3, 4)))
+    batches = synth_batches(n_batches=6)
+    tr.update(batches[0])
+    tr.update(batches[1])           # NaN #1 produced
+    tr.update(batches[2])           # checks step 1: streak 1
+    assert tr.nan_streak == 1
+    tr.update(batches[3])           # checks step 2 (finite): reset
+    assert tr.nan_streak == 0       # (step 3's NaN still pending)
+    tr.update(batches[4])           # checks step 3: streak 1
+    with pytest.raises(faults.DivergenceError) as ei:
+        tr.update(batches[5])       # checks step 4: streak 2 -> trips
+    assert ei.value.streak == 2
+
+
+# --- sharded checkpoint integrity ----------------------------------------
+
+def _tiny_tree():
+    import jax.numpy as jnp
+    return {'w': jnp.arange(8, dtype=jnp.float32),
+            'c': {'step': np.asarray(3, np.int64)}}
+
+
+def test_digest_written_and_detects_truncation(tmp_path):
+    d = str(tmp_path / 'ck')
+    path = sharded_ckpt.save_sharded(d, 1, _tiny_tree(), retry=NO_WAIT)
+    assert os.path.exists(os.path.join(path, 'ckpt_digest.json'))
+    assert sharded_ckpt.verify_step_dir(path) is None
+    # truncate the largest payload file
+    victim = max((os.path.join(r, f) for r, _, fs in os.walk(path)
+                  for f in fs if f != 'ckpt_digest.json'),
+                 key=os.path.getsize)
+    with open(victim, 'r+b') as f:
+        f.truncate(max(0, os.path.getsize(victim) // 2))
+    assert sharded_ckpt.verify_step_dir(path) is not None
+
+
+def test_restore_resilient_falls_back_past_corrupt_step(tmp_path):
+    d = str(tmp_path / 'ck')
+    tree = _tiny_tree()
+    sharded_ckpt.save_sharded(d, 1, tree, retry=NO_WAIT)
+    plan = faults.FaultPlan(seed=5, corrupt_shard=(2,))
+    faults.install_plan(plan)
+    sharded_ckpt.save_sharded(d, 2, tree, retry=NO_WAIT)
+    assert plan.fired() == ['corrupt_shard=2']
+    got, step = sharded_ckpt.restore_resilient(d, tree, retry=NO_WAIT)
+    assert step == 1                                 # newest INTACT wins
+    np.testing.assert_array_equal(np.asarray(got['w']), np.arange(8))
+    # the bad step is quarantined out of future scans
+    assert sharded_ckpt.all_steps(d) == [1]
+    assert os.path.isdir(os.path.join(d, 'step_2.corrupt'))
+
+
+def test_restore_resilient_no_quarantine_when_digest_verifies(
+        tmp_path, monkeypatch):
+    """A restore failure on a digest-intact checkpoint (an outage
+    outlasting the retry budget, a caller-side mismatch) must NOT
+    quarantine it — renaming would destroy the only good recovery point
+    over a fault that may clear."""
+    d = str(tmp_path / 'ck')
+    sharded_ckpt.save_sharded(d, 1, _tiny_tree(), retry=NO_WAIT)
+
+    class _Outage:
+        def restore(self, *a, **k):
+            raise OSError('synthetic storage outage')
+
+    monkeypatch.setattr(sharded_ckpt, '_shared_ck', lambda: _Outage())
+    # with ZERO quarantines the diagnosis must be the environmental
+    # error, not a corruption verdict
+    with pytest.raises(faults.RetryError):
+        sharded_ckpt.restore_resilient(d, _tiny_tree(), retry=NO_WAIT)
+    # the intact checkpoint survived the outage un-renamed...
+    assert sharded_ckpt.all_steps(d) == [1]
+    assert not os.path.isdir(os.path.join(d, 'step_1.corrupt'))
+    # ...and restores fine once the fault clears
+    monkeypatch.undo()
+    got, step = sharded_ckpt.restore_resilient(d, _tiny_tree(),
+                                               retry=NO_WAIT)
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(got['w']), np.arange(8))
+
+
+def test_restore_sharded_missing_step_fails_fast(tmp_path):
+    """Absence is a state, not a transient: an explicit step with no dir
+    raises immediately instead of sleeping through the backoff schedule
+    and logging spurious io_retry records."""
+    d = str(tmp_path / 'ck')
+    sharded_ckpt.save_sharded(d, 1, _tiny_tree(), retry=NO_WAIT)
+    log_before = len(faults.global_failure_log())
+    with pytest.raises(FileNotFoundError):
+        sharded_ckpt.restore_sharded(d, _tiny_tree(), step=99)
+    assert len(faults.global_failure_log()) == log_before
+
+
+def test_restore_resilient_raises_when_nothing_intact(tmp_path):
+    d = str(tmp_path / 'ck')
+    tree = _tiny_tree()
+    faults.install_plan(faults.FaultPlan(seed=5, corrupt_shard=(1,)))
+    sharded_ckpt.save_sharded(d, 1, tree, retry=NO_WAIT)
+    with pytest.raises(faults.CheckpointCorruptError):
+        sharded_ckpt.restore_resilient(d, tree, retry=NO_WAIT)
+    with pytest.raises(FileNotFoundError):
+        sharded_ckpt.restore_resilient(str(tmp_path / 'empty'), tree)
+
+
+def test_step_scan_skips_temp_and_quarantined_dirs(tmp_path):
+    d = tmp_path / 'ck'
+    for name in ('step_3', 'step_7.corrupt', 'step_9.tmp.123',
+                 'tmp_step_11'):
+        (d / name).mkdir(parents=True)
+    assert sharded_ckpt.latest_step(str(d)) == 3
+    assert sharded_ckpt.all_steps(str(d)) == [3]
+
+
+# --- supervised end-to-end recovery ---------------------------------------
+
+def _sup_config(**kw):
+    base = dict(batch_deadline=0.3, max_restarts=3, nan_breaker=0,
+                save_every=2, buffer_size=2, retry=NO_WAIT)
+    base.update(kw)
+    return SupervisorConfig(**base)
+
+
+def test_supervisor_recovers_write_fault_and_stall_bitwise(tmp_path):
+    """Acceptance: a FaultPlan that kills a checkpoint write AND stalls
+    the data pipeline still completes all N steps, and the final params
+    are bitwise-identical to an uninterrupted run with the same seed."""
+    batches = synth_batches(n_batches=8)
+
+    t_ref = _fresh()
+    for b in batches:
+        t_ref.update(b)
+    ref = snap_params(t_ref)
+
+    # The stall must out-last the consumer's worst-case arrival delay at
+    # batch 5 (three updates + two fsync'd periodic saves) by more than
+    # the 0.3s deadline, or a loaded machine absorbs it and the watchdog
+    # lawfully never trips — hence 4s, not something snappier.
+    plan = faults.FaultPlan(seed=1, raise_on_write=(2,),
+                            stall_batch=((5, 4.0),))
+    faults.install_plan(plan)
+    tr = _fresh()
+    log = faults.FailureLog()
+    sup = TrainSupervisor(tr, str(tmp_path / 'sup'), _sup_config(),
+                          failure_log=log)
+    n = sup.run(lambda k: iter(batches[k:]))
+
+    assert n == 8
+    assert tr.sample_counter == 8
+    assert sorted(plan.fired()) == ['raise_on_write=2', 'stall_batch=5:4']
+    assert len(log.records('PipelineStallError')) == 1
+    assert len(log.records('restored')) == 1
+    assert sup.state == 'IDLE'
+    assert_params_equal(snap_params(tr), ref, rtol=0, atol=0)   # bit-exact
+
+
+def test_supervisor_recovers_corrupt_shard_and_divergence_bitwise(tmp_path):
+    """Satellite: corrupt the newest checkpoint shard, then diverge — the
+    supervisor must fall back to the older intact checkpoint, replay, and
+    still end bitwise-identical."""
+    batches = synth_batches(n_batches=8)
+
+    t_ref = _fresh()
+    for b in batches:
+        t_ref.update(b)
+    ref = snap_params(t_ref)
+
+    plan = faults.FaultPlan(seed=2, corrupt_shard=(6,), nan_at_step=(6,))
+    faults.install_plan(plan)
+    tr = _fresh()
+    log = faults.FailureLog()
+    sup = TrainSupervisor(tr, str(tmp_path / 'sup'),
+                          _sup_config(nan_breaker=1), failure_log=log)
+    n = sup.run(lambda k: iter(batches[k:]))
+
+    assert n == 8
+    assert sorted(plan.fired()) == ['corrupt_shard=6', 'nan_at_step=6']
+    assert len(log.records('DivergenceError')) == 1
+    # restore skipped the corrupt step_6 and landed on step_4
+    restored = log.records('restored')
+    assert len(restored) == 1 and restored[0].step == 4
+    assert os.path.isdir(str(tmp_path / 'sup' / 'step_6.corrupt'))
+    assert_params_equal(snap_params(tr), ref, rtol=0, atol=0)   # bit-exact
+
+
+def test_supervisor_gives_up_after_max_restarts(tmp_path):
+    batches = synth_batches(n_batches=6)
+    # an unrecoverable plan: each replay (restored to the anchor, so
+    # epoch-absolute indices restart near 0) reaches the next armed
+    # stall before outrunning the event chain.  4s stalls for the same
+    # reason as the bitwise test above: a loaded machine's consumer-side
+    # latency must not absorb the stall
+    plan = faults.FaultPlan(stall_batch=((0, 4.0), (1, 4.0), (2, 4.0)))
+    faults.install_plan(plan)
+    tr = _fresh()
+    log = faults.FailureLog()
+    sup = TrainSupervisor(tr, str(tmp_path / 'sup'),
+                          _sup_config(max_restarts=2), failure_log=log)
+    with pytest.raises(faults.PipelineStallError):
+        sup.run(lambda k: iter(batches[k:]))
+    assert len(log.records('giving_up')) == 1
+    assert sup.restarts_total == 3      # two restores + the fatal third
+
+
+def test_supervisor_prunes_checkpoints_to_keep_last(tmp_path):
+    batches = synth_batches(n_batches=8)
+    tr = _fresh()
+    sup = TrainSupervisor(tr, str(tmp_path / 'sup'),
+                          _sup_config(save_every=1, keep_last=2))
+    n = sup.run(lambda k: iter(batches[k:]))
+    assert n == 8
+    # anchor + 8 periodic saves, bounded to the 2 newest
+    assert sharded_ckpt.all_steps(str(tmp_path / 'sup')) == [8, 7]
+
+
+def test_periodic_save_skipped_mid_nan_streak(tmp_path):
+    """A periodic save never checkpoints mid-NaN-streak state: a
+    poisoned checkpoint would become the newest restore target (CRC
+    digests cannot see NaNs) and wedge recovery in a restore-diverge
+    loop."""
+    batches = synth_batches(n_batches=6)
+    faults.install_plan(faults.FaultPlan(nan_at_step=(2, 3)))
+    tr = _fresh('nan_breaker = 3\n')    # armed, but streak peaks at 2
+    sup = TrainSupervisor(tr, str(tmp_path / 'sup'),
+                          _sup_config(save_every=1, nan_breaker=0,
+                                      keep_last=0))
+    n = sup.run(lambda k: iter(batches[k:]))
+    assert n == 6
+    steps = set(sharded_ckpt.all_steps(str(tmp_path / 'sup')))
+    assert not {3, 4} & steps           # mid-streak boundaries skipped
+    assert {1, 2, 5, 6} <= steps        # finite-streak saves landed
+
+
+def test_supervisor_prunes_quarantined_dirs_too(tmp_path):
+    """keep_last bounds `.corrupt` post-mortem dirs as well — unbounded
+    quarantine growth would fill exactly the degraded disks that
+    produce it."""
+    d = str(tmp_path / 'sup')
+    for step in range(1, 5):
+        sharded_ckpt.save_sharded(d, step, _tiny_tree(), retry=NO_WAIT)
+        sharded_ckpt.quarantine_step(d, step, 'synthetic bit rot')
+    assert sharded_ckpt.quarantined_steps(d) == [4, 3, 2, 1]
+    tr = _fresh()
+    sup = TrainSupervisor(tr, d, _sup_config(keep_last=2))
+    sup.save()
+    assert sharded_ckpt.quarantined_steps(d) == [4, 3]
+
+
+def test_replay_stability_contract():
+    """Supervised bitwise recovery needs `is_replay_stable`; shuffling
+    imgbin passes must report False, once-at-init mnist stays True, and
+    wrappers delegate."""
+    from cxxnet_tpu.io.data import ThreadBufferIterator
+    from cxxnet_tpu.io.iter_imbin import ImageBinIterator
+    from cxxnet_tpu.io.iter_mnist import MNISTIterator
+    imbin = ImageBinIterator()
+    assert imbin.is_replay_stable()
+    imbin.set_param('shuffle', '1')
+    assert not imbin.is_replay_stable()
+    mnist = MNISTIterator()
+    mnist.set_param('shuffle', '1')      # shuffles once at init: stable
+    assert mnist.is_replay_stable()
+    assert not ThreadBufferIterator(imbin).is_replay_stable()
+
+
+def test_exact_resume_unharmed_by_partial_sidecar_litter(tmp_path):
+    """Exact resume still works when the checkpoint dir is littered with
+    the debris a kill leaves behind: a partial temp dir and a quarantined
+    step must both be invisible to restore."""
+    batches = synth_batches(n_batches=6)
+    t_a = _fresh()
+    for b in batches[:3]:
+        t_a.update(b)
+    d = str(tmp_path / 'exact')
+    t_a.save_training_state(d, 3)
+    os.makedirs(os.path.join(d, 'step_9.tmp.42'))      # killed mid-write
+    os.makedirs(os.path.join(d, 'step_8.corrupt'))     # quarantined earlier
+    for b in batches[3:]:
+        t_a.update(b)
+
+    t_b = _fresh()
+    step = t_b.load_training_state(d, restore_params=True, fallback=True)
+    assert step == 3
+    for b in batches[3:]:
+        t_b.update(b)
+    assert_params_equal(snap_params(t_b), snap_params(t_a), rtol=0, atol=0)
+
+
+# --- CLI / config surface -------------------------------------------------
+
+def test_cli_knobs_parse_into_learn_task():
+    from cxxnet_tpu.main import LearnTask
+    lt = LearnTask()
+    lt.set_param('train.fault_plan', 'nan_at_step=3;stall_batch=2:0.5')
+    lt.set_param('train.supervise', '1')
+    lt.set_param('train.watchdog_deadline', '7.5')
+    lt.set_param('train.max_restarts', '5')
+    lt.set_param('train.nan_breaker', '4')
+    lt.set_param('train.save_every', '10')
+    assert lt.fault_plan == 'nan_at_step=3;stall_batch=2:0.5'
+    assert (lt.supervise, lt.watchdog_deadline, lt.max_restarts,
+            lt.nan_breaker, lt.save_every) == (1, 7.5, 5, 4, 10)
+    plan = faults.FaultPlan.parse(lt.fault_plan)
+    assert plan.describe() == 'seed=0;stall_batch=2:0.5;nan_at_step=3'
