@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Benchmark: online serving latency/throughput (doc/serving.md).
+
+Prints ONE JSON line so future PRs get a serving perf trajectory next to
+the training BENCH_*.json ledger:
+
+  {"metric": "serve_p99_latency_ms", "value": P99, "unit": "ms",
+   "p50_ms": P50, "mean_ms": M, "requests_per_sec": R,
+   "rows_per_sec": RW, "compile_count": C, "buckets": [...],
+   "clients": N, "duration_sec": D}
+
+Method: a tiny MLP (random init — serving cost is shape-bound, not
+value-bound) behind the real PredictEngine + DynamicBatcher stack;
+``--clients`` in-process threads submit mixed-size requests (1..max/2
+rows, seeded) back-to-back for ``--duration`` seconds after a warmup.
+The engine pre-compiles every bucket, so measured latency is pure
+serving-path overhead: queue + coalesce window + pad + forward + split.
+
+Env: honors JAX_PLATFORMS (run with =cpu for a hardware-independent
+number); CXXNET_SERVE_BENCH_* override the defaults below.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+NET_CFG = """
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 64
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 16
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,32
+batch_size = 32
+eta = 0.1
+"""
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--clients', type=int, default=int(
+        os.environ.get('CXXNET_SERVE_BENCH_CLIENTS', 8)))
+    ap.add_argument('--duration', type=float, default=float(
+        os.environ.get('CXXNET_SERVE_BENCH_DURATION', 3.0)))
+    ap.add_argument('--buckets', default=os.environ.get(
+        'CXXNET_SERVE_BENCH_BUCKETS', '1,8,32'))
+    ap.add_argument('--max-wait', type=float, default=0.001)
+    args = ap.parse_args(argv)
+
+    try:
+        from cxxnet_tpu import wrapper
+        from cxxnet_tpu.serve import DynamicBatcher, PredictEngine
+        from cxxnet_tpu.utils.bucketing import parse_buckets
+
+        net = wrapper.Net(dev='', cfg=NET_CFG)
+        net.set_param('inference_only', '1')
+        net.init_model()
+        buckets = parse_buckets(args.buckets)
+        engine = PredictEngine(net._trainer, buckets)
+        engine.warm()
+        batcher = DynamicBatcher(engine, max_queue=4 * args.clients,
+                                 max_wait=args.max_wait, deadline=30.0)
+
+        lat_ms = []
+        rows_done = [0]
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def client(cid: int) -> None:
+            rng = np.random.RandomState(cid)
+            while not stop.is_set():
+                n = int(rng.randint(1, max(2, buckets[-1] // 2)))
+                d = rng.randn(n, 1, 1, 32).astype(np.float32)
+                t0 = time.monotonic()
+                batcher.submit(d)
+                dt = (time.monotonic() - t0) * 1e3
+                with lock:
+                    lat_ms.append(dt)
+                    rows_done[0] += n
+
+        threads = [threading.Thread(target=client, args=(cid,), daemon=True)
+                   for cid in range(args.clients)]
+        warmup = min(0.5, args.duration / 4)
+        for t in threads:
+            t.start()
+        time.sleep(warmup)
+        with lock:          # measure steady state only
+            lat_ms.clear()
+            rows_done[0] = 0
+        t_start = time.monotonic()
+        time.sleep(args.duration)
+        elapsed = time.monotonic() - t_start
+        stop.set()
+        for t in threads:
+            t.join(10)
+        batcher.close(timeout=10)
+
+        arr = np.asarray(lat_ms)
+        out = {
+            'metric': 'serve_p99_latency_ms',
+            'value': round(float(np.quantile(arr, 0.99)), 4),
+            'unit': 'ms',
+            'p50_ms': round(float(np.quantile(arr, 0.5)), 4),
+            'mean_ms': round(float(arr.mean()), 4),
+            'requests_per_sec': round(arr.size / elapsed, 2),
+            'rows_per_sec': round(rows_done[0] / elapsed, 2),
+            'compile_count': engine.compile_count,
+            'buckets': list(buckets),
+            'clients': args.clients,
+            'duration_sec': round(elapsed, 3),
+            'platform': __import__('jax').default_backend(),
+        }
+    except Exception as e:  # structured failure, never a bare traceback
+        out = {'metric': 'serve_p99_latency_ms', 'value': None,
+               'unit': 'ms', 'error': repr(e)}
+    print(json.dumps(out))
+    return 0 if 'error' not in out else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

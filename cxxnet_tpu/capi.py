@@ -120,7 +120,15 @@ def net_predict_batch(net: Net, data_mv, dshape) -> np.ndarray:
 
 
 def net_predict_iter(net: Net, it: DataIter) -> np.ndarray:
-    return _as_f32(net.predict(it))
+    # Whole-iterator predict (CXNNetPredictIter).  The underlying path is
+    # the pipelined predict_stream generator — per-batch host chunks with
+    # pad rows already trimmed — so peak host memory beyond the returned
+    # array is O(batch); the single concatenation happens only here, at
+    # the ABI boundary (the C side needs one contiguous buffer).
+    chunks = list(net.predict_stream(it))
+    if not chunks:
+        return np.empty((0,), np.float32)
+    return _as_f32(np.concatenate(chunks, axis=0))
 
 
 def net_extract_batch(net: Net, data_mv, dshape, node: str) -> np.ndarray:
@@ -128,8 +136,57 @@ def net_extract_batch(net: Net, data_mv, dshape, node: str) -> np.ndarray:
 
 
 def net_extract_iter(net: Net, it: DataIter, node: str) -> np.ndarray:
-    return _as_4d(net.extract(it, node))
+    # Whole-iterator extract: same streaming path as net_predict_iter —
+    # concatenate trimmed per-batch activations once, at the boundary.
+    chunks = list(net.extract_stream(it, node))
+    if not chunks:
+        return np.empty((0, 1, 1, 1), np.float32)
+    return _as_4d(np.concatenate(chunks, axis=0))
 
 
 def net_evaluate(net: Net, it: DataIter, name: str) -> str:
     return net.evaluate(it, name)
+
+
+# ---- serving surface (CXNNetServe*) --------------------------------------
+
+def net_serve_start(net: Net, cfg: str) -> None:
+    """Stand up the serving stack.  ``cfg`` is a compact ``k=v[;k=v...]``
+    list (utils.config.parse_kv_list): ``buckets`` (``:``-separated, e.g.
+    ``1:8:32``), ``max_queue``, ``max_wait`` (seconds), ``deadline``
+    (seconds), ``warm`` (0/1).  Empty string = all defaults."""
+    from .utils.config import parse_kv_list
+    kw = {}
+    for key, val in parse_kv_list(cfg or ''):
+        if key == 'buckets':
+            kw['buckets'] = val.replace(':', ',')
+        elif key == 'max_queue':
+            kw['max_queue'] = int(val)
+        elif key == 'max_wait':
+            kw['max_wait'] = float(val)
+        elif key == 'deadline':
+            kw['deadline'] = float(val)
+        elif key == 'warm':
+            kw['warm'] = bool(int(val))
+        else:
+            raise ValueError(f'unknown serve option: {key!r}')
+    net.serve_start(**kw)
+
+
+def net_serve_predict(net: Net, data_mv, dshape) -> np.ndarray:
+    """One request through the micro-batcher: class id per row.  Typed
+    serving errors (queue full, deadline) propagate as Python exceptions
+    for the C layer's error surface."""
+    return _as_f32(net.serve_predict(_from_buffer(data_mv, tuple(dshape))))
+
+
+def net_serve_reload(net: Net, fname: str) -> None:
+    net.serve_reload(fname)
+
+
+def net_serve_stats(net: Net) -> str:
+    return net.serve_stats()
+
+
+def net_serve_stop(net: Net) -> None:
+    net.serve_stop()

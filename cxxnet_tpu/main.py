@@ -57,6 +57,12 @@ class LearnTask:
         self.extract_node_name = ''
         self.name_pred = 'pred.txt'
         self.output_format = 1
+        # online serving knobs (task=serve, doc/serving.md)
+        self.serve_buckets = '1,8,32'  # serve.buckets batch-size ladder
+        self.serve_max_queue = 64      # serve.max_queue admission bound
+        self.serve_max_wait = 0.002    # serve.max_wait coalesce window (s)
+        self.serve_deadline = 1.0      # serve.deadline per-request (s)
+        self.serve_reload = 0.0        # serve.reload poll period (s, 0=off)
         self.cfg: List[ConfigEntry] = []
         self.net_trainer: Optional[NetTrainer] = None
         self.itr_train = None
@@ -83,6 +89,11 @@ class LearnTask:
             'train.nan_breaker': ('nan_breaker', int),
             'train.save_every': ('save_every', int),
             'train.keep_last': ('keep_last', int),
+            'serve.buckets': ('serve_buckets', str),
+            'serve.max_queue': ('serve_max_queue', int),
+            'serve.max_wait': ('serve_max_wait', float),
+            'serve.deadline': ('serve_deadline', float),
+            'serve.reload': ('serve_reload', float),
         }
         if name in simple:
             attr, typ = simple[name]
@@ -95,7 +106,11 @@ class LearnTask:
     def _create_net(self) -> NetTrainer:
         if self.reset_net_type != -1:
             self.net_type = self.reset_net_type
-        return NetTrainer(self.cfg)
+        cfg = self.cfg
+        if self.task == 'serve':
+            # serving never trains: skip optimizer-state allocation
+            cfg = cfg + [('inference_only', '1')]
+        return NetTrainer(cfg)
 
     def _model_path(self, counter: int) -> str:
         return os.path.join(self.name_model_dir, f'{counter:04d}.model')
@@ -174,6 +189,9 @@ class LearnTask:
         # atomic (temp+fsync+rename) + retried: a crash mid-save can never
         # leave a truncated file where continue=1 would load it
         model_io.save_model_file(path, _write)
+        # integrity sidecar for hot-reloading servers (serve/registry.py
+        # digest-verifies before swapping a checkpoint into live traffic)
+        model_io.write_model_digest(path)
         if self.exact_ckpt:
             # beyond reference: sidecar with optimizer state + counters so
             # continue=1 resumes bit-exact mid-momentum (the reference
@@ -207,13 +225,16 @@ class LearnTask:
                 continue
             if name == 'iter' and val == 'end':
                 assert flag != 0, 'wrong configuration file'
-                if flag == 1 and self.task not in ('pred', 'pred_raw'):
+                if flag == 1 and self.task not in ('pred', 'pred_raw',
+                                                   'serve'):
                     assert self.itr_train is None, 'can only have one data'
                     self.itr_train = create_iterator(itcfg)
-                if flag == 2 and self.task not in ('pred', 'pred_raw'):
+                if flag == 2 and self.task not in ('pred', 'pred_raw',
+                                                   'serve'):
                     self.itr_evals.append(create_iterator(itcfg))
                     self.eval_names.append(evname)
-                if flag == 3 and self.task in ('pred', 'pred_raw', 'extract'):
+                if flag == 3 and self.task in ('pred', 'pred_raw', 'extract',
+                                               'serve'):
                     assert self.itr_pred is None, 'only one pred section'
                     self.itr_pred = create_iterator(itcfg)
                 flag = 0
@@ -411,6 +432,90 @@ class LearnTask:
                     fo.write(' '.join(f'{v:g}' for v in row) + '\n')
         print(f'finished prediction, write into {self.name_pred}')
 
+    def task_serve(self) -> None:
+        """``task=serve``: the online inference stack (doc/serving.md) —
+        bucketed engine + dynamic micro-batcher + (optionally) checkpoint
+        hot-reload — driven over the ``pred=`` iterator as the request
+        source, so the CLI exercises exactly the path a fronting server
+        embeds via ``net_serve_*``.  Predictions land in ``pred=``'s file
+        (task=pred format); per-bucket latency/queue/throughput stats go
+        to stderr at shutdown in eval-line format."""
+        assert self.itr_pred is not None, 'must specify a pred iterator'
+        import numpy as np
+
+        from .serve import DynamicBatcher, ModelRegistry, PredictEngine
+        from .utils.bucketing import parse_buckets
+
+        engine = PredictEngine(self.net_trainer,
+                               parse_buckets(self.serve_buckets))
+        engine.warm()
+        if not self.silent:
+            print(f'serve: warmed {len(engine.buckets)} bucket programs '
+                  f'{engine.buckets}', flush=True)
+        batcher = DynamicBatcher(engine, max_queue=self.serve_max_queue,
+                                 max_wait=self.serve_max_wait,
+                                 deadline=self.serve_deadline)
+        registry = None
+        if self.serve_reload > 0:
+            registry = ModelRegistry(
+                engine, self.name_model_dir,
+                poll_interval=self.serve_reload,
+                current=self.start_counter - 1,
+                on_swap=None if self.silent else (
+                    lambda c, p: print(f'serve: hot-reloaded checkpoint '
+                                       f'{c} from {p}', flush=True)))
+            registry.start()
+        print('start serving...')
+        served = 0
+        try:
+            with open(self.name_pred, 'w') as fo:
+                # windowed async submits: keep up to half the admission
+                # queue in flight so the batcher can coalesce, drain in
+                # order so the output file matches task=pred row order
+                import collections
+                pending = collections.deque()
+                cap = max(1, self.serve_max_queue // 2)
+                # the bulk drive keeps `cap` requests queued by design, so
+                # the LIVE-traffic deadline would expire in our own queue
+                # on any non-trivial model; bulk requests are throughput-
+                # bound, not latency-bound — the bound scales with the
+                # queue a request may sit behind (generous per-request
+                # allowance; a truly wedged worker still trips it)
+                bulk_deadline = max(self.serve_deadline,
+                                    60.0 + 30.0 * cap)
+
+                def _drain_one():
+                    for v in self.net_trainer._pred_transform(
+                            batcher.wait(pending.popleft())):
+                        fo.write(f'{v:g}\n')
+
+                for batch in self.itr_pred:
+                    n = batch.batch_size - batch.num_batch_padd
+                    if not n:
+                        continue
+                    data = batch.data
+                    if batch.norm_spec is not None:
+                        # serving wire contract: normalized floats
+                        data = batch.norm_spec.apply(data)
+                    rows = np.ascontiguousarray(
+                        np.asarray(data, np.float32)[:n])
+                    pending.append(batcher.submit_async(
+                        rows, deadline=bulk_deadline))
+                    served += n
+                    while len(pending) >= cap:
+                        _drain_one()
+                while pending:
+                    _drain_one()
+        finally:
+            if registry is not None:
+                registry.close(timeout=5.0)
+            batcher.close(timeout=30.0)
+            sys.stderr.write(f'[serve]{batcher.report("serve")}\n')
+            sys.stderr.flush()
+        print(f'finished serving {served} instances, predictions in '
+              f'{self.name_pred} (compiled {engine.compile_count} programs '
+              f'for {len(engine.buckets)} buckets)')
+
     def task_extract(self) -> None:
         assert self.itr_pred is not None, 'must specify a pred iterator'
         node = self.extract_node_name or 'top[-1]'
@@ -454,6 +559,8 @@ class LearnTask:
             self.task_predict_raw()
         elif self.task == 'extract':
             self.task_extract()
+        elif self.task == 'serve':
+            self.task_serve()
         if plan is not None and not self.silent:
             # chaos-drill closure: which events actually fired, and what
             # the runtime saw/did about them (doc/fault_tolerance.md)

@@ -236,6 +236,67 @@ def read_model_file(path: str, read_fn: Callable, retry=None):
     return retry.call(attempt, op_name=f'read_model:{os.path.basename(path)}')
 
 
+def model_digest_path(path: str) -> str:
+    return os.fspath(path) + '.crc32'
+
+
+def file_crc32(path: str) -> int:
+    """Chunked crc32 of a file's bytes."""
+    import zlib
+    crc = 0
+    with open(path, 'rb') as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def write_model_digest(path: str) -> str:
+    """Write the ``<model>.crc32`` integrity sidecar (JSON ``{size,
+    crc32}``) next to a just-saved model file, atomically.  The model
+    rename already guarantees *completeness*; the digest additionally
+    catches silent byte corruption between writer and a hot-reloading
+    reader (``serve/registry.py`` verifies it before swapping a new
+    checkpoint into a live engine)."""
+    import json
+    digest = {'size': os.path.getsize(path), 'crc32': file_crc32(path)}
+    side = model_digest_path(path)
+    with atomic_write(side) as f:
+        f.write(json.dumps(digest).encode())
+    return side
+
+
+def verify_model_digest(path: str):
+    """Return None when ``path`` matches its digest sidecar (or no
+    sidecar exists — unverified-but-plausible, the same policy as the
+    sharded-checkpoint verifier), else a human-readable reason."""
+    import json
+    side = model_digest_path(path)
+    if not os.path.exists(side):
+        return None
+    try:
+        with open(side, 'rb') as f:
+            digest = json.load(f)
+        size = os.path.getsize(path)
+    except (OSError, ValueError) as e:
+        return f'unreadable digest sidecar: {e!r}'
+    if not isinstance(digest, dict) \
+            or not isinstance(digest.get('size'), int) \
+            or not isinstance(digest.get('crc32'), int):
+        # malformed-but-valid JSON must be a REASON, not a crash — the
+        # registry blacklists on reasons; an escaping TypeError would
+        # retry the broken sidecar forever
+        return f'malformed digest sidecar: {digest!r}'
+    if size != digest['size']:
+        return f'size {size} != recorded {digest["size"]}'
+    crc = file_crc32(path)
+    if crc != digest['crc32']:
+        return f'crc32 {crc:#x} != recorded {digest["crc32"]:#x}'
+    return None
+
+
 def blob_to_params(net, blob: bytes):
     raw = blob_to_raw(net.cfg.layers, blob)
     params = {}

@@ -89,6 +89,8 @@ class NetTrainer:
         self.max_round = 1
         self.tensor_parallel = 1
         self.test_on_server = 0
+        self.inference_only = 0    # skip optimizer-state allocation (serve)
+        self.pred_buckets = None   # closed batch-size ladder for predict
         self.nan_action = 'none'
         self.nan_breaker = 0       # consecutive non-finite losses -> raise
         self.nan_streak = 0        # current consecutive non-finite count
@@ -132,6 +134,20 @@ class NetTrainer:
             self.tensor_parallel = int(val)
         if name == 'test_on_server':
             self.test_on_server = int(val)
+        if name == 'inference_only':
+            # serving-path trainers hold params only: no optimizer moments
+            # or grad accumulator are ever allocated (serve/engine.py)
+            self.inference_only = int(val)
+        if name == 'pred_buckets':
+            # bound the predict compile cache: every predict/extract batch
+            # is padded to the smallest bucket that fits (oversize splits
+            # into max-bucket chunks), so ad-hoc wrapper/C-ABI callers with
+            # arbitrary batch sizes trace at most len(buckets) programs
+            # (doc/serving.md).  Empty/0 disables.
+            from ..utils.bucketing import parse_buckets
+            v = val.strip()
+            self.pred_buckets = None if v in ('', '0', 'none') \
+                else parse_buckets(v)
         if name == 'nan_action':
             if val not in ('none', 'skip', 'halt'):
                 raise ValueError(
@@ -225,6 +241,12 @@ class NetTrainer:
         put = lambda tree: jax.tree.map(  # noqa: E731
             jax.device_put, tree, shardings)
         self.params = put(self.params)
+        if self.inference_only:
+            # serving holds params only — roughly 1/3 the device memory of
+            # a momentum trainer, 1/4 of Adam; update() refuses below
+            self.opt_state = None
+            self.grad_acc = None
+            return
         opt = init_opt_state(self.net_cfg.updater_type, self.params)
         self.opt_state = {k: put(v) for k, v in opt.items()}
         self.grad_acc = put(jax.tree.map(jnp.zeros_like, self.params))
@@ -585,6 +607,10 @@ class NetTrainer:
     def update_staged(self, staged) -> None:
         """Dispatch the training step for a batch staged by
         :meth:`stage_batch`."""
+        if self.inference_only:
+            raise RuntimeError(
+                'trainer was built inference_only=1 (no optimizer state); '
+                'it can predict/evaluate but not train')
         (data, label, extra, mask, host_label, bs, num_batch_padd,
          norm) = staged
         do_update = (self.sample_counter + 1) % self.update_period == 0
@@ -802,11 +828,48 @@ class NetTrainer:
             _consume(pending)
         return ret + self.metric.print(name)
 
+    def _forward_node_bucketed(self, batch, nid: int) -> np.ndarray:
+        """One node's host output with the batch split/padded onto the
+        ``pred_buckets`` ladder (``utils/bucketing.py``): the jitted
+        forward only ever sees bucket shapes, so a stream of arbitrary
+        request sizes compiles at most ``len(pred_buckets)`` programs
+        instead of one per novel shape.  Pad rows are sliced off before
+        concatenation; returns all ``batch.batch_size`` rows (callers
+        trim ``num_batch_padd`` exactly as on the unbucketed path)."""
+        from ..utils.bucketing import chunk_plan, pad_rows
+        ddim = int(self._mesh.shape['data'])
+        bad = [b for b in self.pred_buckets if b % ddim]
+        if bad:
+            # same invariant PredictEngine enforces at construction: a
+            # padded batch must shard evenly over the mesh data axis
+            raise ValueError(
+                f'pred_buckets {bad} do not divide the mesh data axis '
+                f'({ddim} devices); pick multiples so padded batches '
+                f'shard evenly')
+        norm = self._norm_args(batch)
+        data = np.asarray(batch.data)
+        extras = [np.asarray(e) for e in batch.extra_data]
+        outs = []
+        for off, take, b in chunk_plan(data.shape[0], self.pred_buckets):
+            d = self._shard_batch(pad_rows(data[off:off + take], b),
+                                  cast=not norm)
+            ex = tuple(self._shard_batch(pad_rows(e[off:off + take], b))
+                       for e in extras)
+            values = self._forward_fn(self.params, d, ex, self.round,
+                                      norm=norm)
+            outs.append(np.asarray(values[nid])[:take])
+        if not outs:
+            return np.empty((0,), np.float32)
+        return np.concatenate(outs, axis=0)
+
     def predict(self, batch) -> np.ndarray:
         """Argmax of the final node per instance (``TransformPred``,
         nnet_impl:286-298)."""
         last = self.net.cfg.layers[-1].nindex_out[-1]
-        out = self._forward_nodes(batch, [last])[0]
+        if self.pred_buckets:
+            out = self._forward_node_bucketed(batch, last)
+        else:
+            out = self._forward_nodes(batch, [last])[0]
         n = batch.batch_size - batch.num_batch_padd
         out = out[:n]
         return self._pred_transform(out)
@@ -823,7 +886,16 @@ class NetTrainer:
         is enqueued before batch i's readback blocks, so the device
         computes under the host transfer — the pred/extract analog of
         :meth:`evaluate`'s overlap (reference eval-request overlap,
-        nnet_impl:232-241)."""
+        nnet_impl:232-241).  When ``pred_buckets`` is set the stream
+        routes through the bucketed forward instead (trading the
+        one-batch overlap for the bounded compile cache) — otherwise an
+        iterator with varying batch sizes would still trace novel-shape
+        programs and defeat the ladder."""
+        if self.pred_buckets:
+            for batch in batches:
+                out = self._forward_node_bucketed(batch, nid)
+                yield out[:batch.batch_size - batch.num_batch_padd]
+            return
         pending = None
         for batch in batches:
             outs = self._forward_nodes_async(batch, [nid])
@@ -842,7 +914,10 @@ class NetTrainer:
 
     def extract_feature(self, batch, node_name: str) -> np.ndarray:
         nid = self.net.node_index(node_name)
-        out = self._forward_nodes(batch, [nid])[0]
+        if self.pred_buckets:
+            out = self._forward_node_bucketed(batch, nid)
+        else:
+            out = self._forward_nodes(batch, [nid])[0]
         n = batch.batch_size - batch.num_batch_padd
         return out[:n]
 

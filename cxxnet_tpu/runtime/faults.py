@@ -69,6 +69,39 @@ class CheckpointCorruptError(TrainingFault):
     """A checkpoint failed integrity verification on restore."""
 
 
+class ServeError(RuntimeError):
+    """Base of the online-serving failure taxonomy (doc/serving.md).
+    Deliberately NOT a :class:`TrainingFault`: serving errors are
+    per-request outcomes a client handles (shed load, retry elsewhere),
+    not process-level faults a supervisor restores a checkpoint for."""
+
+
+class ServeOverloadError(ServeError):
+    """Admission control rejected a request: the batcher's bounded queue
+    is full.  Typed so a fronting server can map it to HTTP 429 /
+    RESOURCE_EXHAUSTED instead of letting clients pile onto a queue that
+    can only grow tail latency."""
+
+    def __init__(self, queue_depth: int, max_queue: int):
+        self.queue_depth = int(queue_depth)
+        self.max_queue = int(max_queue)
+        super().__init__(
+            f'serve queue full: {queue_depth}/{max_queue} requests pending')
+
+
+class DeadlineExceededError(ServeError):
+    """A request's deadline elapsed before its batch executed (or before
+    the result reached the caller).  The row count lets the metrics layer
+    account shed work."""
+
+    def __init__(self, deadline: float, waited: float, rows: int = 0):
+        self.deadline = float(deadline)
+        self.waited = float(waited)
+        self.rows = int(rows)
+        super().__init__(
+            f'request deadline {deadline:g}s exceeded after {waited:.3f}s')
+
+
 class FaultInjected(OSError):
     """Deterministic injected fault.  Subclasses ``OSError`` so the
     storage retry policies treat it exactly like a real transient I/O

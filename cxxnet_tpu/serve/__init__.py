@@ -1,0 +1,29 @@
+"""Online inference serving (doc/serving.md).
+
+The first subsystem on the serving half of the north star: turn the
+offline ``task=pred`` loop into an always-on predict service that can
+sit behind heavy live traffic.
+
+* :class:`~cxxnet_tpu.serve.engine.PredictEngine` — inference-only model
+  state, jitted predict over a small closed ladder of batch-size buckets
+  (compile cache provably bounded), atomic hot parameter swap,
+* :class:`~cxxnet_tpu.serve.batcher.DynamicBatcher` — bounded request
+  queue with admission control, a max-wait/max-batch coalescing window,
+  per-request deadlines, per-bucket latency/throughput stats,
+* :class:`~cxxnet_tpu.serve.registry.ModelRegistry` — watch the training
+  run's ``model_dir`` for new atomically-renamed checkpoints,
+  digest-verify, warm, swap — without dropping in-flight requests.
+
+Entry points: ``task=serve`` in the CLI (``main.py``), ``Net.serve_*``
+in the Python wrapper, ``net_serve_*`` in the C ABI glue (``capi.py``).
+"""
+
+from ..runtime.faults import (DeadlineExceededError, ServeError,
+                              ServeOverloadError)
+from .batcher import DynamicBatcher, ServeRequest
+from .engine import PredictEngine
+from .registry import ModelRegistry, load_model_params
+
+__all__ = ['PredictEngine', 'DynamicBatcher', 'ServeRequest',
+           'ModelRegistry', 'load_model_params', 'ServeError',
+           'ServeOverloadError', 'DeadlineExceededError']

@@ -1,0 +1,243 @@
+"""Dynamic micro-batching: coalesce concurrent requests onto bucket shapes.
+
+The batching core of the serving subsystem (doc/serving.md).  Individual
+clients submit small, oddly-sized requests; executing each alone wastes
+the accelerator (a 1-row forward costs nearly as much as a 32-row one)
+and, worse, every novel size would be a fresh XLA compile.  The
+``DynamicBatcher`` sits between clients and a ``PredictEngine``:
+
+* a **bounded queue** with admission control — a full queue rejects
+  immediately with a typed ``ServeOverloadError`` (fail fast beats
+  queueing into certain deadline misses),
+* a **batching window** — the worker takes the oldest request, then
+  waits at most ``max_wait`` seconds (or until ``max_batch`` rows, the
+  engine's largest bucket) for more requests to coalesce.  Arrival order
+  is preserved; requests are never split across executed batches,
+* **per-request deadlines** — a request whose deadline passes before its
+  batch runs gets a typed ``DeadlineExceededError`` instead of a stale
+  answer; the caller side of :meth:`wait` enforces the same bound, so a
+  wedged worker cannot strand clients,
+* **metrics** — per-bucket latency distributions, throughput, queue
+  depth and shed counters accumulate in a ``utils.metric.StatSet`` and
+  print in the familiar ``\\tname-metric:value`` eval-line format at
+  shutdown.
+
+Thread model: any number of client threads call :meth:`submit`; one
+daemon worker drains the queue and drives the engine.  ``close()`` is
+idempotent and re-entrant — it finishes queued work, then joins.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, List, Optional
+
+import numpy as np
+
+from ..runtime.faults import (DeadlineExceededError, ServeError,
+                              ServeOverloadError)
+from ..utils.bucketing import bucket_for
+from ..utils.metric import StatSet
+
+__all__ = ['DynamicBatcher', 'ServeRequest']
+
+
+class ServeRequest:
+    """One in-flight request: payload rows in, scores (or a typed error)
+    out, with a completion event the client blocks on."""
+
+    __slots__ = ('data', 'n', 't_submit', 'deadline', 'deadline_abs',
+                 'event', 'result', 'error', 'abandoned')
+
+    def __init__(self, data: np.ndarray, deadline: float):
+        self.data = data
+        self.n = int(data.shape[0])
+        self.t_submit = time.monotonic()
+        self.deadline = float(deadline)
+        self.deadline_abs = self.t_submit + float(deadline)
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        # set by wait() when the caller gave up: the worker drops the
+        # request at pop time (best-effort — a request already mid-batch
+        # still executes) instead of burning a forward nobody reads, and
+        # the shed is counted once, on the caller side
+        self.abandoned = False
+
+
+class DynamicBatcher:
+    """Coalesce concurrent predict requests into bucket-sized batches.
+
+    ``engine`` is a ``serve.engine.PredictEngine`` (anything with
+    ``predict_scores(np.ndarray) -> np.ndarray`` and a ``buckets``
+    ladder works).  ``max_wait`` trades tail latency for batch
+    efficiency; ``deadline`` is the default per-request bound.
+    """
+
+    def __init__(self, engine, max_queue: int = 64, max_wait: float = 0.002,
+                 deadline: float = 1.0, stats: Optional[StatSet] = None):
+        if max_queue <= 0:
+            raise ValueError('max_queue must be positive')
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.max_wait = float(max_wait)
+        self.deadline = float(deadline)
+        self.max_batch = int(engine.buckets[-1])
+        self.stats = stats if stats is not None else StatSet()
+        self._q: Deque[ServeRequest] = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._t0 = time.monotonic()
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name='serve-batcher')
+        self._worker.start()
+
+    # -- client side -------------------------------------------------------
+    def submit_async(self, data: np.ndarray,
+                     deadline: Optional[float] = None) -> ServeRequest:
+        """Enqueue a request; returns immediately.  Raises
+        ``ServeOverloadError`` when the queue is full and ``ServeError``
+        after ``close()`` — admission control never blocks."""
+        data = np.asarray(data)
+        if data.ndim < 2:
+            raise ValueError('request must be (n, ...) with a row axis')
+        req = ServeRequest(data, self.deadline if deadline is None
+                           else deadline)
+        with self._cond:
+            if self._closed:
+                raise ServeError('batcher is closed')
+            if len(self._q) >= self.max_queue:
+                self.stats.inc('rejected')
+                raise ServeOverloadError(len(self._q), self.max_queue)
+            self._q.append(req)
+            self.stats.peak('queue_peak', len(self._q))
+            self._cond.notify()
+        return req
+
+    def wait(self, req: ServeRequest) -> np.ndarray:
+        """Block until ``req`` completes; returns its score rows or
+        raises its typed error.  Bounded by the request deadline even if
+        the worker never answers."""
+        remaining = req.deadline_abs - time.monotonic()
+        if not req.event.wait(timeout=max(0.0, remaining) + 0.05):
+            # grace covers the set()-after-deadline race; a still-unset
+            # event past it means the batch never ran for us
+            req.abandoned = True
+            self.stats.inc('expired')
+            raise DeadlineExceededError(
+                req.deadline, time.monotonic() - req.t_submit, req.n)
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def submit(self, data: np.ndarray,
+               deadline: Optional[float] = None) -> np.ndarray:
+        """Enqueue and block for the scores — the one-call client path."""
+        return self.wait(self.submit_async(data, deadline))
+
+    # -- worker side -------------------------------------------------------
+    def _expire(self, req: ServeRequest, now: float) -> None:
+        req.error = DeadlineExceededError(req.deadline, now - req.t_submit,
+                                          req.n)
+        self.stats.inc('expired')
+        req.event.set()
+
+    def _gather(self, first: ServeRequest) -> List[ServeRequest]:
+        """Coalesce from the queue behind ``first`` until the window
+        closes or the next request would overflow ``max_batch``."""
+        batch = [first]
+        rows = first.n
+        window_end = time.monotonic() + self.max_wait
+        while rows < self.max_batch:
+            with self._cond:
+                if not self._q:
+                    remaining = window_end - time.monotonic()
+                    if remaining <= 0 or self._closed:
+                        break
+                    self._cond.wait(remaining)
+                    if not self._q:
+                        continue   # spurious wake or window check
+                if self._q[0].n + rows > self.max_batch:
+                    break          # preserve order: don't skip ahead
+                nxt = self._q.popleft()
+            if nxt.abandoned:      # caller gave up and counted the shed
+                nxt.event.set()
+                continue
+            now = time.monotonic()
+            if now >= nxt.deadline_abs:
+                self._expire(nxt, now)
+                continue
+            batch.append(nxt)
+            rows += nxt.n
+        return batch
+
+    def _execute(self, batch: List[ServeRequest]) -> None:
+        rows = sum(r.n for r in batch)
+        try:
+            # the concat stays inside the try: a shape-mismatched request
+            # must surface as that batch's per-request error, not kill
+            # the worker thread and wedge the service
+            data = (batch[0].data if len(batch) == 1 else
+                    np.concatenate([r.data for r in batch], axis=0))
+            scores = self.engine.predict_scores(data)
+        except BaseException as e:  # surface engine faults per-request
+            self.stats.inc('engine_errors')
+            for r in batch:
+                r.error = e
+                r.event.set()
+            return
+        bucket = bucket_for(rows, self.engine.buckets) \
+            or self.engine.buckets[-1]
+        done = time.monotonic()
+        off = 0
+        for r in batch:
+            r.result = scores[off:off + r.n]
+            off += r.n
+            self.stats.inc('requests')
+            self.stats.observe(f'latency_ms[b{bucket}]',
+                               (done - r.t_submit) * 1e3)
+        self.stats.inc(f'batches[b{bucket}]')
+        self.stats.inc(f'rows[b{bucket}]', rows)
+        self.stats.observe('coalesced', len(batch))
+        for r in batch:
+            r.event.set()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._closed:
+                    self._cond.wait(0.05)
+                if not self._q:   # closed and drained
+                    return
+                first = self._q.popleft()
+            if first.abandoned:    # caller gave up and counted the shed
+                first.event.set()
+                continue
+            now = time.monotonic()
+            if now >= first.deadline_abs:
+                self._expire(first, now)
+                continue
+            self._execute(self._gather(first))
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Graceful, idempotent shutdown: stop admitting, let the worker
+        finish every queued request, join it.  Safe to call any number
+        of times, from any thread; returns True once the worker exited."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if threading.current_thread() is self._worker:
+            return False   # re-entrant close from a request callback
+        self._worker.join(timeout)
+        return not self._worker.is_alive()
+
+    def report(self, name: str = 'serve') -> str:
+        """Eval-line-format stats snapshot (``utils.metric.StatSet``),
+        with overall requests/sec appended."""
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        self.stats.gauge('reqs_per_sec',
+                         self.stats.get('requests') / elapsed)
+        return self.stats.print(name)

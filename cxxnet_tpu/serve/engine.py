@@ -1,0 +1,220 @@
+"""Inference engine: bucketed-compile predict over an inference-only model.
+
+``PredictEngine`` is the compute half of the serving subsystem
+(doc/serving.md).  It differs from driving ``NetTrainer.predict`` directly
+in three load-bearing ways:
+
+* **inference-only state** — no optimizer moments, no gradient
+  accumulator: the engine holds params only (roughly 1/3 the device
+  memory of a trainer for SGD-momentum, 1/4 for Adam), loaded via a
+  trainer constructed with ``inference_only = 1``,
+* **provably bounded compile cache** — every request is padded up to one
+  of a small configured ladder of batch-size buckets
+  (``utils/bucketing.py``), so the jitted forward traces at most
+  ``len(buckets)`` times, ever.  ``compile_count`` exposes the actual
+  trace count (the counter increments inside the traced function, so it
+  ticks exactly once per XLA compilation) — tests assert the bound
+  instead of trusting it,
+* **atomic parameter swap** — :meth:`swap_params` replaces the serving
+  weights between batches without touching the compiled programs (the
+  param tree's structure/shapes/dtypes are validated to match, so no
+  retrace).  A batch in flight keeps the snapshot it started with;
+  there is no window where a batch sees half-old, half-new weights.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..layers import ForwardContext
+from ..nnet.trainer import NetTrainer
+from ..parallel.mesh import batch_sharding
+from ..utils.bucketing import DEFAULT_BUCKETS, chunk_plan, pad_rows
+
+__all__ = ['PredictEngine']
+
+
+def _as_4d(arr: np.ndarray) -> np.ndarray:
+    """Request payloads arrive as (n, c, y, x) nodes or flat (n, d)
+    matrices — same viewing rule as the C ABI (capi._as_4d)."""
+    arr = np.asarray(arr)
+    if arr.ndim == 4:
+        return arr
+    if arr.ndim == 2:
+        return arr.reshape(arr.shape[0], 1, 1, arr.shape[1])
+    raise ValueError(f'cannot view shape {arr.shape} as a request batch')
+
+
+class PredictEngine:
+    """Bucketed, hot-swappable jitted predict over a loaded model.
+
+    ``trainer`` must be initialized (``init_model`` or ``load_model``);
+    build it with ``inference_only = 1`` to skip optimizer-state
+    allocation.  Requests are host float32 (or uint8) arrays shaped
+    ``(n, c, y, x)`` or ``(n, d)``; inputs are expected pre-normalized
+    (the serving wire contract — the ``device_normalize`` deferred-spec
+    path is a training-iterator concern).
+    """
+
+    def __init__(self, trainer: NetTrainer,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS):
+        if trainer.net is None or trainer.params is None:
+            raise ValueError('PredictEngine needs an initialized trainer '
+                             '(init_model()/load_model() first)')
+        self.trainer = trainer
+        self.buckets: Tuple[int, ...] = tuple(sorted(set(int(b)
+                                                         for b in buckets)))
+        if not self.buckets or self.buckets[0] <= 0:
+            raise ValueError(f'bad bucket ladder {buckets!r}')
+        ddim = int(trainer._mesh.shape['data'])
+        bad = [b for b in self.buckets if b % ddim]
+        if bad:
+            raise ValueError(
+                f'buckets {bad} do not divide the mesh data axis ({ddim} '
+                f'devices); pick multiples so padded batches shard evenly')
+        self.compile_count = 0
+        self.swap_count = 0
+        self.version: object = 0
+        self._params = trainer.params
+        self._params_treedef = jax.tree.structure(self._params)
+        self._params_shapes = [(l.shape, l.dtype)
+                               for l in jax.tree.leaves(self._params)]
+        self._lock = threading.Lock()
+        self._fwd = self._build_forward()
+
+    # -- compiled forward --------------------------------------------------
+    def _build_forward(self):
+        tr = self.trainer
+        net = tr.net
+        top = net.cfg.layers[-1].nindex_out[-1]
+        compute_dtype = tr.compute_dtype
+        max_round = tr.max_round
+        spmd = tr._mesh.devices.size
+        engine = self
+
+        @jax.jit
+        def fwd(params, data):
+            # trace-time hook: this Python line runs once per XLA
+            # compilation (per distinct data shape) and never inside the
+            # compiled program — the compile-cache bound is asserted on it
+            engine.compile_count += 1
+            ctx = ForwardContext(is_train=False, rng=None, round=0,
+                                 max_round=max_round,
+                                 compute_dtype=compute_dtype,
+                                 spmd_devices=spmd)
+            values, _ = net.forward(params, data, ctx)
+            return values[top]
+
+        return fwd
+
+    # -- parameters --------------------------------------------------------
+    @property
+    def params(self):
+        return self._params
+
+    def _check_tree(self, params) -> None:
+        if jax.tree.structure(params) != self._params_treedef:
+            raise ValueError('swap_params: param tree structure differs '
+                             'from the serving model')
+        for leaf, (shape, dtype) in zip(jax.tree.leaves(params),
+                                        self._params_shapes):
+            if tuple(leaf.shape) != tuple(shape) or leaf.dtype != dtype:
+                raise ValueError(
+                    f'swap_params: leaf {tuple(leaf.shape)}/{leaf.dtype} '
+                    f'!= serving {tuple(shape)}/{dtype} — a shape change '
+                    'needs a new engine, not a hot swap')
+
+    def place_params(self, host_params):
+        """Device-put a host param tree with the serving params'
+        shardings (structure/shape/dtype validated first)."""
+        self._check_tree(host_params)
+        if self._is_placed(host_params):
+            return host_params   # already ours: skip the device round
+        return jax.tree.map(
+            lambda h, cur: jax.device_put(
+                np.asarray(h, dtype=cur.dtype)
+                if not isinstance(h, jax.Array) else h,
+                cur.sharding),
+            host_params, self._params)
+
+    def _is_placed(self, params) -> bool:
+        """True when every leaf is already a device array carrying the
+        serving shardings — lets ``swap_params(place_params(x))`` (the
+        registry's warm-then-swap sequence) skip a second placement."""
+        return all(
+            isinstance(leaf, jax.Array) and leaf.sharding == cur.sharding
+            for leaf, cur in zip(jax.tree.leaves(params),
+                                 jax.tree.leaves(self._params)))
+
+    def warm_params(self, params) -> None:
+        """Run one smallest-bucket forward with ``params`` and block:
+        materializes the tree on device and pays any lazy transfer cost
+        BEFORE the swap, so the first post-swap request sees no warmup
+        stall.  No new compilation (shapes are bucket shapes)."""
+        b = self.buckets[0]
+        c, y, x = self.trainer.net_cfg.input_shape
+        dummy = np.zeros((b, c, y, x), np.float32)
+        jax.block_until_ready(self._fwd(params, self._put(dummy)))
+
+    def swap_params(self, params, version: object = None) -> None:
+        """Atomically make ``params`` (host or device tree) the serving
+        weights.  In-flight batches keep the snapshot they captured;
+        every batch dispatched after this call uses the new tree."""
+        placed = self.place_params(params)
+        with self._lock:
+            self._params = placed
+            self.swap_count += 1
+            if version is not None:
+                self.version = version
+
+    def _snapshot(self):
+        with self._lock:
+            return self._params
+
+    # -- prediction --------------------------------------------------------
+    def _put(self, data: np.ndarray):
+        if data.dtype != np.float32:
+            # jit programs are keyed by dtype as well as shape: normalize
+            # the wire dtype or a uint8 client would double the cache
+            data = data.astype(np.float32)
+        return jax.device_put(np.ascontiguousarray(data),
+                              batch_sharding(self.trainer._mesh))
+
+    def warm(self) -> int:
+        """Compile every bucket up front (cold-start cost paid at startup,
+        not at first-request latency); returns ``compile_count``."""
+        c, y, x = self.trainer.net_cfg.input_shape
+        params = self._snapshot()
+        for b in self.buckets:
+            jax.block_until_ready(
+                self._fwd(params, self._put(np.zeros((b, c, y, x),
+                                                     np.float32))))
+        return self.compile_count
+
+    def predict_scores(self, data: np.ndarray) -> np.ndarray:
+        """Final-node scores for ``n`` request rows: ``(n, k)`` float32.
+        The input is padded to the smallest fitting bucket (oversize
+        requests split into max-bucket chunks); pad rows never leave the
+        engine.  The param snapshot is taken ONCE, so a multi-chunk
+        request is never served by two model versions."""
+        data = _as_4d(data)
+        n = data.shape[0]
+        params = self._snapshot()
+        outs: List[np.ndarray] = []
+        for off, take, bucket in chunk_plan(n, self.buckets):
+            chunk = pad_rows(data[off:off + take], bucket)
+            out = self._fwd(params, self._put(chunk))
+            outs.append(np.asarray(out, np.float32)[:take])
+        if not outs:
+            return np.empty((0, 1), np.float32)
+        scores = np.concatenate(outs, axis=0)
+        return scores.reshape(n, -1)
+
+    def predict(self, data: np.ndarray) -> np.ndarray:
+        """Class id (argmax; raw value for single-score nets) per row —
+        ``NetTrainer.predict`` semantics on the serving path."""
+        return NetTrainer._pred_transform(self.predict_scores(data))

@@ -1,0 +1,207 @@
+"""Model registry: checkpoint hot-reload without dropping requests.
+
+The training side emits ``model_dir/%04d.model`` files via atomic
+temp+fsync+rename (``nnet/checkpoint.py``) — a reader can never observe a
+partial file.  The ``ModelRegistry`` closes the loop on the serving side:
+it watches ``model_dir`` for a newer counter, verifies the file against
+its ``.crc32`` digest sidecar (written by the train CLI at save time),
+loads the params through the retrying model-file reader, warms them on
+device, and atomically swaps them into the live ``PredictEngine``.
+In-flight batches finish on the params they started with; every batch
+dispatched after the swap serves the new ones — no request is ever
+dropped or mixed across versions (engine snapshot semantics,
+``serve/engine.py``).
+
+Reload state machine (one cycle per detected counter, transitions
+recorded in :attr:`transitions` for tests/observability)::
+
+    IDLE -> DETECTED -> VERIFYING -> LOADING -> WARMING -> SWAPPED
+                           |            |
+                           +-> REJECTED-+   (recorded to the failure log;
+                                             retried up to ``max_attempts``
+                                             polls, then blacklisted)
+
+A REJECTED checkpoint never reaches the engine: the previous version
+keeps serving — corrupt or half-replicated storage degrades freshness,
+not availability.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import struct
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from ..nnet import checkpoint
+from ..nnet.net_config import NetConfig
+from ..runtime import faults
+
+__all__ = ['ModelRegistry', 'load_model_params']
+
+_MODEL_RE = re.compile(r'^(\d+)\.model$')
+
+
+def load_model_params(engine, path: str, retry=None):
+    """Read a model file and return its HOST param tree, validated
+    against ``engine``'s net structure (layer count and types must match
+    — a hot swap cannot change architecture).  Raises
+    ``CheckpointCorruptError`` on a truncated blob, ``ValueError`` on a
+    structural mismatch; transient I/O errors retry under ``retry``."""
+
+    def read(f):
+        f.read(4)                      # net_type prefix
+        cfg = NetConfig()
+        cfg.load_net(f)
+        f.read(8)                      # epoch_counter, irrelevant here
+        (blob_len,) = struct.unpack('<Q', f.read(8))
+        blob = f.read(blob_len)
+        if len(blob) != blob_len:
+            raise faults.CheckpointCorruptError(
+                f'{path}: model blob truncated '
+                f'({len(blob)}/{blob_len} bytes)')
+        return cfg, blob
+
+    cfg, blob = checkpoint.read_model_file(path, read, retry=retry)
+    serving = engine.trainer.net_cfg.layers
+    if len(cfg.layers) != len(serving) or any(
+            a.type != b.type for a, b in zip(cfg.layers, serving)):
+        raise ValueError(
+            f'{path}: net structure differs from the serving model '
+            f'({len(cfg.layers)} vs {len(serving)} layers) — '
+            'hot reload cannot change architecture')
+    return checkpoint.blob_to_params(engine.trainer.net, blob)
+
+
+class ModelRegistry:
+    """Watch ``model_dir`` and hot-swap newer checkpoints into ``engine``.
+
+    ``current`` is the counter being served (pass the loaded model's
+    counter so an already-served checkpoint is not re-loaded on the
+    first poll; -1 means "adopt whatever appears first").  ``on_swap``
+    (optional) is called as ``on_swap(counter, path)`` after each
+    successful swap.
+    """
+
+    def __init__(self, engine, model_dir: str, poll_interval: float = 1.0,
+                 current: int = -1, retry: Optional[faults.RetryPolicy] = None,
+                 log: Optional[faults.FailureLog] = None,
+                 on_swap: Optional[Callable[[int, str], None]] = None):
+        self.engine = engine
+        self.model_dir = os.fspath(model_dir)
+        self.poll_interval = float(poll_interval)
+        self.current = int(current)
+        self.retry = faults.DEFAULT_IO_RETRY if retry is None else retry
+        self.log = faults.global_failure_log() if log is None else log
+        self.on_swap = on_swap
+        self.transitions: List[Tuple[str, str]] = []
+        self._attempts: dict = {}          # counter -> failed poll cycles
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        engine.version = self.current
+
+    # -- observability -----------------------------------------------------
+    _MAX_TRANSITIONS = 512
+
+    def _note(self, state: str, detail: str) -> None:
+        with self._lock:
+            self.transitions.append((state, detail))
+            # a long-lived server must not grow this without bound
+            if len(self.transitions) > self._MAX_TRANSITIONS:
+                del self.transitions[:len(self.transitions)
+                                     - self._MAX_TRANSITIONS]
+
+    def states(self) -> List[str]:
+        with self._lock:
+            return [s for s, _ in self.transitions]
+
+    # -- scanning ----------------------------------------------------------
+    def candidates_on_disk(self) -> List[Tuple[int, str]]:
+        """Model files newer than the serving counter, newest first."""
+        out: List[Tuple[int, str]] = []
+        try:
+            names = os.listdir(self.model_dir)
+        except OSError:
+            return out
+        for name in names:
+            m = _MODEL_RE.match(name)
+            if m and int(m.group(1)) > self.current:
+                out.append((int(m.group(1)),
+                            os.path.join(self.model_dir, name)))
+        out.sort(reverse=True)
+        return out
+
+    def latest_on_disk(self) -> Optional[Tuple[int, str]]:
+        """Newest (counter, path) model file in ``model_dir``, or None."""
+        cand = self.candidates_on_disk()
+        return cand[0] if cand else None
+
+    # -- one reload cycle --------------------------------------------------
+    def poll_once(self) -> bool:
+        """Adopt the newest *loadable* checkpoint past the serving one:
+        candidates are tried newest-first, so a blacklisted (persistently
+        rejected) newest file falls back to the next-newest good one
+        instead of pinning the server on a stale version.  Returns True
+        when a swap happened.  Never raises for a bad checkpoint —
+        rejection is recorded, counted toward that counter's blacklist,
+        and the old version keeps serving."""
+        for counter, path in self.candidates_on_disk():
+            if self._attempts.get(counter, 0) >= self.retry.max_attempts:
+                continue                  # blacklisted: persistent reject
+            self._note('DETECTED', path)
+            try:
+                self._note('VERIFYING', path)
+                reason = checkpoint.verify_model_digest(path)
+                if reason:
+                    raise faults.CheckpointCorruptError(f'{path}: {reason}')
+                self._note('LOADING', path)
+                params = load_model_params(self.engine, path,
+                                           retry=self.retry)
+                self._note('WARMING', path)
+                placed = self.engine.place_params(params)
+                self.engine.warm_params(placed)
+            except Exception as e:
+                # ANY failure (I/O, structure, device OOM during warm...)
+                # must reject-and-count: an uncounted error would re-run
+                # the full verify/load/warm cycle every poll forever
+                self._attempts[counter] = self._attempts.get(counter, 0) + 1
+                self._note('REJECTED', f'{path}: {e!r}')
+                self.log.record('serve_reload_reject',
+                                f'checkpoint {counter} rejected: {e!r}')
+                continue
+            self.engine.swap_params(placed, version=counter)
+            self.current = counter
+            self._note('SWAPPED', path)
+            if self.on_swap is not None:
+                self.on_swap(counter, path)
+            return True
+        return False
+
+    # -- watcher lifecycle -------------------------------------------------
+    def start(self) -> None:
+        """Start the polling watcher thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name='serve-registry')
+        self._thread.start()
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.poll_once()
+            except Exception as e:       # watcher must outlive bad cycles
+                self.log.record('serve_reload_error',
+                                f'registry poll failed: {e!r}')
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Stop the watcher (idempotent, re-entrant safe)."""
+        self._stop.set()
+        t = self._thread
+        if t is None or t is threading.current_thread():
+            return True
+        t.join(timeout)
+        return not t.is_alive()

@@ -1,0 +1,84 @@
+"""Batch-size bucketing: fit arbitrary request shapes onto a closed set
+of compiled batch shapes.
+
+The jitted predict path compiles once per distinct input shape, so a
+stream of novel batch sizes (live traffic, ad-hoc ``net_predict_batch``
+calls) grows the XLA compile cache without bound — and each miss costs a
+full compilation at request latency.  The µ-cuDNN observation (PAPERS.md)
+applies directly: pick a small ladder of batch-size *buckets*, pad every
+request up to the smallest bucket that fits (oversize requests split into
+max-bucket chunks), and the compile cache is provably bounded by
+``len(buckets)`` entries per program.
+
+Pure numpy/host helpers — shared by the serving engine
+(``serve/engine.py``), the trainer's ``pred_buckets`` net param
+(``nnet/trainer.py``), and the batcher's accounting; no jax imports so
+anything may depend on it without circularity.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: Default ladder: singleton probes, small interactive batches, bulk.
+DEFAULT_BUCKETS = (1, 8, 32)
+
+
+def parse_buckets(text: str) -> Tuple[int, ...]:
+    """Parse a ``serve.buckets = 1,8,32`` config value into a sorted,
+    de-duplicated tuple of positive ints."""
+    out = set()
+    for tok in str(text).replace(';', ',').split(','):
+        tok = tok.strip()
+        if not tok:
+            continue
+        b = int(tok)
+        if b <= 0:
+            raise ValueError(f'bucket sizes must be positive, got {b}')
+        out.add(b)
+    if not out:
+        raise ValueError(f'no bucket sizes in {text!r}')
+    return tuple(sorted(out))
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> Optional[int]:
+    """Smallest bucket >= n, or None when n exceeds every bucket."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return None
+
+
+def chunk_plan(n: int, buckets: Sequence[int]) -> List[Tuple[int, int, int]]:
+    """Split ``n`` rows into bucket-padded chunks: a list of
+    ``(offset, take, bucket)`` where ``take`` rows starting at ``offset``
+    run in a ``bucket``-sized program.  Greedy: full max-bucket chunks
+    while the remainder overflows the ladder, then the smallest bucket
+    that fits the tail.  ``sum(take) == n``; every ``bucket`` is a member
+    of ``buckets`` — the compile cache never sees a novel shape."""
+    if n <= 0:
+        return []
+    bmax = buckets[-1]
+    plan: List[Tuple[int, int, int]] = []
+    off = 0
+    while n - off > bmax:
+        plan.append((off, bmax, bmax))
+        off += bmax
+    rest = n - off
+    plan.append((off, rest, bucket_for(rest, buckets)))
+    return plan
+
+
+def pad_rows(arr: np.ndarray, b: int) -> np.ndarray:
+    """Pad the leading (row) axis of ``arr`` up to ``b`` with zeros,
+    preserving dtype (uint8 wire batches stay uint8).  No copy when the
+    array is already ``b`` rows."""
+    n = arr.shape[0]
+    if n == b:
+        return arr
+    if n > b:
+        raise ValueError(f'cannot pad {n} rows down to bucket {b}')
+    pad = np.zeros((b - n,) + arr.shape[1:], arr.dtype)
+    return np.concatenate([np.asarray(arr), pad], axis=0)

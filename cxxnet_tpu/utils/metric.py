@@ -13,6 +13,8 @@ surface, not a device-compute path.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 
@@ -120,6 +122,79 @@ def create_metric(name: str) -> Metric | None:
     if name.startswith('rec@'):
         return MetricRecall(name)
     return None
+
+
+class StatSet:
+    """Operational counters + latency distributions, printed in the same
+    ``\\tname-metric:value`` eval-line format as :class:`MetricSet`.
+
+    Where ``MetricSet`` scores model *quality* over (pred, label) pairs,
+    ``StatSet`` observes a *runtime* — the serving subsystem's per-bucket
+    latency/throughput/queue counters (``serve/batcher.py``) report
+    through one of these at shutdown, so serving telemetry reads like
+    every other eval line the framework prints.  Thread-safe: client
+    threads and the batcher worker update it concurrently.
+
+    Three kinds of stat, keyed by name:
+    * ``inc(name, v)`` — monotone counter,
+    * ``gauge(name, v)`` / ``peak(name, v)`` — last-value / max-value,
+    * ``observe(name, v)`` — sample a distribution; ``print`` expands it
+      into ``name.p50 / name.p99 / name.mean / name.n`` entries
+      (exact quantiles over retained samples, capped at the newest
+      ``max_samples`` per name to bound memory on long-lived servers).
+    """
+
+    def __init__(self, max_samples: int = 100_000):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._samples: dict[str, list[float]] = {}
+        self._max_samples = int(max_samples)
+
+    def inc(self, name: str, v: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + v
+
+    def gauge(self, name: str, v: float) -> None:
+        with self._lock:
+            self._counters[name] = float(v)
+
+    def peak(self, name: str, v: float) -> None:
+        with self._lock:
+            if v > self._counters.get(name, float('-inf')):
+                self._counters[name] = float(v)
+
+    def observe(self, name: str, v: float) -> None:
+        with self._lock:
+            s = self._samples.setdefault(name, [])
+            s.append(float(v))
+            if len(s) > self._max_samples:
+                del s[:len(s) - self._max_samples]
+
+    def get(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._counters.get(name, default)
+
+    def quantile(self, name: str, q: float) -> float:
+        with self._lock:
+            s = list(self._samples.get(name, ()))
+        if not s:
+            return float('nan')
+        return float(np.quantile(np.asarray(s), q))
+
+    def print(self, evname: str) -> str:
+        with self._lock:
+            counters = dict(self._counters)
+            samples = {k: list(v) for k, v in self._samples.items() if v}
+        out = []
+        for key in sorted(counters):
+            out.append(f'\t{evname}-{key}:{counters[key]:g}')
+        for key in sorted(samples):
+            arr = np.asarray(samples[key])
+            out.append(f'\t{evname}-{key}.p50:{np.quantile(arr, 0.5):g}')
+            out.append(f'\t{evname}-{key}.p99:{np.quantile(arr, 0.99):g}')
+            out.append(f'\t{evname}-{key}.mean:{arr.mean():g}')
+            out.append(f'\t{evname}-{key}.n:{arr.size:g}')
+        return ''.join(out)
 
 
 class MetricSet:

@@ -101,6 +101,8 @@ class Net:
         if dev:
             self._pairs.append(('dev', dev))
         self._trainer: Optional[NetTrainer] = None
+        self._engine = None     # serve.PredictEngine after serve_start
+        self._batcher = None    # serve.DynamicBatcher after serve_start
 
     def _require(self) -> NetTrainer:
         if self._trainer is None:
@@ -168,6 +170,93 @@ class Net:
         data = np.asarray(data, np.float32)
         return tr.extract_feature(
             DataBatch(data, np.zeros((data.shape[0], 1), np.float32)), name)
+
+    # --- streaming whole-iterator prediction ------------------------------
+    def predict_stream(self, data: 'DataIter'):
+        """Generator of per-batch prediction vectors over the WHOLE
+        iterator (rewound first), pad rows trimmed — the O(batch)-host-
+        memory path behind ``CXNNetPredictIter`` (capi.net_predict_iter);
+        batches pipeline through ``NetTrainer.predict_stream``."""
+        if not isinstance(data, DataIter):
+            raise TypeError('predict_stream needs a DataIter')
+        tr = self._require()
+        data.before_first()
+        yield from tr.predict_stream(iter(data._it))
+
+    def extract_stream(self, data: 'DataIter', name: str):
+        """Generator of per-batch node activations over the whole
+        iterator — the streaming path behind ``CXNNetExtractIter``."""
+        if not isinstance(data, DataIter):
+            raise TypeError('extract_stream needs a DataIter')
+        tr = self._require()
+        data.before_first()
+        yield from tr.forward_stream(iter(data._it), tr.net.node_index(name))
+
+    # --- online serving (doc/serving.md) ----------------------------------
+    def serve_start(self, buckets='1,8,32', max_queue: int = 64,
+                    max_wait: float = 0.002, deadline: float = 1.0,
+                    warm: bool = True) -> None:
+        """Stand up the serving stack over this net's loaded params: a
+        bucketed ``PredictEngine`` plus a ``DynamicBatcher``.  Call once;
+        ``serve_stop()`` tears down (and must precede a restart)."""
+        from .serve import DynamicBatcher, PredictEngine
+        from .utils.bucketing import parse_buckets
+        if self._batcher is not None:
+            raise RuntimeError('serving already started; serve_stop() first')
+        tr = self._require()
+        bks = parse_buckets(buckets) if isinstance(buckets, str) \
+            else tuple(buckets)
+        self._engine = PredictEngine(tr, bks)
+        if warm:
+            self._engine.warm()
+        self._batcher = DynamicBatcher(self._engine, max_queue=max_queue,
+                                       max_wait=max_wait, deadline=deadline)
+
+    def _require_serving(self):
+        if self._batcher is None:
+            raise RuntimeError('call serve_start() first')
+        return self._batcher
+
+    def serve_scores(self, data, deadline: Optional[float] = None) \
+            -> np.ndarray:
+        """Submit one request through the batcher; blocks for the final
+        node's score rows.  Raises the typed serving errors
+        (``ServeOverloadError`` / ``DeadlineExceededError``)."""
+        return self._require_serving().submit(
+            np.asarray(data, np.float32), deadline)
+
+    def serve_predict(self, data, deadline: Optional[float] = None) \
+            -> np.ndarray:
+        """Like :meth:`predict` but through the serving stack (micro-
+        batched with concurrent callers, bucket-padded)."""
+        return NetTrainer._pred_transform(self.serve_scores(data, deadline))
+
+    def serve_reload(self, fname: str) -> None:
+        """Manually hot-swap a checkpoint into the live engine (the
+        registry's verify→load→warm→swap cycle, minus the watching)."""
+        from .nnet import checkpoint
+        from .serve.registry import load_model_params
+        if self._engine is None:
+            raise RuntimeError('call serve_start() first')
+        reason = checkpoint.verify_model_digest(fname)
+        if reason:
+            from .runtime.faults import CheckpointCorruptError
+            raise CheckpointCorruptError(f'{fname}: {reason}')
+        placed = self._engine.place_params(
+            load_model_params(self._engine, fname))
+        self._engine.warm_params(placed)
+        self._engine.swap_params(placed, version=fname)
+
+    def serve_stats(self, name: str = 'serve') -> str:
+        """Per-bucket latency/throughput counters in eval-line format."""
+        return self._require_serving().report(name)
+
+    def serve_stop(self, timeout: Optional[float] = None) -> None:
+        """Drain and tear down the serving stack (idempotent)."""
+        if self._batcher is not None:
+            self._batcher.close(timeout)
+            self._batcher = None
+        self._engine = None
 
     # --- weight access (visitor equivalent) -------------------------------
     def _resolve(self, layer_name: str):

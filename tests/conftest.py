@@ -30,3 +30,8 @@ def pytest_configure(config):
         'markers',
         'faults: deterministic fault-injection / recovery suite '
         '(seeded, tier-1: runs under -m "not slow"; select with -m faults)')
+    config.addinivalue_line(
+        'markers',
+        'serve: online inference serving suite — engine/batcher/registry, '
+        'CPU-only, no network, in-process client threads '
+        '(tier-1: runs under -m "not slow"; select with -m serve)')

@@ -1,0 +1,845 @@
+"""Online inference serving suite (doc/serving.md): bucketed engine,
+dynamic micro-batcher, checkpoint hot-reload registry, and the serving
+satellites (bounded predict compile cache, streaming ABI iter paths,
+re-entrant pipeline shutdown, tail-batch predict semantics).
+
+CPU-only, no network: clients are in-process threads driving the real
+batcher worker; determinism comes from blocking fake engines where the
+real one would race.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import capi, wrapper
+from cxxnet_tpu.nnet import checkpoint
+from cxxnet_tpu.runtime.faults import (DeadlineExceededError,
+                                       ServeError, ServeOverloadError)
+from cxxnet_tpu.serve import (DynamicBatcher, ModelRegistry, PredictEngine,
+                              load_model_params)
+from cxxnet_tpu.utils import bucketing
+from cxxnet_tpu.utils.metric import StatSet
+from tests.test_io import make_img_dataset, write_mnist
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NET_CFG = """
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 16
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 4
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 16
+dev = cpu
+eta = 0.1
+momentum = 0.9
+"""
+
+
+def make_net(seed=0):
+    net = wrapper.Net(dev='cpu', cfg=NET_CFG)
+    net.set_param('seed', seed)
+    net.init_model()
+    return net
+
+
+def rig_constant_class(net, cls=2):
+    """Zero fc2 and bias one logit so every input argmaxes to ``cls`` —
+    a recognizable 'new checkpoint' for hot-reload assertions."""
+    w = net.get_weight('fc2', 'wmat')
+    net.set_weight(np.zeros_like(w), 'fc2', 'wmat')
+    b = np.zeros(4, np.float32)
+    b[cls] = 5.0
+    net.set_weight(b, 'fc2', 'bias')
+    return net
+
+
+# --- bucketing helpers ----------------------------------------------------
+
+def test_parse_buckets_forms():
+    assert bucketing.parse_buckets('1,8,32') == (1, 8, 32)
+    assert bucketing.parse_buckets('32, 8, 1, 8') == (1, 8, 32)
+    with pytest.raises(ValueError):
+        bucketing.parse_buckets('0,4')
+    with pytest.raises(ValueError):
+        bucketing.parse_buckets('')
+
+
+def test_bucket_for_and_chunk_plan():
+    bks = (1, 8, 32)
+    assert bucketing.bucket_for(1, bks) == 1
+    assert bucketing.bucket_for(2, bks) == 8
+    assert bucketing.bucket_for(32, bks) == 32
+    assert bucketing.bucket_for(33, bks) is None
+    assert bucketing.chunk_plan(0, bks) == []
+    assert bucketing.chunk_plan(5, bks) == [(0, 5, 8)]
+    assert bucketing.chunk_plan(32, bks) == [(0, 32, 32)]
+    # oversize splits into max-bucket chunks + smallest-fitting tail
+    assert bucketing.chunk_plan(70, bks) == [(0, 32, 32), (32, 32, 32),
+                                             (64, 6, 8)]
+    # plans cover exactly n rows with only ladder shapes
+    for n in range(1, 100):
+        plan = bucketing.chunk_plan(n, bks)
+        assert sum(t for _, t, _ in plan) == n
+        assert all(b in bks and t <= b for _, t, b in plan)
+
+
+def test_pad_rows_preserves_dtype():
+    a = np.arange(6, dtype=np.uint8).reshape(2, 3)
+    p = bucketing.pad_rows(a, 5)
+    assert p.shape == (5, 3) and p.dtype == np.uint8
+    assert np.array_equal(p[:2], a) and not p[2:].any()
+    assert bucketing.pad_rows(a, 2) is a
+    with pytest.raises(ValueError):
+        bucketing.pad_rows(a, 1)
+
+
+def test_statset_counters_and_quantiles():
+    s = StatSet()
+    s.inc('req')
+    s.inc('req', 2)
+    s.peak('depth', 3)
+    s.peak('depth', 1)
+    s.gauge('rate', 7.5)
+    for v in range(1, 101):
+        s.observe('lat', float(v))
+    assert s.get('req') == 3 and s.get('depth') == 3
+    assert s.quantile('lat', 0.5) == pytest.approx(50.5)
+    line = s.print('serve')
+    assert '\tserve-req:3' in line and '\tserve-lat.p99:' in line
+    assert '\tserve-rate:7.5' in line
+
+
+# --- engine ---------------------------------------------------------------
+
+def test_engine_compile_cache_bounded():
+    net = make_net()
+    eng = PredictEngine(net._trainer, (1, 8, 32))
+    assert eng.warm() == 3
+    rng = np.random.RandomState(0)
+    for n in (1, 2, 3, 5, 8, 13, 21, 32, 33, 70):
+        scores = eng.predict_scores(rng.randn(n, 1, 1, 8).astype(np.float32))
+        assert scores.shape == (n, 4)
+    # flat (n, d) views and non-f32 wire dtypes hit the same programs
+    # (jit keys on dtype too — the engine normalizes at the boundary)
+    assert eng.predict_scores(np.zeros((4, 8), np.uint8)).shape == (4, 4)
+    assert eng.predict_scores(np.zeros((4, 1, 1, 8), np.float64)).shape \
+        == (4, 4)
+    # every size above hit a pre-compiled bucket program
+    assert eng.compile_count == 3
+
+
+def test_engine_predict_matches_trainer_predict():
+    net = make_net()
+    eng = PredictEngine(net._trainer, (8,))
+    rng = np.random.RandomState(3)
+    d = rng.randn(5, 1, 1, 8).astype(np.float32)
+    np.testing.assert_array_equal(eng.predict(d), net.predict(d))
+
+
+def test_engine_inference_only_state():
+    net = wrapper.Net(dev='cpu', cfg=NET_CFG)
+    net.set_param('inference_only', '1')
+    net.init_model()
+    tr = net._trainer
+    assert tr.opt_state is None and tr.grad_acc is None
+    d = np.zeros((4, 1, 1, 8), np.float32)
+    assert net.predict(d).shape == (4,)        # forward still works
+    with pytest.raises(RuntimeError, match='inference_only'):
+        net.update(d, np.zeros((4, 1), np.float32))
+
+
+def test_engine_swap_validates_structure():
+    net = make_net()
+    eng = PredictEngine(net._trainer, (8,))
+    bad = {k: dict(v) for k, v in net._trainer.params.items()}
+    key = next(iter(bad))
+    field = next(iter(bad[key]))
+    bad[key][field] = np.zeros((3, 3), np.float32)   # wrong shape
+    with pytest.raises(ValueError, match='swap_params'):
+        eng.swap_params(bad)
+    with pytest.raises(ValueError, match='structure'):
+        eng.swap_params({'nope': {}})
+
+
+def test_engine_inflight_request_keeps_old_params():
+    """The params snapshot is taken at request start: a swap landing while
+    a request is in flight must not affect that request's result."""
+    net = make_net()
+    eng = PredictEngine(net._trainer, (8,))
+    eng.warm()
+    rng = np.random.RandomState(5)
+    d = rng.randn(4, 1, 1, 8).astype(np.float32)
+    want_old = eng.predict_scores(d)
+
+    orig = eng._fwd
+    entered, release = threading.Event(), threading.Event()
+
+    def slow_fwd(params, data):
+        entered.set()
+        assert release.wait(10)
+        return orig(params, data)
+
+    eng._fwd = slow_fwd
+    out = {}
+    t = threading.Thread(target=lambda: out.setdefault(
+        'scores', eng.predict_scores(d)))
+    t.start()
+    assert entered.wait(10)
+    # swap to the constant-class rig while the request is mid-flight
+    v2 = rig_constant_class(make_net(seed=9))
+    eng.swap_params(v2._trainer.params, version='v2')
+    release.set()
+    t.join(10)
+    eng._fwd = orig
+    np.testing.assert_array_equal(out['scores'], want_old)
+    # and the NEXT request sees the new params
+    assert np.all(eng.predict(d) == 2.0)
+    assert eng.swap_count == 1 and eng.version == 'v2'
+
+
+def test_engine_rejects_bucket_not_dividing_mesh(tmp_path):
+    """On a multi-device mesh the padded batch must shard evenly."""
+    net = wrapper.Net(dev='cpu:0-7', cfg=NET_CFG)
+    net.init_model()
+    with pytest.raises(ValueError, match='data axis'):
+        PredictEngine(net._trainer, (1, 8))
+    eng = PredictEngine(net._trainer, (8, 32))    # multiples of 8: fine
+    assert eng.predict_scores(np.zeros((3, 1, 1, 8), np.float32)).shape \
+        == (3, 4)
+
+
+# --- batcher --------------------------------------------------------------
+
+class FakeEngine:
+    """Deterministic engine stub: records executed batch sizes; optional
+    gate blocks execution so queue states are controllable."""
+
+    def __init__(self, buckets=(1, 8, 32), gate=None, fail=False):
+        self.buckets = tuple(buckets)
+        self.gate = gate
+        self.fail = fail
+        self.batches = []
+
+    def predict_scores(self, data):
+        if self.gate is not None:
+            assert self.gate.wait(10)
+        if self.fail:
+            raise RuntimeError('engine exploded')
+        self.batches.append(data.shape[0])
+        return np.arange(data.shape[0], dtype=np.float32)[:, None]
+
+
+def test_batcher_coalesces_concurrent_requests():
+    gate = threading.Event()
+    eng = FakeEngine(buckets=(1, 8, 32), gate=gate)
+    # max_wait=0: coalescing below comes purely from the queue backlog
+    # that builds while the worker is busy — deterministic
+    b = DynamicBatcher(eng, max_queue=64, max_wait=0.0, deadline=10.0)
+    try:
+        # sacrificial blocker occupies the worker while the real
+        # requests queue up behind it
+        blocker = b.submit_async(np.zeros((1, 4), np.float32))
+        time.sleep(0.05)
+        reqs = [b.submit_async(np.zeros((3, 4), np.float32))
+                for _ in range(4)]
+        gate.set()
+        b.wait(blocker)
+        outs = [b.wait(r) for r in reqs]
+        assert all(o.shape == (3, 1) for o in outs)
+        # all four queued requests coalesced into ONE execution
+        assert eng.batches == [1, 12]
+        # row order preserved within the coalesced batch
+        np.testing.assert_array_equal(outs[0][:, 0], [0, 1, 2])
+        np.testing.assert_array_equal(outs[3][:, 0], [9, 10, 11])
+        assert b.stats.get('batches[b32]') == 1   # 12 rows -> bucket 32
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_batcher_overload_typed_rejection():
+    gate = threading.Event()
+    eng = FakeEngine(gate=gate)
+    b = DynamicBatcher(eng, max_queue=2, max_wait=0.0, deadline=10.0)
+    try:
+        first = b.submit_async(np.zeros((33, 4), np.float32))  # worker busy
+        time.sleep(0.05)                       # worker picked `first` up
+        b.submit_async(np.zeros((1, 4), np.float32))
+        b.submit_async(np.zeros((1, 4), np.float32))
+        with pytest.raises(ServeOverloadError) as ei:
+            b.submit_async(np.zeros((1, 4), np.float32))
+        assert ei.value.max_queue == 2
+        assert b.stats.get('rejected') == 1
+        gate.set()
+        assert b.wait(first).shape == (33, 1)
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_batcher_deadline_typed_error_counted_once():
+    gate = threading.Event()
+    eng = FakeEngine(gate=gate)
+    b = DynamicBatcher(eng, max_queue=8, max_wait=0.0, deadline=0.1)
+    try:
+        blocker = b.submit_async(np.zeros((1, 4), np.float32), deadline=10.0)
+        time.sleep(0.05)                       # worker enters the gate
+        doomed = b.submit_async(np.zeros((2, 4), np.float32), deadline=0.1)
+        with pytest.raises(DeadlineExceededError) as ei:
+            b.wait(doomed)
+        assert ei.value.rows == 2
+        gate.set()
+        b.wait(blocker)
+        # drain the abandoned request off the queue, then verify the shed
+        # was counted ONCE (client side) and its forward never executed
+        assert b.submit(np.zeros((3, 4), np.float32)).shape == (3, 1)
+        assert b.stats.get('expired') == 1
+        assert eng.batches == [1, 3]           # the doomed 2 rows: never run
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_batcher_engine_error_propagates_per_request():
+    b = DynamicBatcher(FakeEngine(fail=True), max_queue=8, max_wait=0.0,
+                       deadline=5.0)
+    try:
+        with pytest.raises(RuntimeError, match='engine exploded'):
+            b.submit(np.zeros((2, 4), np.float32))
+        assert b.stats.get('engine_errors') == 1
+    finally:
+        b.close()
+
+
+def test_batcher_survives_shape_mismatched_coalesce():
+    """A shape-mismatched request must error per-request, not kill the
+    worker thread (which would wedge the service while still admitting)."""
+    gate = threading.Event()
+    eng = FakeEngine(gate=gate)
+    b = DynamicBatcher(eng, max_queue=16, max_wait=0.0, deadline=10.0)
+    try:
+        blocker = b.submit_async(np.zeros((1, 4), np.float32))
+        time.sleep(0.05)
+        good = b.submit_async(np.zeros((2, 4), np.float32))
+        bad = b.submit_async(np.zeros((2, 9), np.float32))  # wrong width
+        gate.set()
+        b.wait(blocker)
+        with pytest.raises(ValueError):
+            b.wait(good)                 # coalesced batch fails together
+        with pytest.raises(ValueError):
+            b.wait(bad)
+        # the worker survived: the service still serves
+        assert b.submit(np.zeros((3, 4), np.float32)).shape == (3, 1)
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_batcher_close_idempotent_and_rejects_after():
+    b = DynamicBatcher(FakeEngine(), max_queue=8, max_wait=0.0, deadline=5.0)
+    assert b.submit(np.zeros((1, 4), np.float32)).shape == (1, 1)
+    assert b.close()
+    assert b.close()                     # second close: no block, no raise
+    with pytest.raises(ServeError):
+        b.submit_async(np.zeros((1, 4), np.float32))
+
+
+def test_batcher_drains_queue_on_close():
+    gate = threading.Event()
+    eng = FakeEngine(gate=gate)
+    b = DynamicBatcher(eng, max_queue=16, max_wait=0.0, deadline=10.0)
+    reqs = [b.submit_async(np.zeros((1, 4), np.float32)) for _ in range(5)]
+    gate.set()
+    assert b.close(timeout=10)
+    for r in reqs:                       # graceful: nothing dropped
+        assert b.wait(r).shape == (1, 1)
+
+
+# --- registry / hot reload ------------------------------------------------
+
+def save_model_with_digest(net, path):
+    net.save_model(path)
+    checkpoint.write_model_digest(path)
+
+
+def test_registry_reload_state_machine(tmp_path):
+    net = make_net()
+    save_model_with_digest(net, str(tmp_path / '0000.model'))
+    serve = wrapper.Net(dev='cpu', cfg=NET_CFG)
+    serve.load_model(str(tmp_path / '0000.model'))
+    eng = PredictEngine(serve._trainer, (1, 8))
+    reg = ModelRegistry(eng, str(tmp_path), current=0)
+    assert not reg.poll_once()           # nothing newer
+    assert reg.states() == []
+
+    v2 = rig_constant_class(make_net(seed=7))
+    save_model_with_digest(v2, str(tmp_path / '0001.model'))
+    assert reg.poll_once()
+    assert reg.states() == ['DETECTED', 'VERIFYING', 'LOADING', 'WARMING',
+                            'SWAPPED']
+    assert reg.current == 1 and eng.version == 1
+    d = np.random.RandomState(0).randn(4, 1, 1, 8).astype(np.float32)
+    assert np.all(eng.predict(d) == 2.0)
+    assert not reg.poll_once()           # idempotent: already current
+
+
+def test_registry_rejects_corrupt_checkpoint_and_keeps_serving(tmp_path):
+    net = make_net()
+    save_model_with_digest(net, str(tmp_path / '0000.model'))
+    eng = PredictEngine(net._trainer, (8,))
+    d = np.random.RandomState(1).randn(3, 1, 1, 8).astype(np.float32)
+    before = eng.predict_scores(d)
+    reg = ModelRegistry(eng, str(tmp_path), current=0)
+
+    v2 = make_net(seed=3)
+    path = str(tmp_path / '0001.model')
+    save_model_with_digest(v2, path)
+    with open(path, 'r+b') as f:         # flip payload bytes post-digest
+        f.seek(200)
+        f.write(b'\xde\xad\xbe\xef')
+    assert not reg.poll_once()
+    assert reg.states()[-1] == 'REJECTED'
+    assert reg.current == 0 and eng.swap_count == 0
+    np.testing.assert_array_equal(eng.predict_scores(d), before)
+    # persistent rejects blacklist after max_attempts polls (no hot loop)
+    for _ in range(10):
+        reg.poll_once()
+    assert sum(1 for s in reg.states() if s == 'REJECTED') \
+        == reg.retry.max_attempts
+
+
+def test_verify_model_digest_malformed_sidecar_is_reason(tmp_path):
+    """Malformed-but-valid-JSON sidecars must yield a rejection REASON,
+    never an escaping TypeError — the registry blacklists on reasons."""
+    net = make_net()
+    path = str(tmp_path / '0000.model')
+    net.save_model(path)
+    assert checkpoint.verify_model_digest(path) is None   # no sidecar: ok
+    side = checkpoint.model_digest_path(path)
+    for payload in ('{"size": %d}' % os.path.getsize(path),  # missing crc
+                    '[1, 2, 3]', '"nope"', 'not json at all'):
+        with open(side, 'w') as f:
+            f.write(payload)
+        reason = checkpoint.verify_model_digest(path)
+        assert isinstance(reason, str) and reason
+    # and the registry turns it into a REJECTED cycle, old version serving
+    eng = PredictEngine(net._trainer, (8,))
+    reg = ModelRegistry(eng, str(tmp_path), current=-1)
+    assert not reg.poll_once()
+    assert reg.states()[-1] == 'REJECTED' and eng.swap_count == 0
+
+
+def test_registry_falls_back_past_corrupt_newest(tmp_path):
+    """A corrupt NEWEST checkpoint must not pin the server: the same
+    poll falls back to the next-newest good candidate."""
+    net = make_net()
+    eng = PredictEngine(net._trainer, (8,))
+    reg = ModelRegistry(eng, str(tmp_path), current=0)
+    good = rig_constant_class(make_net(seed=13))
+    save_model_with_digest(good, str(tmp_path / '0001.model'))
+    bad_path = str(tmp_path / '0002.model')
+    save_model_with_digest(make_net(seed=14), bad_path)
+    with open(bad_path, 'r+b') as f:
+        f.seek(150)
+        f.write(b'\xba\xad')
+    assert reg.poll_once()               # 0002 rejected, 0001 adopted
+    assert reg.current == 1 and eng.version == 1
+    states = reg.states()
+    assert 'REJECTED' in states and states[-1] == 'SWAPPED'
+    d = np.zeros((3, 1, 1, 8), np.float32)
+    assert np.all(eng.predict(d) == 2.0)
+
+
+def test_pred_buckets_bounds_streaming_paths(tmp_path):
+    """forward_stream/predict_stream honor the ladder too: an iterator
+    with varying batch sizes must not grow the compile cache."""
+    from cxxnet_tpu.io.data import DataBatch
+    net = make_net()
+    tr = net._trainer
+    tr.set_param('pred_buckets', '8')
+    base = tr._forward_fn._cache_size()
+    rng = np.random.RandomState(6)
+    batches = [DataBatch(rng.randn(n, 1, 1, 8).astype(np.float32),
+                         np.zeros((n, 1), np.float32))
+               for n in (3, 5, 7)]
+    chunks = list(tr.predict_stream(iter(batches)))
+    assert [c.shape[0] for c in chunks] == [3, 5, 7]
+    assert tr._forward_fn._cache_size() - base == 1
+    # values identical to the unbucketed stream
+    tr.set_param('pred_buckets', '0')
+    for c, ref in zip(chunks, tr.predict_stream(iter(batches))):
+        np.testing.assert_array_equal(c, ref)
+
+
+def test_registry_rejects_structural_mismatch(tmp_path):
+    other_cfg = NET_CFG.replace('layer[+1] = relu', 'layer[+1] = sigmoid')
+    other = wrapper.Net(dev='cpu', cfg=other_cfg)
+    other.init_model()
+    path = str(tmp_path / 'other.model')
+    other.save_model(path)
+    net = make_net()
+    eng = PredictEngine(net._trainer, (8,))
+    with pytest.raises(ValueError, match='architecture'):
+        load_model_params(eng, path)
+
+
+def test_registry_watcher_thread_lifecycle(tmp_path):
+    net = make_net()
+    save_model_with_digest(net, str(tmp_path / '0000.model'))
+    eng = PredictEngine(net._trainer, (8,))
+    reg = ModelRegistry(eng, str(tmp_path), poll_interval=0.02, current=0)
+    reg.start()
+    reg.start()                          # idempotent
+    v2 = rig_constant_class(make_net(seed=11))
+    save_model_with_digest(v2, str(tmp_path / '0001.model'))
+    deadline = time.monotonic() + 10
+    while reg.current != 1 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert reg.current == 1
+    assert reg.close(timeout=5)
+    assert reg.close(timeout=5)          # idempotent
+
+
+# --- acceptance: concurrent serve + hot reload, zero drops ----------------
+
+def test_e2e_concurrent_serve_hot_reload_zero_drops(tmp_path):
+    """N concurrent clients with mixed request sizes; mid-traffic the
+    registry hot-swaps a new checkpoint.  Every request completes (zero
+    drops), the engine compiled exactly len(buckets) programs, overload
+    is a typed rejection, and post-swap requests serve the new params."""
+    buckets = (1, 8, 32)
+    net = make_net()
+    save_model_with_digest(net, str(tmp_path / '0000.model'))
+    serve = wrapper.Net(dev='cpu', cfg=NET_CFG)
+    serve.load_model(str(tmp_path / '0000.model'))
+    eng = PredictEngine(serve._trainer, buckets)
+    eng.warm()
+    bat = DynamicBatcher(eng, max_queue=256, max_wait=0.002, deadline=30.0)
+    reg = ModelRegistry(eng, str(tmp_path), current=0)
+
+    n_clients = 6
+    completed = []
+    errors = []
+    submitted = [0] * n_clients
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def client(cid):
+        rng = np.random.RandomState(cid)
+        while not stop.is_set():
+            n = int(rng.randint(1, 13))
+            submitted[cid] += 1
+            try:
+                scores = bat.submit(rng.randn(n, 1, 1, 8)
+                                    .astype(np.float32))
+                with lock:
+                    completed.append((eng.version, n, scores.shape))
+            except Exception as e:       # any error fails the test
+                with lock:
+                    errors.append((cid, e))
+
+    def count(version=None):
+        with lock:
+            return len(completed) if version is None else \
+                sum(1 for v, _, _ in completed if v == version)
+
+    def wait_for(pred, what):
+        deadline = time.monotonic() + 60
+        while not pred():
+            assert time.monotonic() < deadline, f'timed out: {what}'
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=client, args=(cid,))
+               for cid in range(n_clients)]
+    for t in threads:
+        t.start()
+    # traffic demonstrably flowing on v0, then swap mid-stream
+    wait_for(lambda: count(0) >= 30, 'pre-swap traffic')
+    v2 = rig_constant_class(make_net(seed=21))
+    save_model_with_digest(v2, str(tmp_path / '0001.model'))
+    assert reg.poll_once()
+    # traffic demonstrably flowing on v1 before anyone stops
+    wait_for(lambda: count(1) >= 30, 'post-swap traffic')
+    stop.set()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    assert len(completed) == sum(submitted)              # zero drops
+    assert all(shape == (n, 4) for _, n, shape in completed)
+    # the compile cache stayed provably bounded through all of it
+    assert eng.compile_count == len(buckets)
+    # traffic continued across the swap: both versions actually served
+    versions = {v for v, _, _ in completed}
+    assert versions == {0, 1}
+    # and requests after the swap serve the new params
+    d = np.zeros((4, 1, 1, 8), np.float32)
+    out = bat.submit(d)
+    assert np.all(np.argmax(out, axis=1) == 2)
+    bat.close()
+    report = bat.report('serve')
+    assert 'serve-requests:' in report and 'latency_ms' in report
+
+
+# --- satellite: bounded predict compile cache (pred_buckets) --------------
+
+def test_pred_buckets_bounds_wrapper_predict_compiles():
+    net = make_net()
+    tr = net._trainer
+    tr.set_param('pred_buckets', '8')
+    assert tr.pred_buckets == (8,)
+    base = tr._forward_fn._cache_size()
+    rng = np.random.RandomState(2)
+    data = {n: rng.randn(n, 1, 1, 8).astype(np.float32)
+            for n in (3, 5, 7, 8)}
+    outs = {n: net.predict(d) for n, d in data.items()}
+    assert all(outs[n].shape == (n,) for n in data)
+    # four novel request sizes, ONE compiled program (the 8-bucket)
+    assert tr._forward_fn._cache_size() - base == 1
+    # and values match the unbucketed path exactly
+    tr.set_param('pred_buckets', '0')    # '0' disables
+    assert tr.pred_buckets is None
+    for n, d in data.items():
+        np.testing.assert_array_equal(outs[n], net.predict(d))
+
+
+def test_pred_buckets_mesh_divisibility_clear_error():
+    """Same invariant the engine enforces at startup: on a multi-device
+    mesh a bucket that doesn't divide the data axis fails with the clear
+    config error, not an opaque sharding error mid-predict."""
+    net = wrapper.Net(dev='cpu:0-7', cfg=NET_CFG)
+    net.init_model()
+    net._trainer.set_param('pred_buckets', '1,8')
+    with pytest.raises(ValueError, match='data axis'):
+        net.predict(np.zeros((3, 1, 1, 8), np.float32))
+    net._trainer.set_param('pred_buckets', '8,32')
+    assert net.predict(np.zeros((3, 1, 1, 8), np.float32)).shape == (3,)
+
+
+def test_pred_buckets_extract_and_capi_batch():
+    net = make_net()
+    net._trainer.set_param('pred_buckets', '1,8')
+    rng = np.random.RandomState(4)
+    d = rng.randn(5, 1, 1, 8).astype(np.float32)
+    feat = net.extract(d, 'top[-3]')     # relu output (width 16)
+    assert feat.shape[0] == 5
+    out = capi.net_predict_batch(net, memoryview(d.tobytes()), (5, 1, 1, 8))
+    np.testing.assert_array_equal(out, net.predict(d))
+
+
+# --- satellite: streaming iter paths at the C ABI -------------------------
+
+def make_mnist_iter_cfg(tmp_path, batch_size=10):
+    pi, pl, img, y = write_mnist(str(tmp_path))
+    return f"""
+iter = mnist
+  path_img = "{pi}"
+  path_label = "{pl}"
+  batch_size = {batch_size}
+  silent = 1
+iter = end
+"""
+
+
+def test_net_predict_iter_streams_whole_dataset(tmp_path):
+    cfg = make_mnist_iter_cfg(tmp_path)
+    net = wrapper.Net(dev='cpu', cfg=NET_CFG.replace(
+        'input_shape = 1,1,8', 'input_shape = 1,1,64'))
+    net.init_model()
+    it = wrapper.DataIter(cfg)
+    out = capi.net_predict_iter(net, it)
+    assert out.shape == (50,)            # whole dataset, pads trimmed
+    # matches batch-by-batch prediction
+    it.before_first()
+    chunks = []
+    while it.next():
+        chunks.append(net.predict(it))
+    np.testing.assert_array_equal(out, np.concatenate(chunks))
+    # repeatable: the ABI call rewinds the iterator itself
+    np.testing.assert_array_equal(out, capi.net_predict_iter(net, it))
+
+
+def test_net_extract_iter_streams_whole_dataset(tmp_path):
+    cfg = make_mnist_iter_cfg(tmp_path)
+    net = wrapper.Net(dev='cpu', cfg=NET_CFG.replace(
+        'input_shape = 1,1,8', 'input_shape = 1,1,64'))
+    net.init_model()
+    it = wrapper.DataIter(cfg)
+    out = capi.net_extract_iter(net, it, 'top[-3]')
+    assert out.shape == (50, 1, 1, 16)   # relu width, whole dataset
+    it.before_first()
+    it.next()
+    np.testing.assert_allclose(out[:10].reshape(10, 16),
+                               net.extract(it, 'top[-3]').reshape(10, 16),
+                               rtol=0, atol=1e-6)
+
+
+def test_predict_stream_is_o_batch(tmp_path):
+    """The wrapper-level generator yields one trimmed chunk per batch —
+    the consumer controls peak memory, not the ABI."""
+    cfg = make_mnist_iter_cfg(tmp_path, batch_size=10)
+    net = wrapper.Net(dev='cpu', cfg=NET_CFG.replace(
+        'input_shape = 1,1,8', 'input_shape = 1,1,64'))
+    net.init_model()
+    it = wrapper.DataIter(cfg)
+    sizes = [chunk.shape[0] for chunk in net.predict_stream(it)]
+    assert sizes == [10] * 5
+
+
+# --- satellite: tail-batch predict semantics ------------------------------
+
+def test_predict_stream_trims_exact_tail_pad(tmp_path):
+    """round_batch=0: the last short batch is padded to full size with
+    ``num_batch_padd`` synthetic rows — predict_stream must drop exactly
+    those, so the stream yields exactly the dataset's row count."""
+    lst = make_img_dataset(str(tmp_path), n=10)
+    cfg = [('iter', 'img'), ('image_list', lst),
+           ('image_root', str(tmp_path)), ('input_shape', '3,16,16'),
+           ('batch_size', '4'), ('round_batch', '0'), ('silent', '1'),
+           ('iter', 'end')]
+    from cxxnet_tpu.io.data import create_iterator
+    it = create_iterator(cfg)
+    it.init()
+    batches = list(it)
+    assert [b.num_batch_padd for b in batches] == [0, 0, 2]
+    assert batches[-1].pad_synthetic
+
+    conv_cfg = """
+netconfig=start
+layer[+1] = flatten
+layer[+1] = fullc:fc
+  nhidden = 3
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,16,16
+batch_size = 4
+dev = cpu
+eta = 0.1
+"""
+    net = wrapper.Net(dev='cpu', cfg=conv_cfg)
+    net.init_model()
+    chunks = list(net._trainer.predict_stream(iter(it)))
+    assert [c.shape[0] for c in chunks] == [4, 4, 2]
+    # the tail chunk is the first 2 rows of the padded forward — the
+    # synthetic (repeated-last-instance) rows never surface
+    full = net._trainer._forward_nodes(batches[-1], [
+        net._trainer.net.cfg.layers[-1].nindex_out[-1]])[0]
+    np.testing.assert_array_equal(
+        chunks[-1], wrapper.NetTrainer._pred_transform(full[:2]))
+
+
+# --- satellite: re-entrant pipeline shutdown ------------------------------
+
+def test_thread_buffer_iterator_close_idempotent(tmp_path):
+    from cxxnet_tpu.io.data import ThreadBufferIterator, create_iterator
+    lst = make_img_dataset(str(tmp_path), n=8)
+    base = create_iterator(
+        [('iter', 'img'), ('image_list', lst),
+         ('image_root', str(tmp_path)), ('input_shape', '3,16,16'),
+         ('batch_size', '4'), ('silent', '1'), ('iter', 'end')])
+    it = ThreadBufferIterator(base)
+    it.init()
+    assert len(list(it)) == 2
+    assert it.close(timeout=5)
+    t0 = time.monotonic()
+    assert it.close(timeout=5)           # second close: no block, no raise
+    assert time.monotonic() - t0 < 1.0
+    # the buffer stays usable after close (serve-loop re-entry)
+    assert len(list(it)) == 2
+    assert it.close(timeout=5)
+
+
+def test_thread_buffer_close_concurrent():
+    from cxxnet_tpu.utils.thread_buffer import ThreadBuffer
+    buf = ThreadBuffer(lambda: iter(range(100)), buffer_size=2)
+    got = []
+    for x in buf:
+        got.append(x)
+        if len(got) == 3:
+            break
+    results = []
+    ths = [threading.Thread(target=lambda: results.append(
+        buf.close(timeout=5))) for _ in range(4)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(10)
+    assert results == [True] * 4         # every concurrent close returns
+
+
+# --- CLI: task=serve end to end -------------------------------------------
+
+def _run_cli(conf_path, cwd, *overrides, timeout=300):
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    r = subprocess.run(
+        [sys.executable, '-m', 'cxxnet_tpu.main', conf_path, *overrides],
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    return r
+
+
+def test_cli_task_serve_matches_task_pred(tmp_path):
+    pi, pl, img, y = write_mnist(str(tmp_path))
+    conf = f"""
+data = train
+iter = mnist
+  path_img = "{pi}"
+  path_label = "{pl}"
+  batch_size = 10
+  silent = 1
+iter = end
+pred = {tmp_path}/pred_serve.txt
+iter = mnist
+  path_img = "{pi}"
+  path_label = "{pl}"
+  batch_size = 10
+  silent = 1
+iter = end
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 16
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 3
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,64
+batch_size = 10
+dev = cpu
+eta = 0.3
+num_round = 1
+model_dir = {tmp_path}/models
+metric = error
+"""
+    cp = tmp_path / 'serve.conf'
+    cp.write_text(conf)
+    _run_cli(str(cp), str(tmp_path), 'silent=1')
+    model = f'{tmp_path}/models/0001.model'
+    # train wrote the hot-reload digest sidecar alongside the model
+    assert os.path.exists(model + '.crc32')
+    assert checkpoint.verify_model_digest(model) is None
+    r = _run_cli(str(cp), str(tmp_path), 'task=serve',
+                 f'model_in={model}', 'serve.buckets=1,8,16',
+                 'serve.deadline=60', 'silent=1')
+    assert 'compiled 3 programs for 3 buckets' in r.stdout
+    assert '[serve]' in r.stderr and 'serve-requests:' in r.stderr
+    r2 = _run_cli(str(cp), str(tmp_path), 'task=pred',
+                  f'model_in={model}', f'pred={tmp_path}/pred_ref.txt',
+                  'silent=1')
+    assert (tmp_path / 'pred_serve.txt').read_text() \
+        == (tmp_path / 'pred_ref.txt').read_text()
