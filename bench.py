@@ -165,13 +165,24 @@ def _emit_throughput(metric: str, work_per_step: float, unit: str,
                      baseline: float, step_flops: float, per_step: float,
                      t1s) -> None:
     """The shared ledger JSON payload (value/tflops/mfu/step_ms/
-    dispatch_ms/timing keys) — one schema for every model family."""
+    dispatch_ms/timing keys) — one schema for every model family.
+
+    The A/B experiment knobs ride in the receipt itself (``batch`` from
+    ``CXXNET_BENCH_BATCH``, ``conf_extra`` from
+    ``CXXNET_BENCH_CONF_EXTRA``; both None on a baseline run), so a
+    ledger entry is self-describing — an override run can never be
+    mistaken for the default configuration it is measured against.
+    ``save_stall_ms_per_step`` is 0.0 here by construction (these loops
+    never touch a checkpoint); ``bench_ckpt.py`` measures the nonzero
+    sync-vs-async story on the same schema key."""
     import statistics
 
     rate = work_per_step / per_step
     achieved = step_flops / per_step
     peak = _peak_flops()
     measured = step_flops > 0            # 0 = backend has no cost model
+    env_batch = os.environ.get('CXXNET_BENCH_BATCH')
+    conf_extra = os.environ.get('CXXNET_BENCH_CONF_EXTRA', '').strip()
     _emit({
         'metric': metric,
         'value': round(rate, 1),
@@ -184,6 +195,9 @@ def _emit_throughput(metric: str, work_per_step: float, unit: str,
         # link/dispatch overhead one un-pipelined update() pays per call
         'dispatch_ms': round(statistics.median(t1s) * 1e3 - per_step * 1e3,
                              1),
+        'batch': int(env_batch) if env_batch else None,
+        'conf_extra': conf_extra or None,
+        'save_stall_ms_per_step': 0.0,
         'timing': 'scan-in-jit K-vs-1 quotient',
     })
 
@@ -471,6 +485,11 @@ def bench_decode() -> int:
     batch = _bench_batch(8)
     seq0 = int(os.environ.get('CXXNET_BENCH_SEQ', '128'))
     new_k = _bench_steps(256)
+    # exact decode shapes: the K-vs-1 quotient needs each request to cost
+    # exactly its own step count — opt out of the generate() size-class
+    # bucketing (models/transformer._size_class) so no run is ever
+    # rounded up to a larger compiled horizon
+    os.environ['CXXNET_GEN_BUCKETS'] = '0'
     cfg = T.TransformerConfig(
         vocab_size=32768, d_model=1024, num_heads=16, d_ff=4096,
         num_stages=8, seq_len=seq0 + new_k, attn='local', causal=True,

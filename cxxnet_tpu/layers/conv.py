@@ -62,8 +62,12 @@ def conv_s2d(x, w, strides, pad):
     ``K = ceil(k/s)*s``, ``y[o] = sum_u x[o*s+u] w[u]`` regroups by
     ``u = a*s + r`` into a stride-1 conv over block index ``a`` with
     ``(r, c)`` as channels — exact, so backward comes from AD through
-    the reshapes.  Requires pad % stride == 0 (the pad folds into
-    explicit zeros first); callers degrade to native otherwise."""
+    the reshapes.  Handles ARBITRARY padding (the pad folds into
+    explicit zeros before blocking, so no alignment is required for
+    correctness); the ``_lowering`` gate nonetheless only routes
+    stride-aligned pads here — a conservative POLICY bound, keeping s2d
+    on the shape class the on-chip receipts actually measured, not a
+    correctness requirement."""
     sy, sx = strides
     (py_lo, py_hi), (px_lo, px_hi) = pad
     b, _, _, c = x.shape
@@ -153,8 +157,11 @@ class ConvolutionLayer(Layer):
         # each variant degrades to native on the shapes it does not
         # target, so the knob is usable as a netconfig GLOBAL (replayed
         # into every layer): im2col targets ungrouped convs, split
-        # grouped ones, s2d ungrouped strided convs with stride-aligned
-        # padding
+        # grouped ones, s2d ungrouped strided convs.  The s2d
+        # stride-aligned-padding clause is a conservative POLICY bound,
+        # not correctness (conv_s2d handles arbitrary pads — it folds
+        # them into explicit zeros first): it pins the lowering to the
+        # entry-conv shape class the receipts measured wins on
         if mode == 'split' and self.param.num_group == 1:
             return 'native'
         if mode == 'im2col' and self.param.num_group != 1:

@@ -54,6 +54,11 @@ class LearnTask:
         self.nan_breaker = 3           # train.nan_breaker (consecutive NaNs)
         self.save_every = 0            # train.save_every (steps, 0=per-round)
         self.keep_last = 4             # train.keep_last ckpts kept (0=all)
+        self.save_async = 0            # save_async=1 -> background ckpt
+                                       # writer (doc/fault_tolerance.md);
+                                       # final save always barriers
+        self.save_workers = 2          # save_workers per-save write threads
+        self._async_ckpt = None        # lazy AsyncCheckpointer
         self.extract_node_name = ''
         self.name_pred = 'pred.txt'
         self.output_format = 1
@@ -89,6 +94,8 @@ class LearnTask:
             'train.nan_breaker': ('nan_breaker', int),
             'train.save_every': ('save_every', int),
             'train.keep_last': ('keep_last', int),
+            'save_async': ('save_async', int),
+            'save_workers': ('save_workers', int),
             'serve.buckets': ('serve_buckets', str),
             'serve.max_queue': ('serve_max_queue', int),
             'serve.max_wait': ('serve_max_wait', float),
@@ -174,6 +181,23 @@ class LearnTask:
     def _exact_dir(self) -> str:
         return os.path.join(self.name_model_dir, 'exact_state')
 
+    def _ckpt(self):
+        """The CLI's background checkpoint writer (``save_async=1``)."""
+        if self._async_ckpt is None:
+            from .runtime.async_ckpt import AsyncCheckpointer
+            self._async_ckpt = AsyncCheckpointer(workers=self.save_workers)
+        return self._async_ckpt
+
+    def _prune_exact(self, counter: int) -> None:
+        # only the sidecar matching the newest model file is ever
+        # restored: prune older ones (~3x model size each)
+        from .nnet.sharded_ckpt import step_dir
+        import shutil
+        for old in range(counter):
+            d = step_dir(self._exact_dir(), old)
+            if os.path.isdir(d):
+                shutil.rmtree(d, ignore_errors=True)
+
     def _save_model(self) -> None:
         counter = self.start_counter
         path = self._model_path(counter)
@@ -181,6 +205,9 @@ class LearnTask:
         if self.save_period == 0 or self.start_counter % self.save_period != 0:
             return
         os.makedirs(self.name_model_dir, exist_ok=True)
+        if self.save_async:
+            self._save_model_async(counter, path)
+            return
 
         def _write(f):
             f.write(int(self.net_type).to_bytes(4, 'little', signed=True))
@@ -197,14 +224,42 @@ class LearnTask:
             # continue=1 resumes bit-exact mid-momentum (the reference
             # model file drops momentum by design — trainer.save_model)
             self.net_trainer.save_training_state(self._exact_dir(), counter)
-            # only the sidecar matching the newest model file is ever
-            # restored: prune older ones (~3x model size each)
-            from .nnet.sharded_ckpt import step_dir
-            import shutil
-            for old in range(counter):
-                d = step_dir(self._exact_dir(), old)
-                if os.path.isdir(d):
-                    shutil.rmtree(d, ignore_errors=True)
+            self._prune_exact(counter)
+
+    def _save_model_async(self, counter: int, path: str) -> None:
+        """``save_async=1``: the round boundary only snapshots (donation-
+        safe device copies + the cheap config header); serialization and
+        the atomic+retried+digested writes run on the background writer.
+        Same bytes, same crash contract as the sync path — the next round
+        starts without waiting on storage.  ``run()`` barriers before
+        exit, so the last model file is always durable."""
+        from .nnet.trainer import NetTrainer
+        from .runtime import async_ckpt
+        tr = self.net_trainer
+        header = (int(self.net_type).to_bytes(4, 'little', signed=True)
+                  + tr.model_header())
+        net = tr.net
+        # one param snapshot per boundary: the exact-resume tree already
+        # carries a params copy, so the model blob serializes from it
+        tsnap = tr.snapshot_training_state() if self.exact_ckpt else None
+        psnap = (tsnap['params'] if tsnap is not None
+                 else async_ckpt.snapshot_tree(tr.params))
+        exact_dir = self._exact_dir()
+        ck = self._ckpt()
+
+        def job():
+            blob = model_io.serialize_blob(net, async_ckpt.host_tree(psnap))
+            model_io.save_model_file(
+                path, lambda f: NetTrainer.write_model_bytes(f, header,
+                                                             blob))
+            model_io.write_model_digest(path)
+            if tsnap is not None:
+                from .nnet import sharded_ckpt
+                sharded_ckpt.save_tree_native(exact_dir, counter, tsnap,
+                                              pool=ck.io_pool)
+                self._prune_exact(counter)
+
+        ck.submit(job, step=counter, label=f'save_model:{counter:04d}')
 
     def _create_iterators(self) -> None:
         flag = 0
@@ -291,6 +346,15 @@ class LearnTask:
             self._train_rounds(tracer, batch_counter, start)
         finally:
             tracer.stop()
+            if self._async_ckpt is not None:
+                # the FINAL save always barriers: a deferred write error
+                # surfaces here (like the sync path's, rounds late), and
+                # the newest model file is durable before the CLI returns
+                try:
+                    self._async_ckpt.wait()
+                finally:
+                    self._async_ckpt.close(wait=False)
+                    self._async_ckpt = None
 
     def _make_supervisor(self):
         from .io.data import ThreadBufferIterator
@@ -319,7 +383,9 @@ class LearnTask:
             max_restarts=self.max_restarts,
             nan_breaker=self.nan_breaker,
             save_every=self.save_every,
-            keep_last=self.keep_last)
+            keep_last=self.keep_last,
+            save_async=self.save_async,
+            save_workers=self.save_workers)
         return TrainSupervisor(
             self.net_trainer,
             os.path.join(self.name_model_dir, 'supervised_state'), cfg)
@@ -350,10 +416,17 @@ class LearnTask:
         return sup.run(factory, before_step=before_step)
 
     def _train_rounds(self, tracer, batch_counter, start) -> None:
-        cc = self.max_round
         sup = None
         if self.supervise and self.test_io == 0:
             sup = self._make_supervisor()
+        try:
+            self._run_rounds(sup, tracer, batch_counter, start)
+        finally:
+            if sup is not None:
+                sup.close()
+
+    def _run_rounds(self, sup, tracer, batch_counter, start) -> None:
+        cc = self.max_round
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
             if not self.silent:

@@ -25,9 +25,11 @@ so optimizer state never leaves the device that owns the shard.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import math
+import os
 from dataclasses import dataclass
 from typing import Tuple
 
@@ -406,11 +408,38 @@ def _nodrop_moe_ffn(y2, p, gather: bool):
     return (pg * out.astype(jnp.float32)).astype(y2.dtype)
 
 
-# compiled decode programs keyed by (cfg, shapes, sampling): generate()
-# is called repeatedly (sampling loops, tests) and must not re-trace —
-# and the jitted fn takes params as an ARGUMENT so weights are inputs,
-# not baked-in XLA constants
-_GEN_CACHE: dict = {}
+# compiled decode programs keyed by (cfg, bucketed shapes, sampling):
+# generate() is called repeatedly (sampling loops, tests) and must not
+# re-trace — and the jitted fn takes params as an ARGUMENT so weights are
+# inputs, not baked-in XLA constants.  Two guards keep the cache from
+# retaining one compiled program per distinct request shape forever:
+# prompt/new-token lengths are BUCKETED into power-of-two size classes
+# before keying (below), and the cache itself is a small LRU
+# (``CXXNET_GEN_CACHE_MAX``, default 8) — a varying-prompt sampling loop
+# touches a handful of entries, evicting cold programs instead of
+# growing without bound.
+_GEN_CACHE: 'collections.OrderedDict' = collections.OrderedDict()
+
+
+def _gen_cache_max() -> int:
+    return max(1, int(os.environ.get('CXXNET_GEN_CACHE_MAX', '8')))
+
+
+def _size_class(n: int, floor: int = 1) -> int:
+    """Bucket a length into its size class: the next power of two (the
+    prompt axis floors at 8; ``max_new`` uses the full {1,2,4,8,...}
+    ladder — a 1-token request must not pay 8 decode steps).  EXACT
+    under bucketing (see ``generate``): extra decode steps are computed
+    and trimmed (decode is sequential — token t never depends on later
+    steps), and a bucketed prompt is LEFT-padded with masked-out slots
+    (the model has no positional encoding, so a uniform slot shift with
+    pads excluded from every attention is the identical computation on
+    the real tokens).  ``CXXNET_GEN_BUCKETS=0`` disables bucketing
+    (exact shapes — e.g. bench.py's K-vs-1 decode quotient)."""
+    b = max(1, floor)
+    while b < n:
+        b <<= 1
+    return b
 
 
 def generate(params, prompt, max_new: int, cfg: TransformerConfig,
@@ -443,15 +472,38 @@ def generate(params, prompt, max_new: int, cfg: TransformerConfig,
         raise ValueError('temperature>0 sampling needs an rng key')
     prompt = jnp.asarray(prompt, jnp.int32)
     b, s0 = prompt.shape
-    key = (dataclasses.astuple(cfg), b, s0, max_new, float(temperature),
+    if os.environ.get('CXXNET_GEN_BUCKETS', '1') != '0':
+        s0b, mnb = _size_class(s0, floor=8), _size_class(max_new)
+    else:
+        s0b, mnb = s0, max_new
+    w = s0b - s0                    # left-pad width (0 = exact shape)
+    if w:
+        prompt = jnp.pad(prompt, ((0, 0), (w, 0)))
+    key = (dataclasses.astuple(cfg), b, s0b, mnb, float(temperature),
            eos_id)
     run = _GEN_CACHE.get(key)
     if run is None:
         run = _GEN_CACHE[key] = _build_generate(
-            cfg, b, s0, max_new, temperature, eos_id)
-    if rng is None:
-        rng = jax.random.PRNGKey(0)
-    return run(params, prompt, rng)
+            cfg, b, s0b, mnb, temperature, eos_id)
+        while len(_GEN_CACHE) > _gen_cache_max():
+            _GEN_CACHE.popitem(last=False)
+    else:
+        _GEN_CACHE.move_to_end(key)     # LRU touch
+    # the pad width is a traced VALUE, not a shape: every w for the same
+    # bucket reuses one compiled program.  Sampling keys are split for
+    # the REQUESTED horizon and zero-padded to the bucket (split(rng, n)
+    # prefixes are not stable across n), so the first max_new draws
+    # match the unbucketed schedule exactly; the padded tail's draws are
+    # trimmed with the extra tokens.
+    if temperature > 0:
+        keys = jax.random.split(rng, max_new + 1)
+        if mnb > max_new:
+            keys = jnp.concatenate(
+                [keys, jnp.zeros((mnb - max_new,) + keys.shape[1:],
+                                 keys.dtype)])
+    else:
+        keys = jnp.zeros((mnb + 1, 2), jnp.uint32)
+    return run(params, prompt, keys, jnp.int32(w))[:, :max_new]
 
 
 def _build_generate(cfg: TransformerConfig, b: int, s0: int,
@@ -474,7 +526,7 @@ def _build_generate(cfg: TransformerConfig, b: int, s0: int,
         return jnp.argmax(logits, axis=-1)
 
     @jax.jit
-    def run(params, prompt, rng):
+    def run(params, prompt, keys, w):
         stage_ps = [jax.tree.map(lambda a, i=i: a[i], params['stages'])
                     for i in range(cfg.num_stages)]
         # --- prefill: full prompt in one pass, K/V captured per stage
@@ -482,7 +534,16 @@ def _build_generate(cfg: TransformerConfig, b: int, s0: int,
         kc = jnp.zeros((cfg.num_stages, b, total, cfg.num_heads, hd),
                        h.dtype)
         vc = jnp.zeros_like(kc)
-        mask = jnp.tril(jnp.ones((s0, s0), bool))[None, None]
+        # causal over the real tokens only: the first ``w`` slots are
+        # bucket padding (generate() left-pads), excluded from every
+        # real query.  Each PAD query attends just its own slot — an
+        # all-masked softmax row is NaN, and 0 * NaN cached-V rows would
+        # poison real outputs downstream.  ``w`` is traced, so w=0
+        # reduces to the plain tril without a separate program.
+        ar = jnp.arange(s0)
+        mask = ((ar[None, :] <= ar[:, None]) & (ar[None, :] >= w)
+                | (ar[None, :] == ar[:, None]) & (ar[:, None] < w)
+                )[None, None]
         for i, p in enumerate(stage_ps):
             h, y2, k, v = _stage_attn(p, h, cfg, mask)
             kc = kc.at[i, :, :s0].set(k)
@@ -490,8 +551,6 @@ def _build_generate(cfg: TransformerConfig, b: int, s0: int,
             h = h + ffn(p, y2, gather=False)
         logits0 = (h[:, -1] @ params['head']).astype(jnp.float32)
 
-        keys = (jax.random.split(rng, max_new + 1) if temperature > 0
-                else jnp.zeros((max_new + 1, 2), jnp.uint32))
         tok0 = pick(logits0, keys[0] if temperature > 0 else None)
         rngs = keys[1:]
         done0 = (tok0 == eos_id if eos_id is not None
@@ -502,7 +561,9 @@ def _build_generate(cfg: TransformerConfig, b: int, s0: int,
             tok, done, kc, vc = carry
             t, r = inp
             h = jnp.take(params['embed'], tok[:, None], axis=0)
-            live = (jnp.arange(total) <= t)[None, None, None, :]
+            # cache slots [0, w) hold bucket-pad K/V: never attended
+            live = ((jnp.arange(total) <= t)
+                    & (jnp.arange(total) >= w))[None, None, None, :]
             for i, p in enumerate(stage_ps):
                 y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
                 q = (y @ p['wq']).reshape(b, 1, cfg.num_heads, hd)

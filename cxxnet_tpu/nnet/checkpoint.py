@@ -102,10 +102,23 @@ def from_disk_layout(type_id: int, field: str, arr: np.ndarray,
     return arr
 
 
-def params_to_blob(net, params) -> bytes:
-    out = bytearray()
-    host = {k: {f: np.asarray(v) for f, v in d.items()}
+def host_params(params) -> Dict[str, Dict[str, np.ndarray]]:
+    """Materialize a param tree on host — the device→host half of
+    serialization, split out so an async save (runtime/async_ckpt.py) can
+    run it on the background writer instead of the step loop."""
+    return {k: {f: np.asarray(v) for f, v in d.items()}
             for k, d in params.items()}
+
+
+def params_to_blob(net, params) -> bytes:
+    return serialize_blob(net, host_params(params))
+
+
+def serialize_blob(net, host: Dict[str, Dict[str, np.ndarray]]) -> bytes:
+    """Serialize an already-host-resident param snapshot to the reference
+    model blob layout — pure CPU work, safe on a background thread (reads
+    only the net's static layer structure)."""
+    out = bytearray()
     for i, info in enumerate(net.cfg.layers):
         if net.layer_primary[i] != i or info.type == lbase.kSharedLayer:
             continue

@@ -24,6 +24,7 @@ import zlib
 from typing import List, Optional, Tuple
 
 import jax
+import numpy as np
 
 from ..runtime import faults
 
@@ -174,6 +175,189 @@ def verify_step_dir(path: str) -> Optional[str]:
     return None
 
 
+# --- native tree format ---------------------------------------------------
+#
+# The async save path (runtime/async_ckpt.py) writes checkpoints WITHOUT
+# orbax: one raw-bytes file per leaf (parallel, each through
+# ``checkpoint.atomic_write``) plus a JSON manifest mapping tree paths to
+# (file, dtype, shape), committed by directory rename — the same
+# step_<n>-appears-atomically contract orbax gives, with the write
+# parallelism under our control and no event-loop machinery on the hot
+# path.  Both formats share ``ckpt_digest.json`` and the step-dir naming,
+# so verification, quarantine, pruning, and resilient fallback treat them
+# identically; ``restore_sharded`` dispatches on the manifest's presence.
+
+_MANIFEST_NAME = 'tree_manifest.json'
+_PACKED_NAME = 'packed_leaves.bin'
+_PACK_LIMIT = 1 << 18        # leaves under 256 KiB share one blob file
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _flat_with_paths(tree) -> List[Tuple[str, object]]:
+    """(path-string, leaf) pairs in deterministic tree order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def _write_leaf(dirpath: str, fname: str,
+                data) -> Tuple[int, int]:
+    """Plain write+fsync of one leaf into the UNCOMMITTED temp dir — the
+    directory rename is the atomic unit, so a per-leaf atomic_write dance
+    would only add a rename and two fsyncs per file.  Returns
+    (size, crc32) computed from the in-memory bytes, so the digest never
+    re-reads what it just wrote."""
+    with open(os.path.join(dirpath, fname), 'wb') as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    size = data.nbytes if isinstance(data, np.ndarray) else len(data)
+    return size, zlib.crc32(data) & 0xFFFFFFFF
+
+
+def save_tree_native(ckpt_dir: str, step: int, host_flat_tree, retry=None,
+                     pool=None) -> str:
+    """Write a host-materialized pytree as a native ``step_<n>``
+    checkpoint: leaves in parallel over ``pool`` (a ThreadPoolExecutor;
+    None = sequential), manifest last, then one directory rename commits
+    the whole step.  An existing dir for the step is REPLACED (same
+    contract as the supervisor's sync save).  The write retries whole
+    under ``retry`` and passes through the fault-injection hook; the
+    crc32 integrity sidecar (same ``ckpt_digest.json`` format
+    ``verify_step_dir`` checks) is accumulated from the in-memory bytes
+    during the write — no second read pass — and lands via
+    ``atomic_write`` after the commit, then ``shard_committed`` fires:
+    identical recovery surface to the orbax path."""
+    path = _absolute(step_dir(ckpt_dir, step))
+    tmp = f'{path}.tmp.{os.getpid()}'
+    # np.require, not ascontiguousarray: the latter promotes 0-d leaves
+    # (counters) to shape (1,), which would change the restored tree
+    flat = [(keystr, np.require(np.asarray(leaf), requirements='C'))
+            for keystr, leaf in _flat_with_paths(host_flat_tree)]
+    retry = faults.DEFAULT_IO_RETRY if retry is None else retry
+    digest = {}
+
+    def attempt():
+        import shutil
+        faults.checkpoint_write_attempt(path)
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        digest.clear()
+        manifest = {}
+        jobs = []
+        # small leaves (biases, counters — most of the tree's FILE count,
+        # none of its bytes) pack into one blob: per-file fsync cost, not
+        # bandwidth, is what bounds the background writer's latency
+        packed, off = [], 0
+        for i, (keystr, arr) in enumerate(flat):
+            if arr.nbytes < _PACK_LIMIT:
+                manifest[keystr] = {'file': _PACKED_NAME,
+                                    'dtype': str(arr.dtype),
+                                    'shape': list(arr.shape),
+                                    'offset': off}
+                packed.append(arr)
+                off += arr.nbytes
+                continue
+            fname = f'leaf_{i:05d}.bin'
+            manifest[keystr] = {'file': fname, 'dtype': str(arr.dtype),
+                                'shape': list(arr.shape)}
+            if pool is None:
+                digest[fname] = list(_write_leaf(tmp, fname, arr))
+            else:
+                jobs.append((fname, pool.submit(_write_leaf, tmp, fname,
+                                                arr)))
+        if packed:
+            # .tobytes(), never bytes(): bytes() of a 0-d integer array
+            # routes through __index__ and yields that many NUL bytes
+            blob = b''.join(a.tobytes() for a in packed)
+            if pool is None:
+                digest[_PACKED_NAME] = list(
+                    _write_leaf(tmp, _PACKED_NAME, blob))
+            else:
+                jobs.append((_PACKED_NAME,
+                             pool.submit(_write_leaf, tmp, _PACKED_NAME,
+                                         blob)))
+        for fname, j in jobs:
+            digest[fname] = list(j.result())
+        mbytes = json.dumps(manifest).encode()
+        digest[_MANIFEST_NAME] = [len(mbytes),
+                                  zlib.crc32(mbytes) & 0xFFFFFFFF]
+        _write_leaf(tmp, _MANIFEST_NAME, mbytes)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        os.replace(tmp, path)
+        try:   # make the commit rename itself durable (best effort,
+               # same policy as checkpoint.atomic_write)
+            dfd = os.open(os.path.dirname(path), os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+
+    try:
+        retry.call(attempt, op_name=f'save_native:step_{step}')
+    finally:
+        if os.path.isdir(tmp):
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+    from .checkpoint import atomic_write
+    with atomic_write(os.path.join(path, _DIGEST_NAME)) as f:
+        f.write(json.dumps(digest).encode())
+    faults.shard_committed(step, path)
+    return path
+
+
+def _restore_native(path: str, like):
+    """Load a native-format step dir, placing every leaf per ``like``:
+    jax leaves (or sharding-annotated ShapeDtypeStructs) are device_put
+    with their sharding; host leaves stay numpy."""
+    with open(os.path.join(path, _MANIFEST_NAME)) as f:
+        manifest = json.load(f)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    out = []
+    packed = None                # the shared small-leaf blob, read once
+    for kpath, leaf in flat:
+        key = jax.tree_util.keystr(kpath)
+        ent = manifest.get(key)
+        if ent is None:
+            raise ValueError(
+                f'native checkpoint {path} has no leaf {key!r} '
+                f'(restoring under a changed structure?)')
+        dt = _np_dtype(ent['dtype'])
+        n = int(np.prod(ent['shape'])) if ent['shape'] else 1
+        if ent['file'] == _PACKED_NAME:
+            if packed is None:
+                with open(os.path.join(path, _PACKED_NAME), 'rb') as f:
+                    packed = f.read()
+            arr = np.frombuffer(packed, dt, count=n,
+                                offset=ent.get('offset', 0)).reshape(
+                ent['shape'])
+            writable = False     # frombuffer views are read-only
+        else:
+            # big leaves stream straight from disk, one at a time —
+            # holding every file's bytes until unflatten would double
+            # peak restore memory on exactly the big-model case the
+            # format exists for
+            arr = np.fromfile(os.path.join(path, ent['file']), dtype=dt,
+                              count=n).reshape(ent['shape'])
+            writable = True
+        sharding = getattr(leaf, 'sharding', None)
+        if sharding is not None:
+            arr = jax.device_put(arr, sharding)
+        elif not writable:
+            arr = arr.copy()
+        out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def _flush_pending_digests() -> None:
     while _PENDING_DIGEST:
         step, path = _PENDING_DIGEST.pop()
@@ -249,8 +433,15 @@ def restore_sharded(ckpt_dir: str, like, step: Optional[int] = None,
     # (cloud URLs skip the check and rely on the backend's error)
     if '://' not in path and not os.path.isdir(path):
         raise FileNotFoundError(f'no checkpoint dir {path}')
-    target = _abstract_like(like)
     retry = faults.DEFAULT_IO_RETRY if retry is None else retry
+    if '://' not in path and \
+            os.path.exists(os.path.join(path, _MANIFEST_NAME)):
+        # async-written native format (runtime/async_ckpt.py): restored
+        # with the same retry/placement contract as the orbax path
+        params = retry.call(lambda: _restore_native(path, like),
+                            op_name=f'restore_sharded:step_{step}')
+        return params, step
+    target = _abstract_like(like)
     params = retry.call(
         lambda: _shared_ck().restore(path, target),
         op_name=f'restore_sharded:step_{step}')

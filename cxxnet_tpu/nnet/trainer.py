@@ -943,6 +943,25 @@ class NetTrainer:
         return sharded_ckpt.save_sharded(ckpt_dir, step, tree, block=block,
                                          retry=retry)
 
+    def snapshot_training_state(self):
+        """Donation-safe snapshot of the exact-resume tree (same structure
+        as :meth:`save_training_state`) for the async save path: every
+        device leaf is copied into a fresh buffer (a cheap, non-blocking
+        dispatch — the compiled ``train_step`` donates params/opt_state/
+        grad_acc, so handing the LIVE arrays to a background writer would
+        hand it buffers the very next step invalidates), counters are
+        copied eagerly.  Any validity gate (e.g. the supervisor's
+        NaN-streak rule) must be resolved BEFORE taking the snapshot —
+        once taken, the writer will commit it."""
+        from ..runtime.async_ckpt import snapshot_tree
+        return snapshot_tree(
+            {'params': self.params, 'opt_state': self.opt_state,
+             'grad_acc': self.grad_acc,
+             'counters': {
+                 'epoch': np.asarray(self.epoch_counter, np.int64),
+                 'sample': np.asarray(self.sample_counter, np.int64),
+                 'round': np.asarray(self.round, np.int64)}})
+
     def load_training_state(self, ckpt_dir: str,
                             step: Optional[int] = None,
                             restore_params: bool = False,
@@ -985,12 +1004,30 @@ class NetTrainer:
         self.round = int(c['round'])
         return got
 
-    def save_model(self, fo: BinaryIO) -> None:
-        self.net_cfg.save_net(fo)
-        fo.write(struct.pack('<q', self.epoch_counter))
-        blob = checkpoint.params_to_blob(self.net, self.params)
+    def model_header(self) -> bytes:
+        """The model-file preamble ahead of the weight blob (NetConfig +
+        epoch_counter) — cheap host bytes; an async save captures them at
+        snapshot time while the blob serializes in the background."""
+        import io as _io
+        b = _io.BytesIO()
+        self.net_cfg.save_net(b)
+        b.write(struct.pack('<q', self.epoch_counter))
+        return b.getvalue()
+
+    @staticmethod
+    def write_model_bytes(fo: BinaryIO, header: bytes,
+                          blob: bytes) -> None:
+        """THE model-file layout, in one place: header, u64 blob length,
+        blob — sync :meth:`save_model` and the CLI's async writer both
+        route through here, so the formats can never drift apart."""
+        fo.write(header)
         fo.write(struct.pack('<Q', len(blob)))
         fo.write(blob)
+
+    def save_model(self, fo: BinaryIO) -> None:
+        self.write_model_bytes(
+            fo, self.model_header(),
+            checkpoint.params_to_blob(self.net, self.params))
 
     def load_model(self, fi: BinaryIO) -> None:
         self.net_cfg = NetConfig()

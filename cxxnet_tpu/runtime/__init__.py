@@ -8,4 +8,6 @@ from .faults import (CheckpointCorruptError, DivergenceError,  # noqa: F401
                      PipelineStallError, RetryError, RetryPolicy,
                      TrainingFault, active_plan, clear_plan,
                      global_failure_log, install_plan)
+from .async_ckpt import (AsyncCheckpointer, host_tree,  # noqa: F401
+                         snapshot_tree)
 from .supervisor import SupervisorConfig, TrainSupervisor  # noqa: F401

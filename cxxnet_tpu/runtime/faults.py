@@ -253,6 +253,11 @@ class FaultPlan:
 
     * ``raise_on_write=N`` — the N-th checkpoint storage write attempt
       (1-based, counted across the process) raises :class:`FaultInjected`.
+    * ``stall_write=N[:secs]`` — the N-th checkpoint storage write attempt
+      sleeps ``secs`` (default 0.5) before proceeding: a deterministic
+      slow-storage event, used to prove the async writer's double-buffer
+      backpressure and the restore-barriers-on-pending-save contract
+      without ever racing a real disk.
     * ``stall_batch=K[:secs]`` — the pipeline producer sleeps ``secs``
       (default 30) before handing over batch index K (0-based), tripping
       any consumer deadline shorter than that.
@@ -271,10 +276,13 @@ class FaultPlan:
                  raise_on_write: Tuple[int, ...] = (),
                  stall_batch: Tuple[Tuple[int, Optional[float]], ...] = (),
                  corrupt_shard: Tuple[int, ...] = (),
-                 nan_at_step: Tuple[int, ...] = ()):
+                 nan_at_step: Tuple[int, ...] = (),
+                 stall_write: Tuple[Tuple[int, Optional[float]], ...] = ()):
         self.seed = int(seed)
         self._raise_on_write = set(raise_on_write)
         self._stall = {k: (30.0 if s is None else s) for k, s in stall_batch}
+        self._stall_write = {n: (0.5 if s is None else s)
+                             for n, s in stall_write}
         self._corrupt = set(corrupt_shard)
         self._nan = set(nan_at_step)
         self._write_count = 0
@@ -287,6 +295,7 @@ class FaultPlan:
         seed = 0
         raise_w: List[int] = []
         stall: List[Tuple[int, Optional[float]]] = []
+        stall_w: List[Tuple[int, Optional[float]]] = []
         corrupt: List[int] = []
         nan: List[int] = []
         for key, val in parse_kv_list(text):
@@ -296,6 +305,8 @@ class FaultPlan:
                 raise_w.append(int(val))
             elif key == 'stall_batch':
                 stall.append(_parse_event(val))
+            elif key == 'stall_write':
+                stall_w.append(_parse_event(val))
             elif key == 'corrupt_shard':
                 corrupt.append(int(val))
             elif key == 'nan_at_step':
@@ -304,7 +315,7 @@ class FaultPlan:
                 raise ValueError(f'unknown fault_plan event: {key!r}')
         return cls(seed=seed, raise_on_write=tuple(raise_w),
                    stall_batch=tuple(stall), corrupt_shard=tuple(corrupt),
-                   nan_at_step=tuple(nan))
+                   nan_at_step=tuple(nan), stall_write=tuple(stall_w))
 
     # -- introspection --
     def fired(self) -> List[str]:
@@ -320,6 +331,8 @@ class FaultPlan:
         parts += [f'raise_on_write={n}' for n in sorted(self._raise_on_write)]
         parts += [f'stall_batch={k}:{s:g}'
                   for k, s in sorted(self._stall.items())]
+        parts += [f'stall_write={n}:{s:g}'
+                  for n, s in sorted(self._stall_write.items())]
         parts += [f'corrupt_shard={s}' for s in sorted(self._corrupt)]
         parts += [f'nan_at_step={s}' for s in sorted(self._nan)]
         return ';'.join(parts)
@@ -331,10 +344,15 @@ class FaultPlan:
         with self._lock:
             self._write_count += 1
             n = self._write_count
+            secs = self._stall_write.pop(n, None)
+            if secs is not None:
+                self._fired.append(f'stall_write={n}:{secs:g}')
             hit = n in self._raise_on_write
             if hit:
                 self._raise_on_write.discard(n)
                 self._fired.append(f'raise_on_write={n}')
+        if secs is not None:
+            time.sleep(secs)
         if hit:
             raise FaultInjected(
                 f'injected fault: checkpoint write #{n} to {path}')

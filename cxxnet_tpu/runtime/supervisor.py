@@ -59,6 +59,9 @@ class SupervisorConfig:
     buffer_size: int = 2                     # watchdog prefetch depth
     keep_last: int = 4                       # ckpt steps kept (0 = all);
                                              # also the corrupt-fallback depth
+    save_async: int = 0                      # 1 = background checkpoint
+                                             # writer (runtime/async_ckpt)
+    save_workers: int = 2                    # per-save shard-write threads
     retry: faults.RetryPolicy = field(
         default_factory=lambda: faults.DEFAULT_IO_RETRY)
 
@@ -87,6 +90,12 @@ class TrainSupervisor:
                             if failure_log is None else failure_log)
         self.state = 'IDLE'
         self.restarts_total = 0
+        self._async = None
+        if self.config.save_async:
+            from .async_ckpt import AsyncCheckpointer
+            self._async = AsyncCheckpointer(
+                workers=self.config.save_workers,
+                failure_log=self.failure_log)
         if self.config.nan_breaker and not trainer.nan_breaker:
             trainer.nan_breaker = self.config.nan_breaker
 
@@ -98,11 +107,28 @@ class TrainSupervisor:
         replay rewrites bitwise-identical state, but a same-step save from
         a later round (or a stale dir left by an earlier process) carries
         different counters — skipping it would make a later restore adopt
-        the wrong ``round``/RNG stream."""
+        the wrong ``round``/RNG stream.
+
+        With ``save_async`` the step loop only pays for the snapshot (a
+        non-blocking device-side copy — the trainer's donated buffers are
+        never handed to the writer) plus any double-buffer backpressure
+        from a still-uncommitted previous save; serialization, the atomic
+        commit, the digest, and pruning all run on the background writer.
+        The caller resolves the NaN-streak validity gate BEFORE calling
+        save() — i.e. at snapshot time — so a deferred write can never
+        commit params the gate would have rejected."""
         import shutil
         from ..nnet import sharded_ckpt
         tr = self.trainer
         step = tr.sample_counter
+        if self._async is not None and not self._async_usable():
+            self._async.close()
+            self._async = None
+        if self._async is not None:
+            self._async.save_sharded_async(
+                self.ckpt_dir, step, tr.snapshot_training_state(),
+                retry=self.config.retry, on_commit=lambda _p: self._prune())
+            return sharded_ckpt.step_dir(self.ckpt_dir, step)
         old = sharded_ckpt.step_dir(self.ckpt_dir, step)
         if os.path.isdir(old):
             shutil.rmtree(old, ignore_errors=True)
@@ -110,6 +136,49 @@ class TrainSupervisor:
                                       retry=self.config.retry)
         self._prune()
         return path
+
+    def _async_usable(self) -> bool:
+        """The native async writer gathers every leaf onto this host
+        (``np.asarray``); state sharded across HOSTS is not fully
+        addressable and would fail (or, addressable-but-huge, spike host
+        memory) where the sync orbax path writes shards in place.  Checked
+        once at the first save: multi-host state falls back to synchronous
+        saves with a logged ``save_async_fallback`` record instead of
+        failing every save at its barrier."""
+        if getattr(self, '_async_checked', False):
+            return True
+        import jax
+        tr = self.trainer
+        ok = all(getattr(x, 'is_fully_addressable', True)
+                 for x in jax.tree.leaves(
+                     {'p': tr.params, 'o': tr.opt_state, 'g': tr.grad_acc}))
+        if ok:
+            self._async_checked = True
+            return True
+        self.failure_log.record(
+            'save_async_fallback',
+            'training state is not fully host-addressable (multi-host '
+            'shards): async native saves would gather — falling back to '
+            'synchronous sharded saves')
+        return False
+
+    def wait_for_saves(self) -> None:
+        """Barrier on the async writer (no-op in sync mode): blocks until
+        the in-flight save commits and re-raises its deferred failure —
+        the sync path's error surface, one boundary late.  ``run()``
+        passes the FINAL save through this always."""
+        if self._async is not None:
+            self._async.wait()
+
+    def close(self) -> None:
+        """Release the background writer's threads (drains first).  The
+        supervisor stays usable for sync saves afterwards; long-lived
+        embedders (the CLI, wrapper.py) should call this when done —
+        each un-closed async supervisor otherwise parks 1 + save_workers
+        idle threads until process exit."""
+        if self._async is not None:
+            self._async.close()
+            self._async = None
 
     def _prune(self) -> None:
         """Bound disk growth: keep only the ``keep_last`` newest intact
@@ -135,6 +204,14 @@ class TrainSupervisor:
         """Restore the newest intact checkpoint (quarantining corrupt
         ones) into the trainer — params, optimizer state, counters — and
         clear in-flight per-step state the fault may have poisoned."""
+        if self._async is not None:
+            # barrier on any pending save BEFORE scanning the dir: the
+            # newest checkpoint may still be mid-commit, and restoring
+            # while its writer races the scan could roll back one step
+            # further than necessary.  drain(), not wait(): a FAILED
+            # pending save is already in the log, and recovery must fall
+            # back to the previous good step, not die on the save error.
+            self._async.drain()
         tr = self.trainer
         tr.reset_transient_state()
         step = tr.load_training_state(self.ckpt_dir, restore_params=True,
@@ -244,6 +321,11 @@ class TrainSupervisor:
                         f'{restarts - 1} restarts exhausted '
                         f'({self.failure_log.summary()})',
                         step=tr.sample_counter)
+                    if self._async is not None:
+                        # don't abandon an in-flight save on the way out:
+                        # it may be the newest recovery point a wrapping
+                        # retry (or operator) restores from
+                        self._async.drain()
                     raise
                 self.state = 'RESTORING'
                 self.restore()
@@ -268,6 +350,10 @@ class TrainSupervisor:
                         f'loss(es) open', step=tr.sample_counter)
                 elif last_saved != tr.sample_counter:
                     self.save()
+                # the FINAL save always barriers: returning with the
+                # newest checkpoint still uncommitted would let a process
+                # exit lose it (deferred write errors surface here too)
+                self.wait_for_saves()
                 self.state = 'IDLE'
                 return tr.sample_counter - base
             finally:

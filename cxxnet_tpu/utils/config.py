@@ -147,3 +147,17 @@ def cfg_get(cfg: List[ConfigEntry], name: str, default: str | None = None) -> st
         if k == name and v != 'default':
             val = v
     return val
+
+
+def cfg_get_int(cfg: List[ConfigEntry], name: str, default: int) -> int:
+    """Typed :func:`cfg_get`: last-value-wins int lookup (``default``
+    literal skipped), with a clear error naming the offending key —
+    consumers like ``bench_ckpt.py`` read ``save_async=``/``save_workers=``
+    style knobs without replaying the whole config into a task object."""
+    val = cfg_get(cfg, name)
+    if val is None:
+        return default
+    try:
+        return int(val)
+    except ValueError as e:
+        raise ConfigError(f"'{name}' must be an int, got {val!r}") from e

@@ -35,3 +35,8 @@ def pytest_configure(config):
         'serve: online inference serving suite — engine/batcher/registry, '
         'CPU-only, no network, in-process client threads '
         '(tier-1: runs under -m "not slow"; select with -m serve)')
+    config.addinivalue_line(
+        'markers',
+        'async_ckpt: asynchronous checkpointing suite — snapshot/writer/'
+        'double-buffer/barrier semantics, CPU-only, deterministic '
+        '(tier-1: runs under -m "not slow"; select with -m async_ckpt)')

@@ -1,0 +1,412 @@
+"""Asynchronous checkpointing (``runtime/async_ckpt.py``): the save path
+off the step loop.
+
+The headline contracts, each proven deterministically on CPU:
+
+* an async-written checkpoint restores **bitwise-identical** to a sync
+  twin saved at the same step — and training continued from either stays
+  bitwise-identical;
+* a fault injected into the background writer (``raise_on_write`` firing
+  on the writer thread) never corrupts or removes the previous good
+  checkpoint, and surfaces through the ``FailureLog`` + the next barrier;
+* double-buffering: at most one save in flight — a second submit blocks
+  until the previous commit lands, never mid-step;
+* the supervisor resolves the NaN-streak "never save a poisoned
+  checkpoint" gate at SNAPSHOT time, so deferred writes cannot launder a
+  poisoned tree into the newest restore target;
+* supervisor restore barriers on a pending save (the mid-commit newest
+  step is restored, not skipped).
+
+Select with ``-m async_ckpt``; tier-1 (runs under ``-m "not slow"``).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.nnet import sharded_ckpt
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.runtime import async_ckpt, faults
+from cxxnet_tpu.runtime.async_ckpt import AsyncCheckpointer
+from cxxnet_tpu.runtime.supervisor import SupervisorConfig, TrainSupervisor
+from cxxnet_tpu.utils.config import (ConfigError, cfg_get_int,
+                                     parse_config_string)
+
+from test_device_normalize import assert_params_equal, snap_params
+from test_net_mnist import MLP_CONF, synth_batches
+
+pytestmark = pytest.mark.async_ckpt
+
+NO_WAIT = faults.NO_WAIT_RETRY
+ONE_SHOT = faults.RetryPolicy(max_attempts=1, base_delay=0.0, jitter=0.0,
+                              sleep=lambda _t: None)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    prev = faults.install_plan(None)
+    yield
+    faults.install_plan(prev)
+
+
+def _fresh(extra=''):
+    tr = NetTrainer(parse_config_string(MLP_CONF + extra))
+    tr.init_model()
+    return tr
+
+
+def _sup_config(**kw):
+    base = dict(batch_deadline=0.3, max_restarts=3, nan_breaker=0,
+                save_every=2, buffer_size=2, retry=NO_WAIT,
+                save_async=1, save_workers=3)
+    base.update(kw)
+    return SupervisorConfig(**base)
+
+
+# --- snapshot semantics ---------------------------------------------------
+
+def test_snapshot_survives_donating_steps():
+    """The compiled train step donates params/opt_state/grad_acc; a
+    snapshot taken at a boundary must keep its values through later
+    updates (fresh buffers, not aliases of the donated ones)."""
+    tr = _fresh()
+    batches = synth_batches(n_batches=4)
+    tr.update(batches[0])
+    snap = tr.snapshot_training_state()
+    want = [np.array(x) for x in
+            [np.asarray(v) for v in _leaves(snap['params'])]]
+    for b in batches[1:]:
+        tr.update(b)                      # donates the live buffers
+    got = [np.asarray(v) for v in _leaves(snap['params'])]
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert int(snap['counters']['sample']) == 1
+
+
+def _leaves(tree):
+    import jax
+    return jax.tree.leaves(tree)
+
+
+# --- native format --------------------------------------------------------
+
+def test_native_roundtrip_packed_and_typed(tmp_path):
+    """Small leaves pack into one blob; dtypes (f32/int64, scalar and
+    shaped) survive; the digest sidecar verifies; restore is bitwise."""
+    import jax.numpy as jnp
+    tree = {'w': jnp.arange(8, dtype=jnp.float32),
+            'big': jnp.asarray(
+                np.random.RandomState(0).randn(512, 200), jnp.float32),
+            'c': {'step': np.asarray(3, np.int64),
+                  'vec': np.arange(5, dtype=np.int64)}}
+    path = sharded_ckpt.save_tree_native(str(tmp_path / 'ck'), 1, tree,
+                                         retry=NO_WAIT)
+    assert sharded_ckpt.verify_step_dir(path) is None
+    names = set(os.listdir(path))
+    assert 'tree_manifest.json' in names and 'ckpt_digest.json' in names
+    assert 'packed_leaves.bin' in names        # small leaves coalesced
+    got, step = sharded_ckpt.restore_sharded(str(tmp_path / 'ck'), tree,
+                                             retry=NO_WAIT)
+    assert step == 1
+    for a, b in zip(_leaves(tree), _leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert np.asarray(a).dtype == np.asarray(b).dtype
+
+
+def test_native_digest_detects_truncation(tmp_path):
+    import jax.numpy as jnp
+    tree = {'big': jnp.asarray(
+        np.random.RandomState(0).randn(512, 600), jnp.float32)}
+    path = sharded_ckpt.save_tree_native(str(tmp_path / 'ck'), 1, tree,
+                                         retry=NO_WAIT)
+    victim = max((os.path.join(path, f) for f in os.listdir(path)
+                  if f not in ('ckpt_digest.json',)), key=os.path.getsize)
+    with open(victim, 'r+b') as f:
+        f.truncate(os.path.getsize(victim) // 2)
+    assert sharded_ckpt.verify_step_dir(path) is not None
+
+
+# --- the acceptance pair: bitwise twin + writer-fault isolation -----------
+
+def test_async_restore_bitwise_identical_to_sync_twin(tmp_path):
+    """Acceptance: restore from an async-written checkpoint ==(bitwise)
+    restore from the same step saved synchronously — both immediately
+    and after continuing training from each."""
+    batches = synth_batches(n_batches=6)
+    tr = _fresh()
+    for b in batches[:3]:
+        tr.update(b)
+    tr.save_training_state(str(tmp_path / 'sync'), 3)         # sync twin
+    ck = AsyncCheckpointer(workers=3)
+    ck.save_sharded_async(str(tmp_path / 'async'), 3,
+                          tr.snapshot_training_state(), retry=NO_WAIT)
+    for b in batches[3:]:
+        tr.update(b)          # write overlaps live (donating) training
+    ck.wait()
+    ck.close()
+
+    t_sync, t_async = _fresh(), _fresh()
+    assert t_sync.load_training_state(str(tmp_path / 'sync'),
+                                      restore_params=True) == 3
+    assert t_async.load_training_state(str(tmp_path / 'async'),
+                                       restore_params=True) == 3
+    assert_params_equal(snap_params(t_async), snap_params(t_sync),
+                        rtol=0, atol=0)
+    assert (t_async.epoch_counter, t_async.sample_counter) == \
+        (t_sync.epoch_counter, t_sync.sample_counter)
+    for b in batches[3:]:
+        t_sync.update(b)
+        t_async.update(b)
+    assert_params_equal(snap_params(t_async), snap_params(t_sync),
+                        rtol=0, atol=0)
+
+
+def test_writer_fault_preserves_previous_checkpoint(tmp_path):
+    """Crash-consistency: kill the background writer mid-flight
+    (``raise_on_write`` fires on the WRITER thread, retry budget 1) —
+    the failed step never appears, no temp litter survives, the fault is
+    in the failure log, the deferred error surfaces at the next barrier,
+    and the PREVIOUS checkpoint still verifies and restores bitwise."""
+    d = str(tmp_path / 'ck')
+    batches = synth_batches(n_batches=4)
+    tr = _fresh()
+    tr.update(batches[0])
+    good = snap_params(tr)
+    log = faults.FailureLog()
+    ck = AsyncCheckpointer(workers=2, failure_log=log)
+    ck.save_sharded_async(d, 1, tr.snapshot_training_state(),
+                          retry=ONE_SHOT)
+    ck.wait()                                        # good step committed
+
+    # the plan is installed after the good save, so its process-wide
+    # write counter starts here: write #1 is the step-2 attempt
+    faults.install_plan(faults.FaultPlan(raise_on_write=(1,)))
+    tr.update(batches[1])
+    ck.save_sharded_async(d, 2, tr.snapshot_training_state(),
+                          retry=ONE_SHOT)
+    with pytest.raises(faults.RetryError):
+        ck.wait()                                    # deferred error
+    assert len(log.records('async_save_failed')) == 1
+    assert sharded_ckpt.all_steps(d) == [1]          # step 2 never appears
+    litter = [n for n in os.listdir(d) if '.tmp.' in n]
+    assert litter == []
+    path1 = sharded_ckpt.step_dir(d, 1)
+    assert sharded_ckpt.verify_step_dir(path1) is None
+    t2 = _fresh()
+    assert t2.load_training_state(d, restore_params=True,
+                                  fallback=True) == 1
+    assert_params_equal(snap_params(t2), good, rtol=0, atol=0)
+    ck.close()
+
+
+def test_injected_writer_fault_rides_retry_and_recovers(tmp_path):
+    """Same injection, default-style retry budget: the writer's retry
+    absorbs the one-shot fault — the save commits, nothing raises (the
+    sync path's recovery semantics, on the background thread)."""
+    d = str(tmp_path / 'ck')
+    tr = _fresh()
+    tr.update(synth_batches(n_batches=1)[0])
+    plan = faults.FaultPlan(raise_on_write=(1,))
+    faults.install_plan(plan)
+    ck = AsyncCheckpointer(workers=2)
+    ck.save_sharded_async(d, 1, tr.snapshot_training_state(),
+                          retry=NO_WAIT)
+    ck.wait()
+    assert plan.fired() == ['raise_on_write=1']
+    assert sharded_ckpt.all_steps(d) == [1]
+    assert sharded_ckpt.verify_step_dir(sharded_ckpt.step_dir(d, 1)) is None
+    ck.close()
+
+
+def test_corrupt_shard_fires_in_writer_and_falls_back(tmp_path):
+    """``corrupt_shard`` fires AFTER the background commit (same hook as
+    the sync path): the corrupted async step must fail verification and
+    ``restore_resilient`` must quarantine it and fall back."""
+    d = str(tmp_path / 'ck')
+    tr = _fresh()
+    batches = synth_batches(n_batches=2)
+    tr.update(batches[0])
+    ck = AsyncCheckpointer(workers=2)
+    ck.save_sharded_async(d, 1, tr.snapshot_training_state(),
+                          retry=NO_WAIT)
+    ck.wait()
+    good = snap_params(tr)
+    plan = faults.FaultPlan(seed=5, corrupt_shard=(2,))
+    faults.install_plan(plan)
+    tr.update(batches[1])
+    ck.save_sharded_async(d, 2, tr.snapshot_training_state(),
+                          retry=NO_WAIT)
+    ck.wait()
+    ck.close()
+    assert plan.fired() == ['corrupt_shard=2']
+    t2 = _fresh()
+    assert t2.load_training_state(d, restore_params=True,
+                                  fallback=True) == 1
+    assert_params_equal(snap_params(t2), good, rtol=0, atol=0)
+    assert os.path.isdir(os.path.join(d, 'step_2.corrupt'))
+
+
+# --- double buffering -----------------------------------------------------
+
+def test_double_buffer_blocks_second_submit_until_commit():
+    """At most one save in flight: submit #2 returns only after #1's
+    write committed (event-gated, no timing races)."""
+    ck = AsyncCheckpointer(workers=2)
+    gate = threading.Event()
+    done = []
+
+    def slow():
+        gate.wait(5.0)
+        done.append('first')
+
+    ck.submit(slow, label='first')
+    assert ck.pending()
+    releaser = threading.Timer(0.2, gate.set)
+    releaser.start()
+    ck.submit(lambda: done.append('second'), label='second')
+    # the second submit could only return after the first committed —
+    # but 'second' may already be running on the committer, so assert
+    # ORDER, not absence
+    assert done[0] == 'first'
+    assert ck.in_flight() <= 1
+    ck.wait()
+    assert done == ['first', 'second']
+    releaser.cancel()
+    ck.close()
+
+
+def test_submit_resurfaces_previous_failure_then_recovers():
+    log = faults.FailureLog()
+    ck = AsyncCheckpointer(workers=1, failure_log=log)
+    ck.submit(lambda: (_ for _ in ()).throw(OSError('disk gone')),
+              label='bad')
+    with pytest.raises(OSError):
+        ck.submit(lambda: 'fine', label='next')
+    # the error is consumed at its barrier; the path is usable again
+    f = ck.submit(lambda: 'fine', label='next')
+    ck.wait()
+    assert f.result() == 'fine'
+    assert len(log.records('async_save_failed')) == 1
+    ck.close()
+
+
+def test_stall_write_event_parse_and_fire():
+    plan = faults.FaultPlan.parse('stall_write=1:0.05;stall_write=3')
+    assert 'stall_write=1:0.05' in plan.describe()
+    t0 = time.monotonic()
+    plan.on_checkpoint_write('p')
+    assert time.monotonic() - t0 >= 0.05
+    plan.on_checkpoint_write('p')               # un-armed write: no stall
+    assert plan.fired() == ['stall_write=1:0.05']
+
+
+# --- supervisor integration -----------------------------------------------
+
+def test_supervisor_async_recovers_write_fault_and_stall_bitwise(tmp_path):
+    """The PR-1 acceptance drill re-run with save_async=1: a checkpoint
+    write fault (now firing inside the background writer) AND a pipeline
+    stall still end bitwise-identical to an uninterrupted run."""
+    batches = synth_batches(n_batches=8)
+    t_ref = _fresh()
+    for b in batches:
+        t_ref.update(b)
+    ref = snap_params(t_ref)
+
+    plan = faults.FaultPlan(seed=1, raise_on_write=(2,),
+                            stall_batch=((5, 4.0),))
+    faults.install_plan(plan)
+    tr = _fresh()
+    log = faults.FailureLog()
+    sup = TrainSupervisor(tr, str(tmp_path / 'sup'), _sup_config(),
+                          failure_log=log)
+    n = sup.run(lambda k: iter(batches[k:]))
+    assert n == 8
+    assert sorted(plan.fired()) == ['raise_on_write=2', 'stall_batch=5:4']
+    assert len(log.records('restored')) == 1
+    assert_params_equal(snap_params(tr), ref, rtol=0, atol=0)
+    # the final save barriered: the last step is committed and verified
+    last = sharded_ckpt.all_steps(str(tmp_path / 'sup'))[0]
+    assert last == 8
+    assert sharded_ckpt.verify_step_dir(
+        sharded_ckpt.step_dir(str(tmp_path / 'sup'), 8)) is None
+
+
+def test_supervisor_restore_barriers_on_pending_save(tmp_path):
+    """A fault arriving while a save is still mid-commit: restore must
+    wait for that commit and restore THAT step — not race the writer and
+    roll back further than necessary.  The in-flight save is slowed with
+    the deterministic ``stall_write`` event; the assertion holds however
+    long the stall takes, because drain() blocks."""
+    batches = synth_batches(n_batches=8)
+    t_ref = _fresh()
+    for b in batches:
+        t_ref.update(b)
+    ref = snap_params(t_ref)
+
+    # write #1 = anchor; write #2 = the step-2 periodic save -> stalled
+    # 1.5s; nan at step 2 trips the breaker (deferred one step) while
+    # that save is still in flight
+    plan = faults.FaultPlan(stall_write=((2, 1.5),), nan_at_step=(2,))
+    faults.install_plan(plan)
+    tr = _fresh()
+    log = faults.FailureLog()
+    sup = TrainSupervisor(tr, str(tmp_path / 'sup'),
+                          _sup_config(nan_breaker=1, batch_deadline=30.0),
+                          failure_log=log)
+    n = sup.run(lambda k: iter(batches[k:]))
+    assert n == 8
+    assert 'stall_write=2:1.5' in plan.fired()
+    restored = log.records('restored')
+    assert len(restored) == 1 and restored[0].step == 2
+    assert_params_equal(snap_params(tr), ref, rtol=0, atol=0)
+
+
+def test_nan_streak_gate_resolved_at_snapshot_time(tmp_path):
+    """Deferred writes must not launder a poisoned tree: the NaN-streak
+    save gate is resolved at SNAPSHOT time, so mid-streak boundaries
+    produce no checkpoint at all — even after every async write lands."""
+    batches = synth_batches(n_batches=6)
+    faults.install_plan(faults.FaultPlan(nan_at_step=(2, 3)))
+    tr = _fresh('nan_breaker = 3\n')     # armed, but streak peaks at 2
+    sup = TrainSupervisor(tr, str(tmp_path / 'sup'),
+                          _sup_config(save_every=1, nan_breaker=0,
+                                      keep_last=0))
+    n = sup.run(lambda k: iter(batches[k:]))
+    assert n == 6
+    sup.wait_for_saves()
+    steps = set(sharded_ckpt.all_steps(str(tmp_path / 'sup')))
+    assert not {3, 4} & steps            # mid-streak boundaries skipped
+    assert {1, 2, 5, 6} <= steps         # finite-streak saves landed
+
+
+def test_supervisor_async_prunes_to_keep_last(tmp_path):
+    batches = synth_batches(n_batches=8)
+    tr = _fresh()
+    sup = TrainSupervisor(tr, str(tmp_path / 'sup'),
+                          _sup_config(save_every=1, keep_last=2))
+    n = sup.run(lambda k: iter(batches[k:]))
+    assert n == 8
+    sup.wait_for_saves()
+    assert sharded_ckpt.all_steps(str(tmp_path / 'sup')) == [8, 7]
+
+
+# --- CLI / config surface -------------------------------------------------
+
+def test_cli_save_async_knobs_parse():
+    from cxxnet_tpu.main import LearnTask
+    lt = LearnTask()
+    lt.set_param('save_async', '1')
+    lt.set_param('save_workers', '6')
+    assert (lt.save_async, lt.save_workers) == (1, 6)
+
+
+def test_cfg_get_int_typed_lookup():
+    cfg = [('steps', '5'), ('steps', '9'), ('w', 'default')]
+    assert cfg_get_int(cfg, 'steps', 1) == 9     # last value wins
+    assert cfg_get_int(cfg, 'w', 7) == 7         # 'default' skipped
+    assert cfg_get_int(cfg, 'absent', 3) == 3
+    with pytest.raises(ConfigError):
+        cfg_get_int([('steps', 'notanint')], 'steps', 1)

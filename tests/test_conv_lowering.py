@@ -86,6 +86,9 @@ class TestSpaceToDepth:
         # (in_y, in_x, cin, cout, k, stride, pad)
         (23, 23, 3, 8, 11, 4, 0),    # conv1 class: k not divisible by s
         (12, 12, 3, 8, 5, 2, 2),     # pad aligned to stride
+        (12, 12, 3, 8, 5, 2, 1),     # pad % stride != 0: legal — the
+                                     # _lowering gate's alignment clause
+                                     # is policy, not correctness
         (13, 17, 2, 4, 4, 2, 0),     # rectangular, k divisible by s
         (9, 9, 3, 4, 3, 3, 3),       # k == s, pad == s
     ])

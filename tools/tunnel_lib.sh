@@ -42,15 +42,19 @@ wait_tunnel() {
 
 # wait_for_runners <script-basename>... — block until none of the named
 # runner stages is alive.  Two pgreps, not one with \| (a \| inside a
-# pgrep -f pattern is a literal pipe in its ERE and never matches);
-# '^bash tools/' anchors past wrapper shells whose cmdline merely
-# mentions the script.
+# pgrep -f pattern is a literal pipe in its ERE and never matches).
+# The pattern matches the script PATH SUFFIX ('bash [^ ]*tools/<s>.sh'),
+# not an anchored '^bash tools/' — runners launched by absolute path
+# ('bash /root/repo/tools/foo.sh', cron, another cwd) must count as
+# alive too.  [^ ]* (not .*) keeps the match inside the FIRST argument
+# after 'bash ', so a wrapper whose cmdline merely mentions the script
+# later ('bash tools/notify.sh tools/foo.sh') still does not count.
 wait_for_runners() {
     local s alive=1
     while [ "$alive" -eq 1 ]; do
         alive=0
         for s in "$@"; do
-            pgrep -f "^bash tools/$s.sh" > /dev/null && alive=1
+            pgrep -f "bash [^ ]*tools/$s\.sh" > /dev/null && alive=1
         done
         [ "$alive" -eq 1 ] && sleep 120
     done
