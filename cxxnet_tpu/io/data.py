@@ -115,6 +115,27 @@ class IIterator:
         base = getattr(self, 'base', None)
         return base.get_norm_spec() if base is not None else None
 
+    def pipeline_stats(self):
+        """The chain's ``utils.metric.StatSet`` of per-stage pipeline
+        counters (decode/augment/collate ms, pool occupancy, buffer
+        stalls), or None when no stage is instrumented (stats turn on
+        with ``nworker``, doc/io.md).  Wrappers delegate."""
+        base = getattr(self, 'base', None)
+        return base.pipeline_stats() if base is not None else None
+
+    def iter_thunks(self):
+        """One epoch pass as zero-arg callables, each materializing the
+        next ``DataInst`` — the submission stream of the parallel
+        decode/augment pool (``utils/parallel_pool.py``).  Sources whose
+        per-instance work is heavy (JPEG decode, ``iter_imbin``)
+        override this to DEFER that work into the thunk so pool workers
+        carry it; the default wraps ``__iter__`` (work already done on
+        the calling thread, the pool still parallelizes augmentation).
+        Thunk order must equal ``__iter__`` order — the pool's
+        bitwise-identity contract hangs on it."""
+        for inst in self:
+            yield (lambda inst=inst: inst)
+
     def is_replay_stable(self) -> bool:
         """True when every ``__iter__`` replays the SAME item sequence —
         the contract supervised fault recovery relies on to re-wind to
@@ -137,7 +158,14 @@ class ThreadBufferIterator(IIterator):
     producer that misses the deadline raises
     ``runtime.faults.PipelineStallError`` instead of blocking the trainer
     forever (0 disables).  The buffer is batch-scoped for deterministic
-    stall injection (doc/fault_tolerance.md)."""
+    stall injection (doc/fault_tolerance.md).
+
+    ``nworker = N`` (config) is accepted here — the natural place to
+    size the pipeline — and cascades down the chain to the augment
+    stage, which fans per-instance decode+augment across N pool threads
+    (``utils/parallel_pool.py``); output stays bitwise identical for
+    any N.  When the chain is instrumented (nworker set), this stage's
+    producer/consumer stalls land on the same StatSet."""
 
     def __init__(self, base: IIterator, buffer_size: int = 2):
         self.base = base
@@ -179,6 +207,17 @@ class ThreadBufferIterator(IIterator):
         return self._buf.close(timeout)
 
     def __iter__(self):
+        # late-bound: the chain's StatSet exists only after set_param
+        # cascaded an ``nworker`` key to the augment stage
+        stats = self.base.pipeline_stats()
+        self._buf.stats = stats
+        if stats is not None and self._deadline is not None \
+                and self._first_deadline is None:
+            # pooled chains (nworker): the first batch also fills the
+            # pool's in-flight window (nworker*4 instances), so the
+            # default epoch-setup grace doubles — same rule as the
+            # supervisor's watchdog (doc/fault_tolerance.md)
+            self._buf._first_deadline = self._deadline * 10
         return iter(self._buf)
 
 

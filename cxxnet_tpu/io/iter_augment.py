@@ -133,8 +133,23 @@ class ImageAugmenter:
 
 
 class AugmentIterator(IIterator):
+    """Serial by default (one sequential RNG, reference-exact stream).
+
+    ``nworker = N`` switches to the pooled path: per-instance decode
+    (``base.iter_thunks``) + augmentation fan across an order-preserving
+    worker pool (``utils/parallel_pool.py``).  Per-instance RNG is then
+    seeded from the **epoch-absolute instance index** — NOT drawn from a
+    shared sequential stream — so the output is bitwise identical for
+    any worker count (including N=1), replay-stability is preserved,
+    and a pooled run is reproducible against another pooled run of any
+    width.  (The pooled stream therefore differs from the legacy serial
+    stream: pick one mode per experiment.)  Per-stage timings land on
+    ``pipeline_stats()``."""
+
     def __init__(self, base: IIterator):
         self.base = base
+        self.nworker = 0            # 0 = legacy serial path
+        self._stats = None
         self.shape = (0, 0, 0)      # (c, y, x)
         self.rand_crop = 0
         self.rand_mirror = 0
@@ -156,6 +171,11 @@ class AugmentIterator(IIterator):
     def set_param(self, name, val):
         self.base.set_param(name, val)
         self.aug.set_param(name, val)
+        if name == 'nworker':
+            self.nworker = max(0, int(val))
+            if self.nworker and self._stats is None:
+                from ..utils.metric import StatSet
+                self._stats = StatSet()
         if name == 'input_shape':
             self.shape = tuple(int(t) for t in val.split(','))
         if name == 'seed_data':
@@ -198,34 +218,40 @@ class AugmentIterator(IIterator):
             else:
                 self._create_mean_img()
 
+    def _process_raw(self, data, rng):
+        """Affine + crop + mirror for ONE instance array — the stage-1
+        body of ``_raw_iter``, factored out so the pooled path can run
+        it per-worker with a per-instance RNG.  Draw order is exactly
+        the serial path's (process → crop randints → mirror rand)."""
+        c, ty, tx = self.shape
+        data = self.aug.process(data, rng, ty, tx)
+        if ty == 1 and c == 1:
+            return data                   # flat input: no crop
+        _, h, w = data.shape
+        assert h >= ty and w >= tx, \
+            'Data size must be bigger than the input size to net.'
+        yy, xx = h - ty, w - tx
+        if self.rand_crop != 0 and (yy != 0 or xx != 0):
+            yy = rng.randint(0, yy + 1)
+            xx = rng.randint(0, xx + 1)
+        else:
+            yy //= 2
+            xx //= 2
+        if h != ty and self.crop_y_start != -1:
+            yy = self.crop_y_start
+        if w != tx and self.crop_x_start != -1:
+            xx = self.crop_x_start
+        crop = data[:, yy:yy + ty, xx:xx + tx]
+        if (self.rand_mirror != 0 and rng.rand() < 0.5) or self.mirror == 1:
+            crop = crop[:, :, ::-1]
+        return crop
+
     def _raw_iter(self):
         """Instances after affine + crop + mirror, before mean/scale —
         used for mean-image computation."""
         rng = np.random.RandomState(self.seed_data)
-        c, ty, tx = self.shape
         for inst in self.base:
-            data = self.aug.process(inst.data, rng, ty, tx)
-            if ty == 1 and c == 1:
-                yield inst, data          # flat input: no crop
-                continue
-            _, h, w = data.shape
-            assert h >= ty and w >= tx, \
-                'Data size must be bigger than the input size to net.'
-            yy, xx = h - ty, w - tx
-            if self.rand_crop != 0 and (yy != 0 or xx != 0):
-                yy = rng.randint(0, yy + 1)
-                xx = rng.randint(0, xx + 1)
-            else:
-                yy //= 2
-                xx //= 2
-            if h != ty and self.crop_y_start != -1:
-                yy = self.crop_y_start
-            if w != tx and self.crop_x_start != -1:
-                xx = self.crop_x_start
-            crop = data[:, yy:yy + ty, xx:xx + tx]
-            if (self.rand_mirror != 0 and rng.rand() < 0.5) or self.mirror == 1:
-                crop = crop[:, :, ::-1]
-            yield inst, crop
+            yield inst, self._process_raw(inst.data, rng)
 
     def _device_norm_active(self) -> bool:
         """uint8-through mode: crop/mirror on host, (x-mean)*scale deferred
@@ -258,43 +284,87 @@ class AugmentIterator(IIterator):
         return NormSpec(mean_img=mean_img, mean_vals=self.mean_vals,
                         scale=self.scale)
 
+    def _finish_host(self, inst, crop, rng):
+        """Host-normalize ONE cropped instance (contrast/illumination/
+        mean/scale) — the stage-2 body of the serial ``__iter__``, same
+        draw order (contrast rand, then illumination rand)."""
+        c, ty, tx = self.shape
+        if ty == 1 and c == 1:
+            return DataInst(inst.index,
+                            np.asarray(crop, np.float32) * self.scale,
+                            inst.label, inst.extra_data)
+        contrast = 1.0
+        illum = 0.0
+        if self.max_random_contrast > 0:
+            contrast = rng.rand() * self.max_random_contrast * 2 \
+                - self.max_random_contrast + 1
+        if self.max_random_illumination > 0:
+            illum = rng.rand() * self.max_random_illumination * 2 \
+                - self.max_random_illumination
+        out = crop.astype(np.float32)
+        if self.mean_vals is not None:
+            out = out - self.mean_vals[:, None, None]
+        elif self._meanimg is not None:
+            if self._meanimg.shape == out.shape:
+                out = out - self._meanimg
+        out = (out * contrast + illum) * self.scale
+        return DataInst(inst.index, out, inst.label, inst.extra_data)
+
+    def pipeline_stats(self):
+        return self._stats
+
+    def _inst_rng(self, i: int, salt: int) -> np.random.RandomState:
+        """Pooled-path RNG for epoch-absolute instance ``i``: a fresh
+        MT19937 seeded from (seed_data, salt, i) only, so any worker can
+        compute instance i's draws with no shared stream — the bitwise-
+        identical-for-any-worker-count property.  ``salt`` separates the
+        affine/crop/mirror stream (0) from contrast/illumination (91),
+        mirroring the serial path's two seeds."""
+        return np.random.RandomState(
+            (self.seed_data + salt + (i + 1) * 2654435761) % (2 ** 31))
+
+    def _iter_pooled(self):
+        """nworker path: decode thunks from the source fan across an
+        order-preserving pool together with this stage's augmentation;
+        per-stage wall times flow to ``pipeline_stats()``."""
+        from ..utils.parallel_pool import OrderedWorkerPool
+        dev_norm = self._device_norm_active()
+        stats = self._stats
+        pool = OrderedWorkerPool(self.nworker, stats=stats, name='pool')
+
+        def job(task):
+            i, thunk = task
+            t0 = time.perf_counter()
+            inst = thunk()                      # source decode (deferred)
+            t1 = time.perf_counter()
+            crop = self._process_raw(inst.data, self._inst_rng(i, 0))
+            if dev_norm:
+                out = DataInst(inst.index, np.ascontiguousarray(crop),
+                               inst.label, inst.extra_data)
+            else:
+                out = self._finish_host(inst, crop, self._inst_rng(i, 91))
+            if stats is not None:
+                t2 = time.perf_counter()
+                stats.observe('decode_ms', (t1 - t0) * 1e3)
+                stats.observe('augment_ms', (t2 - t1) * 1e3)
+            return out
+
+        yield from pool.imap(job, enumerate(self.base.iter_thunks()))
+
     def __iter__(self):
+        if self.nworker:
+            yield from self._iter_pooled()
+            return
         if self._device_norm_active():
             # raw crops go to the device untouched; normalization happens
             # inside the jitted step (trainer._apply_input_norm)
-            yield from self._raw_iter_insts()
+            for inst, crop in self._raw_iter():
+                yield DataInst(inst.index, np.ascontiguousarray(crop),
+                               inst.label, inst.extra_data)
             return
         rng = np.random.RandomState(self.seed_data + 91)
-        c, ty, tx = self.shape
         for inst, crop in self._raw_iter():
-            if ty == 1 and c == 1:
-                yield DataInst(inst.index,
-                               np.asarray(crop, np.float32) * self.scale,
-                               inst.label, inst.extra_data)
-                continue
-            contrast = 1.0
-            illum = 0.0
-            if self.max_random_contrast > 0:
-                contrast = rng.rand() * self.max_random_contrast * 2 \
-                    - self.max_random_contrast + 1
-            if self.max_random_illumination > 0:
-                illum = rng.rand() * self.max_random_illumination * 2 \
-                    - self.max_random_illumination
-            out = crop.astype(np.float32)
-            if self.mean_vals is not None:
-                out = out - self.mean_vals[:, None, None]
-            elif self._meanimg is not None:
-                if self._meanimg.shape == out.shape:
-                    out = out - self._meanimg
-            out = (out * contrast + illum) * self.scale
-            yield DataInst(inst.index, out, inst.label, inst.extra_data)
-
-    def _raw_iter_insts(self):
-        """Device-normalize path: instances with the raw (typically uint8)
-        crop; rand-crop/mirror RNG sequence identical to ``_raw_iter``."""
-        for inst, crop in self._raw_iter():
-            yield DataInst(inst.index, np.ascontiguousarray(crop),
-                           inst.label, inst.extra_data)
+            yield self._finish_host(inst, crop, rng)
 
     def _create_mean_img(self):
         if self.silent == 0:

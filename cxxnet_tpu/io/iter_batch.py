@@ -10,6 +10,8 @@ maximum pipeline throughput (used by the ``test_io`` harness).
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from .data import DataBatch, IIterator
@@ -24,6 +26,7 @@ class BatchAdaptIterator(IIterator):
         self.label_width = 1
         self._cached: DataBatch | None = None
         self._norm_spec = None
+        self._stats = None
 
     def set_param(self, name, val):
         if name == 'batch_size':
@@ -39,8 +42,18 @@ class BatchAdaptIterator(IIterator):
     def init(self):
         self.base.init()
         self._norm_spec = self.base.get_norm_spec()
+        self._stats = self.base.pipeline_stats()
 
     def _make_batch(self, insts):
+        if self._stats is not None:
+            t0 = time.perf_counter()
+            out = self._collate(insts)
+            self._stats.observe('collate_ms',
+                                (time.perf_counter() - t0) * 1e3)
+            return out
+        return self._collate(insts)
+
+    def _collate(self, insts):
         data = np.stack([i.data for i in insts])
         if not (data.dtype == np.uint8 and self._norm_spec is not None):
             # reference host contract: float32 batches

@@ -252,10 +252,23 @@ class ImageBinIterator(IIterator):
                     base += len(blobs)
 
     def __iter__(self):
+        # defined over iter_thunks so the serial and pooled paths can
+        # never disagree on instance order (the pool's bitwise-identity
+        # contract, io/data.py)
+        for thunk in self.iter_thunks():
+            yield thunk()
+
+    def iter_thunks(self):
+        """Parallel-pool submission stream (``io/data.py``) — and the
+        single definition of this source's instance order (``__iter__``
+        derives from it).  Each thunk carries the ENCODED blob and
+        defers the JPEG decode onto whichever thread runs it — the
+        stage the reference pinned to one thread
+        (``iter_thread_imbin-inl.hpp``)."""
         rng_page, _ = self._epoch_rngs()
         for blobs, lines in self._epoch_pages(rng_page):
             for blob, line in zip(blobs, lines):
-                yield self._make_inst(blob, line)
+                yield (lambda b=blob, li=line: self._make_inst(b, li))
 
 
 class ImageBinXIterator(ImageBinIterator):
@@ -320,3 +333,18 @@ class ImageBinXIterator(ImageBinIterator):
                     yield pending.popleft().result()
 
         return iter(ThreadBuffer(insts, self.INST_BUFFER))
+
+    def iter_thunks(self):
+        """imgbinx submission stream: page reads stay behind their own
+        ThreadBuffer (IO overlaps the pool) and ``shuffle=1`` keeps the
+        within-page instance shuffle; the decode itself rides the thunk
+        — the chain-level ``nworker`` pool replaces this class's private
+        decode pool, never stacks on it."""
+        rng_page, rng_inst = self._epoch_rngs()
+        for blobs, lines in ThreadBuffer(
+                lambda: self._epoch_pages(rng_page), self.PAGE_BUFFER):
+            inst_order = (rng_inst.permutation(len(blobs))
+                          if self.shuffle else range(len(blobs)))
+            for k in inst_order:
+                yield (lambda b=blobs[k], li=lines[k]:
+                       self._make_inst(b, li))

@@ -59,6 +59,11 @@ class LearnTask:
                                        # final save always barriers
         self.save_workers = 2          # save_workers per-save write threads
         self._async_ckpt = None        # lazy AsyncCheckpointer
+        # scanned hot loop: K staged batches per device dispatch
+        # (doc/trainer.md; steps_per_dispatch=1 = per-step reference path)
+        self.steps_per_dispatch = 1
+        self._scan_fns = {}            # K -> compiled multi-step fn
+        self._scan_note_printed = False
         self.extract_node_name = ''
         self.name_pred = 'pred.txt'
         self.output_format = 1
@@ -96,6 +101,8 @@ class LearnTask:
             'train.keep_last': ('keep_last', int),
             'save_async': ('save_async', int),
             'save_workers': ('save_workers', int),
+            'steps_per_dispatch': ('steps_per_dispatch', int),
+            'train.steps_per_dispatch': ('steps_per_dispatch', int),
             'serve.buckets': ('serve_buckets', str),
             'serve.max_queue': ('serve_max_queue', int),
             'serve.max_wait': ('serve_max_wait', float),
@@ -385,7 +392,11 @@ class LearnTask:
             save_every=self.save_every,
             keep_last=self.keep_last,
             save_async=self.save_async,
-            save_workers=self.save_workers)
+            save_workers=self.save_workers,
+            # pooled chains (nworker) report the watchdog's stalls on
+            # the chain StatSet and get the doubled first-batch grace
+            pipeline_stats=(None if self._sup_iter is None
+                            else self._sup_iter.pipeline_stats()))
         return TrainSupervisor(
             self.net_trainer,
             os.path.join(self.name_model_dir, 'supervised_state'), cfg)
@@ -408,10 +419,7 @@ class LearnTask:
         def before_step(i):
             # same progress/trace cadence as the unsupervised loop
             tracer.before_update(batch_counter + i)
-            if (i + 1) % self.print_step == 0 and not self.silent:
-                elapsed = int(time.time() - start)
-                print(f'round {self.start_counter - 1:8d}:'
-                      f'[{i + 1:8d}] {elapsed} sec elapsed', flush=True)
+            self._progress(i + 1, start)
 
         return sup.run(factory, before_step=before_step)
 
@@ -425,46 +433,143 @@ class LearnTask:
             if sup is not None:
                 sup.close()
 
+    def _progress(self, sample_counter: int, start: float) -> None:
+        if sample_counter % self.print_step == 0 and not self.silent:
+            elapsed = int(time.time() - start)
+            print(f'round {self.start_counter - 1:8d}:'
+                  f'[{sample_counter:8d}] {elapsed} sec elapsed', flush=True)
+
+    def _resolve_scan_k(self, sup, tracer) -> int:
+        """Effective ``steps_per_dispatch`` for this run — the scanned
+        hot loop (one ``lax.scan`` dispatch per K batches, zero per-step
+        link RTT) engages only when its semantics are exactly the
+        per-step path's; otherwise fall back to K=1 and say why once
+        (the fallback matrix, doc/trainer.md)."""
+        k = self.steps_per_dispatch
+        if k <= 1 or self.test_io:
+            return 1
+        tr = self.net_trainer
+        why = None
+        if sup is not None:
+            why = 'train.supervise=1 (recovery re-winds per batch)'
+        elif tracer.enabled:
+            # a batch-windowed trace needs per-step dispatch boundaries
+            # — inside a scanned window there is nothing to start/stop
+            # the profiler between
+            why = 'profile_dir set (trace window brackets per-step ' \
+                  'dispatches)'
+        elif tr.update_period != 1:
+            why = f'update_period={tr.update_period} (scan applies the ' \
+                  'optimizer every step)'
+        elif tr.eval_train and len(tr.train_metric):
+            why = 'eval_train=1 with train metrics (per-step metric ' \
+                  'readback); set eval_train=0 to scan'
+        if why is not None:
+            if not self.silent and not self._scan_note_printed:
+                print(f'steps_per_dispatch={k} falls back to per-step: '
+                      f'{why}', flush=True)
+                self._scan_note_printed = True
+            return 1
+        return k
+
+    def _scan_fn(self, k: int):
+        if k not in self._scan_fns:
+            self._scan_fns[k] = self.net_trainer.compile_multi_step(k)
+        return self._scan_fns[k]
+
+    def _plain_round(self, tracer, batch_counter, start):
+        """Per-step dispatch with the one-batch host->device lookahead:
+        batch i+1's transfers are enqueued (stage_batch, async) before
+        batch i's step is dispatched, so the host link rides behind
+        device compute — the H2D half of the reference's prefetch
+        pipeline (iter_thread_buffer covers the disk->host half)."""
+        sample_counter = updates = 0
+        pending = None
+        for batch in self.itr_train:
+            if self.test_io == 0:
+                staged = self.net_trainer.stage_batch(batch)
+                if pending is not None:
+                    tracer.before_update(batch_counter + updates)
+                    self.net_trainer.update_staged(pending)
+                    updates += 1
+                pending = staged
+            sample_counter += 1
+            self._progress(sample_counter, start)
+        if pending is not None:
+            tracer.before_update(batch_counter + updates)
+            self.net_trainer.update_staged(pending)
+            updates += 1
+        return updates, sample_counter
+
+    def _scanned_round(self, k, tracer, batch_counter, start):
+        """Scanned hot loop: accumulate K staged batches (each an async
+        H2D enqueue — the lookahead now runs K batches deep) and drive
+        them through ONE ``compile_multi_step`` dispatch.  A short tail
+        window finishes on the per-step path, which is bitwise-identical
+        (trainer.update_staged_window), so epoch length need not divide
+        K.  An ``attachtxt`` chain (extra_data) is detected on the first
+        batch and demotes the whole round to per-step."""
+        sample_counter = updates = 0
+        window = []
+        demoted = False
+
+        def step_one(st):
+            nonlocal updates
+            tracer.before_update(batch_counter + updates)
+            self.net_trainer.update_staged(st)
+            updates += 1
+
+        for batch in self.itr_train:
+            staged = self.net_trainer.stage_batch(batch)
+            if not demoted and staged[2]:
+                # extra_data (attachtxt): the scan body can't carry it —
+                # demote mid-epoch WITHOUT re-winding the iterator
+                demoted = True
+                self.steps_per_dispatch = 1  # future rounds resolve to 1
+                if not self.silent and not self._scan_note_printed:
+                    print(f'steps_per_dispatch={k} falls back to per-step: '
+                          'iterator attaches extra_data', flush=True)
+                self._scan_note_printed = True
+                for st in window:
+                    step_one(st)
+                window = []
+            if demoted:
+                step_one(staged)
+            else:
+                window.append(staged)
+                if len(window) == k:
+                    # no tracer hook here: profile_dir demotes to
+                    # per-step in _resolve_scan_k (a trace window can't
+                    # bracket steps inside one dispatch)
+                    self.net_trainer.update_staged_window(
+                        self._scan_fn(k), window)
+                    updates += k
+                    window = []
+            sample_counter += 1
+            self._progress(sample_counter, start)
+        for st in window:            # tail: per-step, bitwise-identical
+            step_one(st)
+        return updates, sample_counter
+
     def _run_rounds(self, sup, tracer, batch_counter, start) -> None:
         cc = self.max_round
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
             if not self.silent:
                 print(f'update round {self.start_counter - 1}', flush=True)
-            sample_counter = 0
             self.net_trainer.start_round(self.start_counter)
+            scan_k = self._resolve_scan_k(sup, tracer)
             if sup is not None:
                 n = self._supervised_round(sup, tracer, batch_counter,
                                            start)
                 batch_counter += n
-                sample_counter = n
-                pending = None
+            elif scan_k > 1:
+                n, _ = self._scanned_round(scan_k, tracer, batch_counter,
+                                           start)
+                batch_counter += n
             else:
-                # one-batch host->device lookahead: batch i+1's transfers
-                # are enqueued (stage_batch, async) before batch i's step
-                # is dispatched, so the host link rides behind device
-                # compute — the H2D half of the reference's prefetch
-                # pipeline (iter_thread_buffer covers the disk->host half)
-                pending = None
-                for batch in self.itr_train:
-                    if self.test_io == 0:
-                        staged = self.net_trainer.stage_batch(batch)
-                        if pending is not None:
-                            tracer.before_update(batch_counter)
-                            self.net_trainer.update_staged(pending)
-                            batch_counter += 1
-                        pending = staged
-                    sample_counter += 1
-                    if sample_counter % self.print_step == 0 \
-                            and not self.silent:
-                        elapsed = int(time.time() - start)
-                        print(f'round {self.start_counter - 1:8d}:'
-                              f'[{sample_counter:8d}] {elapsed} sec elapsed',
-                              flush=True)
-            if pending is not None:
-                tracer.before_update(batch_counter)
-                self.net_trainer.update_staged(pending)
-                batch_counter += 1
+                n, _ = self._plain_round(tracer, batch_counter, start)
+                batch_counter += n
             # settle the one-step-deferred divergence gate (no-op unless
             # nan_action=halt / nan_breaker armed the check)
             self.net_trainer.flush_divergence_check()
@@ -474,11 +579,27 @@ class LearnTask:
                     sys.stderr.write(self.net_trainer.evaluate(None, 'train'))
                 for it, name in zip(self.itr_evals, self.eval_names):
                     sys.stderr.write(self.net_trainer.evaluate(it, name))
+                self._write_io_stats()
                 sys.stderr.write('\n')
                 sys.stderr.flush()
             self._save_model()
         if not self.silent:
             print(f'\nupdating end, {int(time.time() - start)} sec in all')
+
+    def _write_io_stats(self) -> None:
+        """Pipeline observability: when the train chain is instrumented
+        (``nworker`` set, doc/io.md) its per-stage stats join the round's
+        eval line in the same ``\\tio-key:value`` format, then reset so
+        each round reports its own pass."""
+        if self.itr_train is None:
+            return
+        stats = self.itr_train.pipeline_stats()
+        if stats is None:
+            return
+        line = stats.print('io')
+        if line:
+            sys.stderr.write(line)
+        stats.clear()
 
     def task_predict(self) -> None:
         assert self.itr_pred is not None, 'must specify a pred iterator'

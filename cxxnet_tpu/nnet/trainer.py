@@ -111,6 +111,7 @@ class NetTrainer:
         self._forward_fn = None
         self._pending_train_eval = None
         self._ones_mask_cache: Dict[int, object] = {}
+        self._stack_jit = None     # device-side batch stacker (scanned loop)
         self._norm_dev = {}        # per-spec staged (mean, scale) consts
         if cfg:
             for name, val in cfg:
@@ -358,6 +359,7 @@ class NetTrainer:
 
         self._train_step_fn = train_step
         self._forward_fn = forward_step
+        self._stack_jit = None     # mesh may have changed: rebuild lazily
 
     def compile_multi_step(self, n_steps: int):
         """Jitted ``n_steps``-training-step function: ONE dispatch runs the
@@ -376,12 +378,21 @@ class NetTrainer:
 
         Requires ``update_period == 1`` (each scan step applies the
         optimizer).  Returns ``fn(params, opt_state, data_stack,
-        label_stack, rng0, epoch0, mask_stack, rnd) -> (params, opt_state,
-        last_loss)`` with the compiled step count attached as
+        label_stack, base_rng, epoch0, sc0, mask_stack, rnd) -> (params,
+        opt_state, losses)`` with the compiled step count attached as
         ``fn.n_steps``; drive it through :meth:`update_n_on_device` to keep
         trainer counters coherent (round-dependent layers and tail-batch
         masks follow the same semantics as the per-step :meth:`update`
         path: ``rnd`` is traced, ``mask_stack`` rides the batch stack).
+
+        Step ``t`` derives its dropout key as ``fold_in(base_rng,
+        1 + (sc0 + t) * 131 + rnd)`` — the EXACT key the per-step
+        :meth:`update_staged` path computes at sample counter ``sc0+t``,
+        so a K-step dispatch is bitwise-identical to K per-step
+        dispatches even for stochastic nets (the production
+        ``steps_per_dispatch`` contract, doc/trainer.md); ``losses`` is
+        the full ``(n_steps,)`` per-step loss vector so the divergence
+        gate sees every step, not just the last.
         """
         if self.update_period != 1:
             raise ValueError('compile_multi_step requires update_period=1')
@@ -391,8 +402,8 @@ class NetTrainer:
         nan_skip = self.nan_action == 'skip'
 
         @partial(jax.jit, donate_argnums=(0, 1))
-        def multi_step(params, opt_state, data_stack, label_stack, rng0,
-                       epoch0, mask_stack, rnd, norm=()):
+        def multi_step(params, opt_state, data_stack, label_stack, base_rng,
+                       epoch0, sc0, mask_stack, rnd, norm=()):
             nstack = data_stack.shape[0]
 
             def body(carry, t):
@@ -403,7 +414,7 @@ class NetTrainer:
                     label_stack, t % nstack, keepdims=False)
                 mask = jax.lax.dynamic_index_in_dim(
                     mask_stack, t % nstack, keepdims=False)
-                rng = jax.random.fold_in(rng0, t)
+                rng = jax.random.fold_in(base_rng, 1 + (sc0 + t) * 131 + rnd)
                 (loss, _), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params, data, label, (), mask,
                                            rng, rnd, norm)
@@ -419,12 +430,12 @@ class NetTrainer:
 
             (params, opt_state, _), losses = jax.lax.scan(
                 body, (params, opt_state, epoch0), jnp.arange(n_steps))
-            return params, opt_state, losses[-1]
+            return params, opt_state, losses
 
-        def multi_fn(params, opt_state, data_stack, label_stack, rng0,
-                     epoch0, mask_stack, rnd, norm=()):
+        def multi_fn(params, opt_state, data_stack, label_stack, base_rng,
+                     epoch0, sc0, mask_stack, rnd, norm=()):
             return multi_step(params, opt_state, data_stack, label_stack,
-                              rng0, epoch0, mask_stack, rnd, norm)
+                              base_rng, epoch0, sc0, mask_stack, rnd, norm)
 
         multi_fn.n_steps = n_steps
         return multi_fn
@@ -506,14 +517,30 @@ class NetTrainer:
                 f'{compiled} compiled into multi_fn')
         if mask_stack is None:
             mask_stack = self._ones_mask_stack(data_stack.shape[:2])
-        rng0 = jax.random.fold_in(self._rng, 1 + self.sample_counter * 131 +
-                                  self.round)
-        self.params, self.opt_state, loss = multi_fn(
-            self.params, self.opt_state, data_stack, label_stack, rng0,
-            self.epoch_counter, mask_stack, self.round, norm)
+        sc0 = self.sample_counter
+        self.params, self.opt_state, losses = multi_fn(
+            self.params, self.opt_state, data_stack, label_stack, self._rng,
+            self.epoch_counter, sc0, mask_stack, self.round, norm)
         self.epoch_counter += n_steps
         self.sample_counter += n_steps
-        return loss
+        self._gate_losses(losses, sc0)
+        return losses[-1]
+
+    def _gate_losses(self, losses, sc0: int) -> None:
+        """Divergence gate over a scanned dispatch's per-step losses.
+        Only when something can act on them (halt / breaker / NaN
+        injection — same arming rule as ``_observe_loss``) does this
+        fetch the loss vector (ONE host sync per K-step dispatch, the
+        scanned path's analogue of the per-step deferred check); every
+        step feeds ``_check_loss`` so ``nan_at_step``-style events and
+        consecutive-NaN streaks land on the exact step index."""
+        from ..runtime import faults
+        plan = faults.active_plan()
+        inject = plan is not None and plan.has_nan_events()
+        if self.nan_action != 'halt' and not self.nan_breaker and not inject:
+            return
+        for t, loss in enumerate(np.asarray(losses)):
+            self._check_loss(sc0 + t, loss)
 
     def _ones_mask_stack(self, shape):
         """Cached on-device all-ones (nstack, batch) loss-mask stack for
@@ -526,6 +553,50 @@ class NetTrainer:
                 np.ones(shape, np.float32), cast=False)
             self._ones_mask_cache[key] = cached
         return cached
+
+    def _device_stack(self, arrays):
+        """Stack already-staged per-batch device arrays (batch axis
+        sharded over ``data``) into the (nstack, batch, ...) layout
+        :meth:`compile_multi_step` scans — a device-side op, so the
+        per-batch async H2D transfers :meth:`stage_batch` enqueued are
+        never re-shipped over the host link."""
+        if self._stack_jit is None:
+            sh = NamedSharding(self._mesh, P(None, 'data'))
+            self._stack_jit = jax.jit(lambda *xs: jnp.stack(xs),
+                                      out_shardings=sh)
+        return self._stack_jit(*arrays)
+
+    def update_staged_window(self, multi_fn, staged_list):
+        """Drive one :meth:`compile_multi_step` dispatch over a window of
+        K batches staged by :meth:`stage_batch` — the production scanned
+        hot loop (``steps_per_dispatch``, doc/trainer.md).  The staged
+        handles' async H2D transfers overlap earlier dispatches; here
+        they are stacked on device and the whole window runs as ONE
+        program: zero per-step dispatch/link RTT.  Tail-batch loss masks
+        ride the stack, so ``round_batch=0`` pad rows stay out of the
+        gradients exactly as on the per-step path.  Counters, LR
+        schedule, dropout keys and the divergence gate all match K
+        per-step calls bitwise.  Returns the window's last loss (device
+        scalar)."""
+        if self.inference_only:
+            raise RuntimeError(
+                'trainer was built inference_only=1 (no optimizer state); '
+                'it can predict/evaluate but not train')
+        if len(staged_list) != multi_fn.n_steps:
+            raise ValueError(
+                f'window of {len(staged_list)} batches does not match the '
+                f'step count {multi_fn.n_steps} compiled into multi_fn')
+        for s in staged_list:
+            if s[2]:
+                raise ValueError(
+                    'scanned dispatch does not carry extra_data '
+                    '(attachtxt chains); use the per-step path')
+        data_stack = self._device_stack([s[0] for s in staged_list])
+        label_stack = self._device_stack([s[1] for s in staged_list])
+        mask_stack = self._device_stack([s[3] for s in staged_list])
+        return self.update_n_on_device(
+            multi_fn, data_stack, label_stack, mask_stack=mask_stack,
+            norm=staged_list[0][7])
 
     # --- training ---------------------------------------------------------
     def start_round(self, round_: int) -> None:

@@ -62,6 +62,13 @@ class SupervisorConfig:
     save_async: int = 0                      # 1 = background checkpoint
                                              # writer (runtime/async_ckpt)
     save_workers: int = 2                    # per-save shard-write threads
+    # the train chain's utils.metric.StatSet when the pooled input
+    # pipeline is on (nworker, doc/io.md): the watchdog buffer reports
+    # its stalls there, and its presence marks the chain as POOLED —
+    # the first batch then also pays the pool's window fill
+    # (nworker*4 decoded+augmented instances), so the first-deadline
+    # grace doubles rather than deterministically tripping the watchdog
+    pipeline_stats: Optional[object] = None
     retry: faults.RetryPolicy = field(
         default_factory=lambda: faults.DEFAULT_IO_RETRY)
 
@@ -272,6 +279,10 @@ class TrainSupervisor:
             # re-trip the watchdog and exhaust max_restarts
             first = None if cfg.batch_deadline is None \
                 else cfg.batch_deadline * max(5, start + 1)
+            if first is not None and cfg.pipeline_stats is not None:
+                # pooled producers (nworker): the first batch also fills
+                # the pool's in-flight window before anything is emitted
+                first *= 2
             # fault_base keeps injected stall indices epoch-absolute
             # across restarts (the producer's enumerate restarts at 0)
             buf = ThreadBuffer(lambda s=start: batch_factory(s),
@@ -280,6 +291,7 @@ class TrainSupervisor:
                                first_deadline=first,
                                fault_scope='batch',
                                fault_base=start)
+            buf.stats = cfg.pipeline_stats
             try:
                 for batch in buf:
                     if before_step is not None:

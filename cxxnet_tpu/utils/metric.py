@@ -174,6 +174,12 @@ class StatSet:
         with self._lock:
             return self._counters.get(name, default)
 
+    def clear(self) -> None:
+        """Reset every counter and distribution (per-round reporting)."""
+        with self._lock:
+            self._counters.clear()
+            self._samples.clear()
+
     def quantile(self, name: str, q: float) -> float:
         with self._lock:
             s = list(self._samples.get(name, ()))

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterator, List, Optional, Tuple, TypeVar
 
 T = TypeVar('T')
@@ -55,6 +56,11 @@ class ThreadBuffer:
         # the fault-injection hook, so a consumer that re-winds mid-epoch
         # (the supervisor) keeps injected stall indices epoch-absolute
         self._fault_base = fault_base
+        # optional utils.metric.StatSet: producer full-queue stalls and
+        # consumer empty-queue waits land on the eval line (doc/io.md);
+        # assigned late (io chains resolve their StatSet after set_param)
+        self.stats = None
+        self.stats_name = 'buffer'
         self._lock = threading.Lock()
         # every live (thread, stop, queue) from __iter__, for close()
         self._runs: List[Tuple[threading.Thread, threading.Event,
@@ -72,6 +78,11 @@ class ThreadBuffer:
                         q.put(item, timeout=0.1)
                         break
                     except queue.Full:
+                        # consumer slower than this producer: benign for
+                        # throughput, but counted — a full buffer plus a
+                        # starved pool downstream localizes the bottleneck
+                        if self.stats is not None:
+                            self.stats.inc(f'{self.stats_name}.full_stall')
                         continue
                 if stop.is_set():
                     return
@@ -111,7 +122,7 @@ class ThreadBuffer:
         stop = threading.Event()
         box: list = []
         thread = threading.Thread(target=self._run, args=(q, stop, box),
-                                  daemon=True)
+                                  daemon=True, name='cxxnet-tb-producer')
         with self._lock:
             # prune retired producers so an epoch-per-iteration consumer
             # doesn't grow this list unboundedly
@@ -124,6 +135,12 @@ class ThreadBuffer:
                 dl = self._deadline
                 if index == 0 and self._first_deadline is not None:
                     dl = self._first_deadline
+                # when instrumented and about to block, time the wait:
+                # consumer-starved ms is the number that justifies
+                # nworker (doc/io.md)
+                starved = self.stats is not None and q.empty()
+                if starved:
+                    t0 = time.perf_counter()
                 if dl is None:
                     item = q.get()
                 else:
@@ -132,6 +149,9 @@ class ThreadBuffer:
                     except queue.Empty:
                         from ..runtime.faults import PipelineStallError
                         raise PipelineStallError(index, dl) from None
+                if starved:
+                    self.stats.observe(f'{self.stats_name}.starved_ms',
+                                       (time.perf_counter() - t0) * 1e3)
                 if item is _STOP:
                     if box:
                         raise box[0]
@@ -148,7 +168,6 @@ class ThreadBuffer:
         returns True when every producer thread exited."""
         with self._lock:
             runs, self._runs = self._runs, []
-        import time
         end = None if timeout is None else time.monotonic() + timeout
         ok = True
         for thread, stop, q in runs:

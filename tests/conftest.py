@@ -10,7 +10,12 @@ alone is not enough — we also update the live jax config before any
 backend is initialized by a test.
 """
 
+import gc
 import os
+import threading
+import time
+
+import pytest
 
 os.environ['JAX_PLATFORMS'] = 'cpu'
 flags = os.environ.get('XLA_FLAGS', '')
@@ -40,3 +45,50 @@ def pytest_configure(config):
         'async_ckpt: asynchronous checkpointing suite — snapshot/writer/'
         'double-buffer/barrier semantics, CPU-only, deterministic '
         '(tier-1: runs under -m "not slow"; select with -m async_ckpt)')
+    config.addinivalue_line(
+        'markers',
+        'io_perf: parallel input pipeline + scanned step-loop dispatch '
+        'suite — worker-pool determinism, thread lifecycle, '
+        'steps_per_dispatch bitwise equality; CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m io_perf)')
+
+
+# every pipeline thread the framework starts carries a cxxnet- name
+# prefix (utils/thread_buffer.py producers, utils/parallel_pool.py
+# workers) precisely so this fixture can hold the line on lifecycle
+_PIPELINE_THREAD_PREFIXES = ('cxxnet-tb-', 'cxxnet-pool-')
+
+
+def _pipeline_threads():
+    return {t for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith(_PIPELINE_THREAD_PREFIXES)}
+
+
+@pytest.fixture(autouse=True)
+def _no_pipeline_thread_leaks():
+    """No stray ThreadBuffer producer / pool worker survives a test.
+
+    Abandoned iterator generators retire their threads from the
+    generator's ``finally`` (ThreadBuffer stop event, pool sentinel
+    drain), which on CPython fires at refcount-zero — so the check
+    collects garbage and grants a grace window before calling leak."""
+    before = _pipeline_threads()
+    yield
+    deadline = time.time() + 5.0
+    while True:
+        leaked = _pipeline_threads() - before
+        if not leaked:
+            return
+        # only pay a full collection when a candidate leak exists — an
+        # abandoned generator's finally (which retires its threads) may
+        # just not have run yet
+        gc.collect()
+        leaked = _pipeline_threads() - before
+        if not leaked:
+            return
+        if time.time() > deadline:
+            pytest.fail(
+                'pipeline threads leaked past the test: '
+                f'{sorted(t.name for t in leaked)} — close() the '
+                'ThreadBuffer/iterator or let its generator be collected')
+        time.sleep(0.05)
