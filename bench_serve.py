@@ -1,23 +1,35 @@
 #!/usr/bin/env python
 """Benchmark: online serving latency/throughput (doc/serving.md).
 
-Prints ONE JSON line so future PRs get a serving perf trajectory next to
-the training BENCH_*.json ledger:
+Prints ONE JSON line per run so future PRs get a serving perf trajectory
+next to the training BENCH_*.json ledger.  Two modes:
+
+``predict`` (default) — the PR 2 fixed-shape path::
 
   {"metric": "serve_p99_latency_ms", "value": P99, "unit": "ms",
    "p50_ms": P50, "mean_ms": M, "requests_per_sec": R,
    "rows_per_sec": RW, "compile_count": C, "buckets": [...],
    "clients": N, "duration_sec": D}
 
-Method: a tiny MLP (random init — serving cost is shape-bound, not
-value-bound) behind the real PredictEngine + DynamicBatcher stack;
-``--clients`` in-process threads submit mixed-size requests (1..max/2
-rows, seeded) back-to-back for ``--duration`` seconds after a warmup.
-The engine pre-compiles every bucket, so measured latency is pure
-serving-path overhead: queue + coalesce window + pad + forward + split.
+``decode`` — the continuous-batching decode engine (serve/decode.py)::
 
-Env: honors JAX_PLATFORMS (run with =cpu for a hardware-independent
-number); CXXNET_SERVE_BENCH_* override the defaults below.
+  {"metric": "decode_tokens_per_sec", "value": TPS, "unit": "tokens/sec",
+   "token_p50_ms": P50, "token_p99_ms": P99, "streams": N,
+   "shed": {"expired": E, "pages": P, "rejected": R},
+   "gen_cache": {"hit": H, "miss": M}, "slots": S, "pages": PG, ...}
+
+Method: a tiny model (random init — serving cost is shape-bound, not
+value-bound) behind the real engine + DynamicBatcher stack;
+``--clients`` in-process threads submit mixed-size requests (seeded)
+back-to-back for ``--duration`` seconds after a warmup.  Decode clients
+send mixed prompt lengths with staggered arrivals; per-token latency is
+the gap between consecutive emissions of one stream.
+
+Fallback policy (PR 5): when the accelerator backend cannot be reached
+within ``CXXNET_BENCH_BACKEND_WAIT`` seconds the run re-executes pinned
+to ``JAX_PLATFORMS=cpu`` and the receipt is tagged
+``"platform": "cpu-fallback"`` — the ledger always records a number.
+Env: CXXNET_SERVE_BENCH_* override the defaults below.
 """
 
 from __future__ import annotations
@@ -25,6 +37,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -46,8 +59,206 @@ eta = 0.1
 """
 
 
+def _backend_ok(budget: float) -> bool:
+    """True when jax can reach a non-CPU backend (or CPU was asked for
+    explicitly); bounded subprocess probe, same policy as bench.py."""
+    plats = [p.strip() for p in
+             os.environ.get('JAX_PLATFORMS', '').split(',') if p.strip()]
+    if plats and all(p == 'cpu' for p in plats):
+        return True                       # explicit CPU run: no probe
+    try:
+        r = subprocess.run(
+            [sys.executable, '-c',
+             'import jax; print(jax.devices()[0].platform)'],
+            capture_output=True, text=True,
+            timeout=max(20.0, min(180.0, budget)))
+        return r.returncode == 0 and \
+            (r.stdout or '').strip().splitlines()[-1:] != ['cpu']
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _cpu_fallback(argv, reason: str) -> int:
+    """Re-run this bench pinned to CPU and re-tag its receipt."""
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)]
+                      + list(argv or sys.argv[1:]),
+                      env=env, capture_output=True, text=True,
+                      timeout=3000)
+    payload = None
+    for line in reversed((r.stdout or '').strip().splitlines()):
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if payload is None:
+        print(json.dumps({'metric': 'serve_bench', 'value': None,
+                          'error': f'cpu fallback produced no JSON '
+                                   f'(rc={r.returncode})',
+                          'fallback_reason': reason}))
+        return 1
+    payload['platform'] = 'cpu-fallback'
+    payload['fallback_reason'] = reason
+    print(json.dumps(payload))
+    return 0 if payload.get('value') is not None else 1
+
+
+def bench_predict(args) -> dict:
+    from cxxnet_tpu import wrapper
+    from cxxnet_tpu.serve import DynamicBatcher, PredictEngine
+    from cxxnet_tpu.utils.bucketing import parse_buckets
+
+    net = wrapper.Net(dev='', cfg=NET_CFG)
+    net.set_param('inference_only', '1')
+    net.init_model()
+    buckets = parse_buckets(args.buckets)
+    engine = PredictEngine(net._trainer, buckets)
+    engine.warm()
+    batcher = DynamicBatcher(engine, max_queue=4 * args.clients,
+                             max_wait=args.max_wait, deadline=30.0)
+
+    lat_ms = []
+    rows_done = [0]
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(cid: int) -> None:
+        rng = np.random.RandomState(cid)
+        while not stop.is_set():
+            n = int(rng.randint(1, max(2, buckets[-1] // 2)))
+            d = rng.randn(n, 1, 1, 32).astype(np.float32)
+            t0 = time.monotonic()
+            batcher.submit(d)
+            dt = (time.monotonic() - t0) * 1e3
+            with lock:
+                lat_ms.append(dt)
+                rows_done[0] += n
+
+    threads = [threading.Thread(target=client, args=(cid,), daemon=True)
+               for cid in range(args.clients)]
+    warmup = min(0.5, args.duration / 4)
+    for t in threads:
+        t.start()
+    time.sleep(warmup)
+    with lock:          # measure steady state only
+        lat_ms.clear()
+        rows_done[0] = 0
+    t_start = time.monotonic()
+    time.sleep(args.duration)
+    elapsed = time.monotonic() - t_start
+    stop.set()
+    for t in threads:
+        t.join(10)
+    batcher.close(timeout=10)
+
+    arr = np.asarray(lat_ms)
+    return {
+        'metric': 'serve_p99_latency_ms',
+        'value': round(float(np.quantile(arr, 0.99)), 4),
+        'unit': 'ms',
+        'p50_ms': round(float(np.quantile(arr, 0.5)), 4),
+        'mean_ms': round(float(arr.mean()), 4),
+        'requests_per_sec': round(arr.size / elapsed, 2),
+        'rows_per_sec': round(rows_done[0] / elapsed, 2),
+        'compile_count': engine.compile_count,
+        'buckets': list(buckets),
+        'clients': args.clients,
+        'duration_sec': round(elapsed, 3),
+        'platform': __import__('jax').default_backend(),
+    }
+
+
+def bench_decode(args) -> dict:
+    """Continuous-batching decode: mixed prompt lengths, staggered
+    arrivals, tokens/sec + per-token p50/p99 + shed counts."""
+    from cxxnet_tpu.models import transformer as T
+    from cxxnet_tpu.serve import ServeError
+    from cxxnet_tpu.serve.decode import DecodeService
+
+    cfg = T.TransformerConfig(vocab_size=256, d_model=64, num_heads=4,
+                              d_ff=128, num_stages=2, seq_len=64,
+                              attn='local')
+    params = T.init_params(np.random.RandomState(0), cfg)
+    svc = DecodeService(params, cfg, slots=args.slots, pages=args.pages,
+                        page_size=args.page_size, max_prompt=32,
+                        max_new_bound=args.max_new,
+                        max_queue=4 * args.clients, deadline=60.0)
+    stats = svc.engine.stats
+    T.gen_cache_stats(reset=True)
+
+    tok_gaps = []
+    streams = [0]
+    toks_done = [0]
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(cid: int) -> None:
+        rng = np.random.RandomState(1000 + cid)
+        while not stop.is_set():
+            s0 = int(rng.randint(1, 32))
+            prompt = rng.randint(0, cfg.vocab_size, (1, s0)).astype(np.int32)
+            try:
+                req = svc.submit_async(prompt, args.max_new)
+                svc.batcher.wait(req)
+            except ServeError:
+                continue           # shed: counted by the engine stats
+            with lock:
+                streams[0] += 1
+                toks_done[0] += len(req.tokens)
+                tt = req.token_times
+                tok_gaps.extend((b - a) * 1e3 for a, b in zip(tt, tt[1:]))
+            time.sleep(rng.uniform(0, 0.01))   # staggered arrivals
+
+    threads = [threading.Thread(target=client, args=(cid,), daemon=True)
+               for cid in range(args.clients)]
+    for t in threads:
+        t.start()
+    time.sleep(min(1.0, args.duration / 3))    # warmup: compile + fill
+    with lock:
+        tok_gaps.clear()
+        streams[0] = toks_done[0] = 0
+    t_start = time.monotonic()
+    time.sleep(args.duration)
+    elapsed = time.monotonic() - t_start
+    stop.set()
+    for t in threads:
+        t.join(30)
+    svc.close(30)
+
+    gaps = np.asarray(tok_gaps) if tok_gaps else np.asarray([float('nan')])
+    gs = T.gen_cache_stats()
+    return {
+        'metric': 'decode_tokens_per_sec',
+        'value': round(toks_done[0] / elapsed, 2),
+        'unit': 'tokens/sec',
+        'token_p50_ms': round(float(np.quantile(gaps, 0.5)), 4),
+        'token_p99_ms': round(float(np.quantile(gaps, 0.99)), 4),
+        'streams': streams[0],
+        'streams_per_sec': round(streams[0] / elapsed, 2),
+        'shed': {'expired': int(stats.get('expired')),
+                 'pages': int(stats.get('shed_pages')),
+                 'rejected': int(stats.get('rejected'))},
+        'step_occupancy_p50': round(
+            float(stats.quantile('step_occupancy', 0.5)), 3),
+        # retrace visibility: the engine's own compiled programs (the
+        # decode path never consults generate()'s cache; gen_cache is
+        # here for surfaces that do — e.g. the CLI drive's twin check)
+        'prefill_programs': int(stats.get('prefill_programs')),
+        'gen_cache': {'hit': gs['hit'], 'miss': gs['miss']},
+        'slots': args.slots, 'pages': args.pages,
+        'page_size': args.page_size, 'max_new': args.max_new,
+        'clients': args.clients,
+        'duration_sec': round(elapsed, 3),
+        'platform': __import__('jax').default_backend(),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('mode', nargs='?', default='predict',
+                    choices=('predict', 'decode'))
     ap.add_argument('--clients', type=int, default=int(
         os.environ.get('CXXNET_SERVE_BENCH_CLIENTS', 8)))
     ap.add_argument('--duration', type=float, default=float(
@@ -55,74 +266,26 @@ def main(argv=None) -> int:
     ap.add_argument('--buckets', default=os.environ.get(
         'CXXNET_SERVE_BENCH_BUCKETS', '1,8,32'))
     ap.add_argument('--max-wait', type=float, default=0.001)
+    ap.add_argument('--slots', type=int, default=int(
+        os.environ.get('CXXNET_SERVE_BENCH_SLOTS', 8)))
+    ap.add_argument('--pages', type=int, default=int(
+        os.environ.get('CXXNET_SERVE_BENCH_PAGES', 96)))
+    ap.add_argument('--page-size', type=int, default=16)
+    ap.add_argument('--max-new', type=int, default=int(
+        os.environ.get('CXXNET_SERVE_BENCH_MAX_NEW', 32)))
     args = ap.parse_args(argv)
 
+    budget = float(os.environ.get('CXXNET_BENCH_BACKEND_WAIT', '60'))
+    if not _backend_ok(budget):
+        return _cpu_fallback(argv, f'TPU backend unavailable within '
+                                   f'{budget:.0f}s')
     try:
-        from cxxnet_tpu import wrapper
-        from cxxnet_tpu.serve import DynamicBatcher, PredictEngine
-        from cxxnet_tpu.utils.bucketing import parse_buckets
-
-        net = wrapper.Net(dev='', cfg=NET_CFG)
-        net.set_param('inference_only', '1')
-        net.init_model()
-        buckets = parse_buckets(args.buckets)
-        engine = PredictEngine(net._trainer, buckets)
-        engine.warm()
-        batcher = DynamicBatcher(engine, max_queue=4 * args.clients,
-                                 max_wait=args.max_wait, deadline=30.0)
-
-        lat_ms = []
-        rows_done = [0]
-        lock = threading.Lock()
-        stop = threading.Event()
-
-        def client(cid: int) -> None:
-            rng = np.random.RandomState(cid)
-            while not stop.is_set():
-                n = int(rng.randint(1, max(2, buckets[-1] // 2)))
-                d = rng.randn(n, 1, 1, 32).astype(np.float32)
-                t0 = time.monotonic()
-                batcher.submit(d)
-                dt = (time.monotonic() - t0) * 1e3
-                with lock:
-                    lat_ms.append(dt)
-                    rows_done[0] += n
-
-        threads = [threading.Thread(target=client, args=(cid,), daemon=True)
-                   for cid in range(args.clients)]
-        warmup = min(0.5, args.duration / 4)
-        for t in threads:
-            t.start()
-        time.sleep(warmup)
-        with lock:          # measure steady state only
-            lat_ms.clear()
-            rows_done[0] = 0
-        t_start = time.monotonic()
-        time.sleep(args.duration)
-        elapsed = time.monotonic() - t_start
-        stop.set()
-        for t in threads:
-            t.join(10)
-        batcher.close(timeout=10)
-
-        arr = np.asarray(lat_ms)
-        out = {
-            'metric': 'serve_p99_latency_ms',
-            'value': round(float(np.quantile(arr, 0.99)), 4),
-            'unit': 'ms',
-            'p50_ms': round(float(np.quantile(arr, 0.5)), 4),
-            'mean_ms': round(float(arr.mean()), 4),
-            'requests_per_sec': round(arr.size / elapsed, 2),
-            'rows_per_sec': round(rows_done[0] / elapsed, 2),
-            'compile_count': engine.compile_count,
-            'buckets': list(buckets),
-            'clients': args.clients,
-            'duration_sec': round(elapsed, 3),
-            'platform': __import__('jax').default_backend(),
-        }
+        out = (bench_decode if args.mode == 'decode'
+               else bench_predict)(args)
     except Exception as e:  # structured failure, never a bare traceback
-        out = {'metric': 'serve_p99_latency_ms', 'value': None,
-               'unit': 'ms', 'error': repr(e)}
+        out = {'metric': ('decode_tokens_per_sec' if args.mode == 'decode'
+                          else 'serve_p99_latency_ms'),
+               'value': None, 'unit': None, 'error': repr(e)}
     print(json.dumps(out))
     return 0 if 'error' not in out else 1
 

@@ -154,7 +154,9 @@ def net_serve_start(net: Net, cfg: str) -> None:
     """Stand up the serving stack.  ``cfg`` is a compact ``k=v[;k=v...]``
     list (utils.config.parse_kv_list): ``buckets`` (``:``-separated, e.g.
     ``1:8:32``), ``max_queue``, ``max_wait`` (seconds), ``deadline``
-    (seconds), ``warm`` (0/1).  Empty string = all defaults."""
+    (seconds), ``warm`` (0/1), ``models`` (``|``-separated ``id:dir``
+    fleet siblings), ``mem_budget`` (bytes).  Empty string = all
+    defaults."""
     from .utils.config import parse_kv_list
     kw = {}
     for key, val in parse_kv_list(cfg or ''):
@@ -168,6 +170,11 @@ def net_serve_start(net: Net, cfg: str) -> None:
             kw['deadline'] = float(val)
         elif key == 'warm':
             kw['warm'] = bool(int(val))
+        elif key == 'models':
+            kw['models'] = dict(seg.split(':', 1)
+                                for seg in val.split('|') if seg)
+        elif key == 'mem_budget':
+            kw['mem_budget'] = int(val)
         else:
             raise ValueError(f'unknown serve option: {key!r}')
     net.serve_start(**kw)
@@ -190,3 +197,72 @@ def net_serve_stats(net: Net) -> str:
 
 def net_serve_stop(net: Net) -> None:
     net.serve_stop()
+
+
+# ---- continuous decode surface (CXNLMServe*) ------------------------------
+
+def lm_serve_start(cfg: str):
+    """Stand up the continuous-batching decode stack (doc/serving.md
+    "Continuous decode") for a transformer LM.  ``cfg`` is a compact
+    ``k=v[;k=v...]`` list: model spec ``vocab``/``d_model``/``heads``/
+    ``d_ff``/``stages``/``experts``, params from ``model_in`` (a
+    ``%04d.lm`` tree) or ``seed`` init, engine shape ``slots``/``pages``/
+    ``page_size``/``max_prompt``/``max_new``/``eos``, batcher knobs
+    ``max_queue``/``max_wait``/``deadline``.  Returns the service handle
+    the other ``lm_serve_*`` calls take."""
+    import numpy as np
+
+    from .models import transformer as T
+    from .serve.decode import DecodeService, load_lm_params
+    from .utils.config import parse_kv_list
+    cfg_kw = {'attn': 'local'}
+    svc_kw = {}
+    seed, model_in, eos = 0, None, None
+    names = {'vocab': 'vocab_size', 'd_model': 'd_model',
+             'heads': 'num_heads', 'd_ff': 'd_ff', 'stages': 'num_stages',
+             'experts': 'num_experts', 'seq': 'seq_len'}
+    ints = ('slots', 'pages', 'page_size', 'max_prompt', 'max_queue')
+    for key, val in parse_kv_list(cfg or ''):
+        if key in names:
+            cfg_kw[names[key]] = int(val)
+        elif key in ints:
+            svc_kw[key] = int(val)
+        elif key == 'max_new':
+            svc_kw['max_new_bound'] = int(val)
+        elif key in ('max_wait', 'deadline'):
+            svc_kw[key] = float(val)
+        elif key == 'seed':
+            seed = int(val)
+        elif key == 'model_in':
+            model_in = val
+        elif key == 'eos':
+            eos = None if int(val) < 0 else int(val)
+        else:
+            raise ValueError(f'unknown lm_serve option: {key!r}')
+    tcfg = T.TransformerConfig(**cfg_kw)
+    params = (load_lm_params(model_in) if model_in
+              else T.init_params(np.random.RandomState(seed), tcfg))
+    return DecodeService(params, tcfg, eos_id=eos, **svc_kw)
+
+
+def lm_serve_generate(svc, prompt_mv, n: int, max_new: int,
+                      temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+    """One decode request through the admission-controlled stack: blocks
+    for the full stream, returns contiguous int32 token ids (the stream
+    ends at the engine's EOS when configured).  Typed serving errors
+    propagate as Python exceptions for the C error surface."""
+    prompt = np.frombuffer(prompt_mv, np.int32, count=int(n))[None]
+    rng = None
+    if temperature > 0:
+        import jax
+        rng = jax.random.PRNGKey(int(seed))
+    toks = svc.generate(prompt, int(max_new), float(temperature), rng)
+    return np.ascontiguousarray(toks, np.int32)
+
+
+def lm_serve_stats(svc) -> str:
+    return svc.report()
+
+
+def lm_serve_stop(svc) -> None:
+    svc.close()

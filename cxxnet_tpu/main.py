@@ -73,6 +73,22 @@ class LearnTask:
         self.serve_max_wait = 0.002    # serve.max_wait coalesce window (s)
         self.serve_deadline = 1.0      # serve.deadline per-request (s)
         self.serve_reload = 0.0        # serve.reload poll period (s, 0=off)
+        # continuous decode + multi-model fleet (doc/serving.md)
+        self.serve_mode = 'predict'    # serve.mode: predict | decode
+        self.serve_slots = 4           # serve.slots decode step width
+        self.serve_pages = 64          # serve.pages KV pool (physical pages)
+        self.serve_page_size = 16      # serve.page_size tokens per page
+        self.serve_max_prompt = 64     # serve.max_prompt longest prompt
+        self.serve_max_new = 16        # serve.max_new decode horizon/bound
+        self.serve_eos = -1            # serve.eos id (-1 = none)
+        self.serve_lm = ''             # serve.lm transformer spec (k=v;...)
+        self.serve_lm_seed = 0         # serve.lm_seed init seed (no model_in)
+        self.serve_lm_model_in = 'NULL'  # serve.lm_model_in %04d.lm file
+        self.serve_requests = 16       # serve.requests decode drive size
+        self.serve_temperature = 0.0   # serve.temperature decode sampling
+        self.serve_seed = 0            # serve.seed drive prompt/rng seed
+        self.serve_models = ''         # serve.models fleet: id=dir;id=dir
+        self.serve_mem_budget = 0      # serve.mem_budget bytes (0 = off)
         self.cfg: List[ConfigEntry] = []
         self.net_trainer: Optional[NetTrainer] = None
         self.itr_train = None
@@ -108,6 +124,21 @@ class LearnTask:
             'serve.max_wait': ('serve_max_wait', float),
             'serve.deadline': ('serve_deadline', float),
             'serve.reload': ('serve_reload', float),
+            'serve.mode': ('serve_mode', str),
+            'serve.slots': ('serve_slots', int),
+            'serve.pages': ('serve_pages', int),
+            'serve.page_size': ('serve_page_size', int),
+            'serve.max_prompt': ('serve_max_prompt', int),
+            'serve.max_new': ('serve_max_new', int),
+            'serve.eos': ('serve_eos', int),
+            'serve.lm': ('serve_lm', str),
+            'serve.lm_seed': ('serve_lm_seed', int),
+            'serve.lm_model_in': ('serve_lm_model_in', str),
+            'serve.requests': ('serve_requests', int),
+            'serve.temperature': ('serve_temperature', float),
+            'serve.seed': ('serve_seed', int),
+            'serve.models': ('serve_models', str),
+            'serve.mem_budget': ('serve_mem_budget', int),
         }
         if name in simple:
             attr, typ = simple[name]
@@ -313,6 +344,11 @@ class LearnTask:
             it.init()
 
     def init(self) -> None:
+        if self.task == 'serve' and self.serve_mode == 'decode':
+            # the decode stack serves a transformer LM tree (serve.lm /
+            # serve.lm_model_in), not a netconfig model: no NetTrainer
+            self._create_iterators()
+            return
         if self.task == 'train' and self.continue_training:
             if not self._sync_latest_model():
                 raise RuntimeError(
@@ -659,6 +695,19 @@ class LearnTask:
                     lambda c, p: print(f'serve: hot-reloaded checkpoint '
                                        f'{c} from {p}', flush=True)))
             registry.start()
+        fleet = self._serve_fleet(engine)
+        if fleet is not None:
+            for mid in fleet.models():
+                try:
+                    fleet.get(mid)       # budgeter decides who stays warm
+                except Exception as e:   # a cold sibling must not kill serve
+                    print(f'serve: fleet model {mid!r} not loaded: {e}',
+                          flush=True)
+            if not self.silent:
+                print(f'serve: fleet of {len(fleet.models())} models, '
+                      f'{len(fleet.loaded())} resident under '
+                      f'{self.serve_mem_budget or "unbounded"} bytes',
+                      flush=True)
         print('start serving...')
         served = 0
         try:
@@ -705,10 +754,149 @@ class LearnTask:
                 registry.close(timeout=5.0)
             batcher.close(timeout=30.0)
             sys.stderr.write(f'[serve]{batcher.report("serve")}\n')
+            if fleet is not None:
+                sys.stderr.write(f'[serve]{fleet.report()}\n')
+                fleet.close(timeout=5.0)
             sys.stderr.flush()
         print(f'finished serving {served} instances, predictions in '
               f'{self.name_pred} (compiled {engine.compile_count} programs '
               f'for {len(engine.buckets)} buckets)')
+
+    def _lm_spec(self):
+        """Build the decode model: ``serve.lm`` is a compact
+        ``k=v[;k=v...]`` TransformerConfig spec (vocab, d_model, heads,
+        d_ff, stages, experts, seq); params come from
+        ``serve.lm_model_in`` (a ``%04d.lm`` tree written by
+        ``serve.save_lm_params``) or a seeded init."""
+        import numpy as np
+
+        from .models import transformer as TT
+        from .utils.config import parse_kv_list
+        kw = {'attn': 'local'}
+        names = {'vocab': ('vocab_size', int), 'd_model': ('d_model', int),
+                 'heads': ('num_heads', int), 'd_ff': ('d_ff', int),
+                 'stages': ('num_stages', int), 'seq': ('seq_len', int),
+                 'experts': ('num_experts', int)}
+        for key, val in parse_kv_list(self.serve_lm or ''):
+            if key not in names:
+                raise ValueError(f'unknown serve.lm key: {key!r}')
+            attr, typ = names[key]
+            kw[attr] = typ(val)
+        cfg = TT.TransformerConfig(**kw)
+        if self.serve_lm_model_in != 'NULL':
+            from .serve.decode import load_lm_params
+            params = load_lm_params(self.serve_lm_model_in)
+        else:
+            params = TT.init_params(
+                np.random.RandomState(self.serve_lm_seed), cfg)
+        return params, cfg
+
+    def task_serve_decode(self) -> None:
+        """``task=serve serve.mode=decode``: the continuous-batching
+        decode stack (doc/serving.md "Continuous decode") driven over
+        seeded synthetic prompts of mixed lengths — the CLI exercises
+        exactly the join/leave/page path an embedding server drives via
+        ``lm_serve_*``.  Token streams land in ``pred=``'s file (one
+        space-separated line per request, arrival order); the first few
+        are cross-checked against offline ``transformer.generate`` twins
+        and the per-token stats print to stderr at shutdown."""
+        import numpy as np
+
+        from .models import transformer as TT
+        from .serve.decode import DecodeService
+
+        params, cfg = self._lm_spec()
+        svc = DecodeService(
+            params, cfg, slots=self.serve_slots, pages=self.serve_pages,
+            page_size=self.serve_page_size,
+            max_prompt=self.serve_max_prompt,
+            max_new_bound=self.serve_max_new,
+            eos_id=None if self.serve_eos < 0 else self.serve_eos,
+            max_queue=self.serve_max_queue, max_wait=self.serve_max_wait,
+            # bulk drive: throughput-bound, not latency-bound (the same
+            # reasoning as the predict drive's bulk_deadline)
+            deadline=max(self.serve_deadline, 60.0))
+        if not self.silent:
+            print(f'serve: decode engine up — {self.serve_slots} slots, '
+                  f'{self.serve_pages}x{self.serve_page_size}-token KV '
+                  f'pages (slot cache {svc.engine.cache_len})', flush=True)
+        print('start serving (decode)...')
+        rng = np.random.RandomState(self.serve_seed)
+        n_req = max(1, self.serve_requests)
+        prompts = [rng.randint(
+            0, cfg.vocab_size,
+            (1, int(rng.randint(1, max(2, self.serve_max_prompt)))))
+            .astype(np.int32) for _ in range(n_req)]
+        temp = float(self.serve_temperature)
+        keys = [None] * n_req
+        if temp > 0:
+            import jax
+            keys = [jax.random.PRNGKey(self.serve_seed * 100003 + i)
+                    for i in range(n_req)]
+        reqs = [svc.submit_async(p, self.serve_max_new, temp, k)
+                for p, k in zip(prompts, keys)]
+        served = 0
+        try:
+            with open(self.name_pred, 'w') as fo:
+                for r in reqs:
+                    toks = svc.batcher.wait(r)
+                    fo.write(' '.join(str(int(t)) for t in toks) + '\n')
+                    served += 1
+            # bitwise-twin spot check: the stream each request got must
+            # equal its offline generate call (same seed/schedule)
+            checked = 0
+            for i in range(min(3, n_req)):
+                off = np.asarray(TT.generate(
+                    params, prompts[i], self.serve_max_new, cfg,
+                    temperature=temp, rng=keys[i],
+                    eos_id=None if self.serve_eos < 0
+                    else self.serve_eos))[0]
+                got = reqs[i].result
+                if not (np.asarray(got) == off[:len(got)]).all():
+                    raise AssertionError(
+                        f'decode stream {i} diverged from its offline '
+                        f'generate twin: {got} vs {off}')
+                checked += 1
+            if not self.silent:
+                print(f'decode twin check: {checked} streams equal their '
+                      'offline generate calls', flush=True)
+        finally:
+            sys.stderr.write(f'[serve]{svc.report("decode")}\n')
+            sys.stderr.flush()
+            svc.close(30.0)
+        print(f'finished serving {served} decode streams, token ids in '
+              f'{self.name_pred}')
+
+    def _serve_fleet(self, engine):
+        """``serve.models=id=dir;id=dir``: register sibling checkpoints
+        (same architecture as the conf) in a MultiModelRegistry under
+        ``serve.mem_budget`` bytes; returns the fleet (or None)."""
+        if not self.serve_models:
+            return None
+        from .serve import MultiModelRegistry, PredictEngine
+        from .utils.bucketing import parse_buckets
+        from .utils.config import parse_kv_list
+
+        fleet = MultiModelRegistry(mem_budget=self.serve_mem_budget,
+                                   poll_interval=self.serve_reload or 1.0)
+
+        def make_factory(mdir):
+            def factory():
+                from .serve.registry import (load_into_trainer,
+                                             newest_model_file)
+                best = newest_model_file(mdir)
+                if best is None:
+                    raise FileNotFoundError(f'no model files in {mdir}')
+                tr = load_into_trainer(self._create_net(), best[1])
+                return PredictEngine(tr,
+                                     parse_buckets(self.serve_buckets))
+            return factory
+
+        for mid, mdir in parse_kv_list(self.serve_models):
+            fleet.add_model(mid, make_factory(mdir), model_dir=mdir)
+        if self.serve_reload > 0:
+            fleet.start()
+        return fleet
 
     def task_extract(self) -> None:
         assert self.itr_pred is not None, 'must specify a pred iterator'
@@ -754,7 +942,10 @@ class LearnTask:
         elif self.task == 'extract':
             self.task_extract()
         elif self.task == 'serve':
-            self.task_serve()
+            if self.serve_mode == 'decode':
+                self.task_serve_decode()
+            else:
+                self.task_serve()
         if plan is not None and not self.silent:
             # chaos-drill closure: which events actually fired, and what
             # the runtime saw/did about them (doc/fault_tolerance.md)

@@ -420,6 +420,21 @@ def _nodrop_moe_ffn(y2, p, gather: bool):
 # growing without bound.
 _GEN_CACHE: 'collections.OrderedDict' = collections.OrderedDict()
 
+# hit/miss tallies for the program cache — serving telemetry
+# (serve stats / bench receipts) reads these through gen_cache_stats()
+# so a retrace storm under live traffic is visible, not silent
+_GEN_STATS = {'hit': 0, 'miss': 0}
+
+
+def gen_cache_stats(reset: bool = False) -> dict:
+    """Snapshot (optionally reset) the ``generate`` program-cache
+    hit/miss counters; serving surfaces export them onto a
+    ``utils.metric.StatSet`` (``gen_cache.hit`` / ``gen_cache.miss``)."""
+    out = dict(_GEN_STATS)
+    if reset:
+        _GEN_STATS['hit'] = _GEN_STATS['miss'] = 0
+    return out
+
 
 def _gen_cache_max() -> int:
     return max(1, int(os.environ.get('CXXNET_GEN_CACHE_MAX', '8')))
@@ -483,12 +498,16 @@ def generate(params, prompt, max_new: int, cfg: TransformerConfig,
            eos_id)
     run = _GEN_CACHE.get(key)
     if run is None:
+        _GEN_STATS['miss'] += 1
         run = _GEN_CACHE[key] = _build_generate(
             cfg, b, s0b, mnb, temperature, eos_id)
-        while len(_GEN_CACHE) > _gen_cache_max():
-            _GEN_CACHE.popitem(last=False)
     else:
+        _GEN_STATS['hit'] += 1
         _GEN_CACHE.move_to_end(key)     # LRU touch
+    # enforce the bound on EVERY call (hits included): an env value that
+    # shrinks mid-process takes effect on the next call, not the next miss
+    while len(_GEN_CACHE) > _gen_cache_max():
+        _GEN_CACHE.popitem(last=False)
     # the pad width is a traced VALUE, not a shape: every w for the same
     # bucket reuses one compiled program.  Sampling keys are split for
     # the REQUESTED horizon and zero-padded to the bucket (split(rng, n)
@@ -506,18 +525,121 @@ def generate(params, prompt, max_new: int, cfg: TransformerConfig,
     return run(params, prompt, keys, jnp.int32(w))[:, :max_new]
 
 
+def _gen_ffn(cfg: TransformerConfig, p, y2, gather: bool):
+    """Inference-path FFN for one stage: dense nets run the training
+    math; MoE nets route through the no-drop top-1 gate."""
+    mb, s, d = y2.shape
+    if cfg.num_experts:
+        return _nodrop_moe_ffn(y2.reshape(mb * s, d), p,
+                               gather).reshape(mb, s, d)
+    return jax.nn.relu(y2 @ p['w1']) @ p['w2']
+
+
+def prefill_kv(params, prompt, w, cfg: TransformerConfig):
+    """Vectorized prompt prefill — the whole (possibly left-padded)
+    prompt through :func:`_stage_attn` in one pass, capturing each
+    stage's K/V.  THE single copy of the prefill math: ``generate``'s
+    compiled program and the serve decode engine's per-request prefill
+    (serve/decode.py) both run through here.
+
+    ``prompt``: (b, s0) int32 with the first ``w`` slots bucket padding
+    (``w`` is a traced value — every pad width shares one program).
+    Returns ``(ks, vs, logits0)``: ks/vs (num_stages, b, s0, heads, hd)
+    cache rows for positions [0, s0), logits0 (b, vocab) float32 for the
+    last position (the first generated token's distribution)."""
+    b, s0 = prompt.shape
+    h = jnp.take(params['embed'], prompt, axis=0)
+    # causal over the real tokens only: the first ``w`` slots are
+    # bucket padding (generate() left-pads), excluded from every
+    # real query.  Each PAD query attends just its own slot — an
+    # all-masked softmax row is NaN, and 0 * NaN cached-V rows would
+    # poison real outputs downstream.  ``w`` is traced, so w=0
+    # reduces to the plain tril without a separate program.
+    ar = jnp.arange(s0)
+    mask = ((ar[None, :] <= ar[:, None]) & (ar[None, :] >= w)
+            | (ar[None, :] == ar[:, None]) & (ar[:, None] < w)
+            )[None, None]
+    ks, vs = [], []
+    for i in range(cfg.num_stages):
+        p = jax.tree.map(lambda a, i=i: a[i], params['stages'])
+        h, y2, k, v = _stage_attn(p, h, cfg, mask)
+        ks.append(k)
+        vs.append(v)
+        h = h + _gen_ffn(cfg, p, y2, gather=False)
+    logits0 = (h[:, -1] @ params['head']).astype(jnp.float32)
+    return jnp.stack(ks), jnp.stack(vs), logits0
+
+
+def decode_step(params, cfg: TransformerConfig, tok, kc, vc, t, w):
+    """One KV-cached decode step over a DENSE cache — the
+    single-token-step entry the serve decode engine drives
+    (serve/decode.py) and the body of ``generate``'s scan: one copy of
+    the per-token block math, so the two cannot drift.
+
+    ``tok``: (b,) int32, the token consumed this step.  ``kc``/``vc``:
+    (num_stages, b, total, heads, hd) caches; this step's K/V is written
+    at position ``t`` before attending.  ``t``/``w`` are traced values —
+    scalars (every row at the same position: ``generate``) or (b,)
+    vectors (per-row positions and pad widths: the decode engine's
+    slots, each mid-stream at its own offset).  Cache positions outside
+    ``[w, t]`` are masked out of the attention (the paged-attention
+    masking rule: a slot's unwritten/bucket-pad positions never
+    contribute).
+
+    Returns ``(logits, kc, vc, knew, vnew)``: logits (b, vocab) float32
+    for the next token, the updated caches, and knew/vnew
+    (num_stages, b, heads, hd) — just the rows written at ``t`` (the
+    paged engine scatters those into its page pool; ``generate`` keeps
+    the dense caches and ignores them)."""
+    total = kc.shape[2]
+    b = tok.shape[0]
+    hd = cfg.d_model // cfg.num_heads
+    scale = 1.0 / math.sqrt(hd)
+    per_row = jnp.ndim(t) > 0
+    ar = jnp.arange(total)
+    if per_row:
+        live = ((ar[None, :] <= t[:, None])
+                & (ar[None, :] >= w[:, None]))[:, None, None, :]
+    else:
+        # cache slots [0, w) hold bucket-pad K/V: never attended
+        live = ((ar <= t) & (ar >= w))[None, None, None, :]
+    h = jnp.take(params['embed'], tok[:, None], axis=0)
+    knews, vnews = [], []
+    for i in range(cfg.num_stages):
+        p = jax.tree.map(lambda a, i=i: a[i], params['stages'])
+        y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
+        q = (y @ p['wq']).reshape(b, 1, cfg.num_heads, hd)
+        k = (y @ p['wk']).reshape(b, 1, cfg.num_heads, hd)
+        v = (y @ p['wv']).reshape(b, 1, cfg.num_heads, hd)
+        if per_row:
+            kc = kc.at[i, jnp.arange(b), t].set(k[:, 0])
+            vc = vc.at[i, jnp.arange(b), t].set(v[:, 0])
+        else:
+            kc = jax.lax.dynamic_update_slice(
+                kc, k[None], (i, 0, t, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v[None], (i, 0, t, 0, 0))
+        ki, vi = kc[i], vc[i]
+        # (b, heads, 1, total) scores over the cache
+        s_ = jnp.einsum('bqhd,bkhd->bhqk', q, ki) * scale
+        s_ = jnp.where(live, s_, -jnp.inf)
+        attn = jnp.einsum(
+            'bhqk,bkhd->bqhd',
+            jax.nn.softmax(s_.astype(jnp.float32),
+                           axis=-1).astype(ki.dtype), vi)
+        h = h + attn.reshape(b, 1, cfg.d_model) @ p['wo']
+        y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
+        h = h + _gen_ffn(cfg, p, y2, gather=True)
+        knews.append(k[:, 0])
+        vnews.append(v[:, 0])
+    logits = (h[:, -1] @ params['head']).astype(jnp.float32)
+    return logits, kc, vc, jnp.stack(knews), jnp.stack(vnews)
+
+
 def _build_generate(cfg: TransformerConfig, b: int, s0: int,
                     max_new: int, temperature: float, eos_id=None):
     total = s0 + max_new
     hd = cfg.d_model // cfg.num_heads
-    scale = 1.0 / math.sqrt(hd)
-
-    def ffn(p, y2, gather):
-        mb, s, d = y2.shape
-        if cfg.num_experts:
-            return _nodrop_moe_ffn(y2.reshape(mb * s, d), p,
-                                   gather).reshape(mb, s, d)
-        return jax.nn.relu(y2 @ p['w1']) @ p['w2']
 
     def pick(logits, r):
         if temperature > 0:
@@ -527,29 +649,13 @@ def _build_generate(cfg: TransformerConfig, b: int, s0: int,
 
     @jax.jit
     def run(params, prompt, keys, w):
-        stage_ps = [jax.tree.map(lambda a, i=i: a[i], params['stages'])
-                    for i in range(cfg.num_stages)]
         # --- prefill: full prompt in one pass, K/V captured per stage
-        h = jnp.take(params['embed'], prompt, axis=0)
+        ks, vs, logits0 = prefill_kv(params, prompt, w, cfg)
         kc = jnp.zeros((cfg.num_stages, b, total, cfg.num_heads, hd),
-                       h.dtype)
+                       ks.dtype)
         vc = jnp.zeros_like(kc)
-        # causal over the real tokens only: the first ``w`` slots are
-        # bucket padding (generate() left-pads), excluded from every
-        # real query.  Each PAD query attends just its own slot — an
-        # all-masked softmax row is NaN, and 0 * NaN cached-V rows would
-        # poison real outputs downstream.  ``w`` is traced, so w=0
-        # reduces to the plain tril without a separate program.
-        ar = jnp.arange(s0)
-        mask = ((ar[None, :] <= ar[:, None]) & (ar[None, :] >= w)
-                | (ar[None, :] == ar[:, None]) & (ar[:, None] < w)
-                )[None, None]
-        for i, p in enumerate(stage_ps):
-            h, y2, k, v = _stage_attn(p, h, cfg, mask)
-            kc = kc.at[i, :, :s0].set(k)
-            vc = vc.at[i, :, :s0].set(v)
-            h = h + ffn(p, y2, gather=False)
-        logits0 = (h[:, -1] @ params['head']).astype(jnp.float32)
+        kc = kc.at[:, :, :s0].set(ks)
+        vc = vc.at[:, :, :s0].set(vs)
 
         tok0 = pick(logits0, keys[0] if temperature > 0 else None)
         rngs = keys[1:]
@@ -560,31 +666,8 @@ def _build_generate(cfg: TransformerConfig, b: int, s0: int,
         def step(carry, inp):
             tok, done, kc, vc = carry
             t, r = inp
-            h = jnp.take(params['embed'], tok[:, None], axis=0)
-            # cache slots [0, w) hold bucket-pad K/V: never attended
-            live = ((jnp.arange(total) <= t)
-                    & (jnp.arange(total) >= w))[None, None, None, :]
-            for i, p in enumerate(stage_ps):
-                y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
-                q = (y @ p['wq']).reshape(b, 1, cfg.num_heads, hd)
-                k = (y @ p['wk']).reshape(b, 1, cfg.num_heads, hd)
-                v = (y @ p['wv']).reshape(b, 1, cfg.num_heads, hd)
-                kc = jax.lax.dynamic_update_slice(
-                    kc, k[None], (i, 0, t, 0, 0))
-                vc = jax.lax.dynamic_update_slice(
-                    vc, v[None], (i, 0, t, 0, 0))
-                ki, vi = kc[i], vc[i]
-                # (b, heads, 1, total) scores over the cache
-                s_ = jnp.einsum('bqhd,bkhd->bhqk', q, ki) * scale
-                s_ = jnp.where(live, s_, -jnp.inf)
-                attn = jnp.einsum(
-                    'bhqk,bkhd->bqhd',
-                    jax.nn.softmax(s_.astype(jnp.float32),
-                                   axis=-1).astype(ki.dtype), vi)
-                h = h + attn.reshape(b, 1, cfg.d_model) @ p['wo']
-                y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
-                h = h + ffn(p, y2, gather=True)
-            logits = (h[:, -1] @ params['head']).astype(jnp.float32)
+            logits, kc, vc, _, _ = decode_step(params, cfg, tok, kc, vc,
+                                               t, w)
             nxt = pick(logits, r if temperature > 0 else None)
             if eos_id is not None:
                 # a finished row keeps emitting eos (static shapes under

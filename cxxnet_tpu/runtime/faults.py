@@ -102,6 +102,61 @@ class DeadlineExceededError(ServeError):
             f'request deadline {deadline:g}s exceeded after {waited:.3f}s')
 
 
+class TokenDeadlineExceededError(DeadlineExceededError):
+    """A decode request's deadline elapsed MID-STREAM: some tokens were
+    already emitted when the slot was reclaimed.  Subclasses
+    :class:`DeadlineExceededError` so predict-path handling applies;
+    ``tokens_emitted`` lets the client keep the partial stream and the
+    metrics layer account shed work at token granularity."""
+
+    def __init__(self, deadline: float, waited: float,
+                 tokens_emitted: int = 0):
+        super().__init__(deadline, waited, rows=1)
+        self.tokens_emitted = int(tokens_emitted)
+        self.args = (
+            f'decode deadline {deadline:g}s exceeded after {waited:.3f}s '
+            f'({tokens_emitted} tokens emitted)',)
+
+
+class DecodeSlotsExhaustedError(ServeError):
+    """A decode request can NEVER be admitted by this engine: its prompt
+    bucket or horizon exceeds the slot cache, or it needs more KV pages
+    than the pool holds even when empty.  A sizing/config outcome, not a
+    transient — shed immediately rather than queue forever."""
+
+    def __init__(self, reason: str):
+        super().__init__(f'decode request inadmissible: {reason}')
+
+
+class DecodePagesExhaustedError(ServeError):
+    """The paged KV pool ran dry mid-stream and this request was the
+    preemption victim: its pages were reclaimed so older streams could
+    finish.  ``tokens_emitted`` is the partial progress at shed time."""
+
+    def __init__(self, tokens_emitted: int, pages: int):
+        self.tokens_emitted = int(tokens_emitted)
+        self.pages = int(pages)
+        super().__init__(
+            f'KV page pool exhausted ({pages} pages): request preempted '
+            f'after {tokens_emitted} tokens')
+
+
+class MemoryBudgetExceededError(ServeError):
+    """Loading a model would exceed the serve fleet's device-memory
+    budget and no cold model could be evicted to make room."""
+
+    def __init__(self, model_id: str, needed: int, budget: int,
+                 resident: int):
+        self.model_id = str(model_id)
+        self.needed = int(needed)
+        self.budget = int(budget)
+        self.resident = int(resident)
+        super().__init__(
+            f'model {model_id!r} needs {needed} bytes but the serve '
+            f'budget is {budget} with {resident} resident and nothing '
+            'evictable (every loaded model is serving)')
+
+
 class FaultInjected(OSError):
     """Deterministic injected fault.  Subclasses ``OSError`` so the
     storage retry policies treat it exactly like a real transient I/O

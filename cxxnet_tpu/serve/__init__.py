@@ -12,18 +12,36 @@ sit behind heavy live traffic.
   per-request deadlines, per-bucket latency/throughput stats,
 * :class:`~cxxnet_tpu.serve.registry.ModelRegistry` — watch the training
   run's ``model_dir`` for new atomically-renamed checkpoints,
-  digest-verify, warm, swap — without dropping in-flight requests.
+  digest-verify, warm, swap — without dropping in-flight requests,
+* :class:`~cxxnet_tpu.serve.decode.DecodeEngine` — continuous-batching
+  autoregressive decode: one persistent compiled step over a paged KV
+  cache, requests join/leave at token boundaries, token streams
+  bitwise-twin offline ``transformer.generate``,
+* :class:`~cxxnet_tpu.serve.registry.MultiModelRegistry` — N models on
+  one chip under a :class:`~cxxnet_tpu.serve.registry.MemoryBudgeter`
+  (evict-cold, never the serving model; per-model reload machinery).
 
-Entry points: ``task=serve`` in the CLI (``main.py``), ``Net.serve_*``
-in the Python wrapper, ``net_serve_*`` in the C ABI glue (``capi.py``).
+Entry points: ``task=serve`` (+ ``serve.mode=decode``) in the CLI
+(``main.py``), ``Net.serve_*`` in the Python wrapper, ``net_serve_*`` /
+``lm_serve_*`` in the C ABI glue (``capi.py``).
 """
 
-from ..runtime.faults import (DeadlineExceededError, ServeError,
-                              ServeOverloadError)
+from ..runtime.faults import (DeadlineExceededError,
+                              DecodePagesExhaustedError,
+                              DecodeSlotsExhaustedError,
+                              MemoryBudgetExceededError, ServeError,
+                              ServeOverloadError, TokenDeadlineExceededError)
 from .batcher import DynamicBatcher, ServeRequest
+from .decode import (DecodeEngine, DecodeService, lm_loader,
+                     load_lm_params, save_lm_params)
 from .engine import PredictEngine
-from .registry import ModelRegistry, load_model_params
+from .registry import (MemoryBudgeter, ModelRegistry, MultiModelRegistry,
+                       load_model_params)
 
 __all__ = ['PredictEngine', 'DynamicBatcher', 'ServeRequest',
-           'ModelRegistry', 'load_model_params', 'ServeError',
-           'ServeOverloadError', 'DeadlineExceededError']
+           'ModelRegistry', 'MultiModelRegistry', 'MemoryBudgeter',
+           'load_model_params', 'DecodeEngine', 'DecodeService',
+           'save_lm_params', 'load_lm_params', 'lm_loader', 'ServeError',
+           'ServeOverloadError', 'DeadlineExceededError',
+           'TokenDeadlineExceededError', 'DecodeSlotsExhaustedError',
+           'DecodePagesExhaustedError', 'MemoryBudgetExceededError']

@@ -46,12 +46,19 @@ __all__ = ['DynamicBatcher', 'ServeRequest']
 
 class ServeRequest:
     """One in-flight request: payload rows in, scores (or a typed error)
-    out, with a completion event the client blocks on."""
+    out, with a completion event the client blocks on.
+
+    ``meta`` carries per-request options for engines that need more than
+    rows — the decode engine (serve/decode.py) reads ``max_new`` /
+    ``temperature`` / ``rng`` from it and streams emitted token ids into
+    ``tokens`` (with per-token emit times in ``token_times``) before
+    setting the completion event."""
 
     __slots__ = ('data', 'n', 't_submit', 'deadline', 'deadline_abs',
-                 'event', 'result', 'error', 'abandoned')
+                 'event', 'result', 'error', 'abandoned', 'meta',
+                 'tokens', 'token_times')
 
-    def __init__(self, data: np.ndarray, deadline: float):
+    def __init__(self, data: np.ndarray, deadline: float, meta=None):
         self.data = data
         self.n = int(data.shape[0])
         self.t_submit = time.monotonic()
@@ -60,6 +67,9 @@ class ServeRequest:
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        self.meta = meta or {}
+        self.tokens: list = []          # incremental decode emissions
+        self.token_times: list = []
         # set by wait() when the caller gave up: the worker drops the
         # request at pop time (best-effort — a request already mid-batch
         # still executes) instead of burning a forward nobody reads, and
@@ -85,6 +95,10 @@ class DynamicBatcher:
         self.max_wait = float(max_wait)
         self.deadline = float(deadline)
         self.max_batch = int(engine.buckets[-1])
+        # engines that own request completion (the decode engine admits
+        # requests into slots and finishes them from its own loop) expose
+        # execute_requests; the default predict path stays synchronous
+        self._exec = getattr(engine, 'execute_requests', None)
         self.stats = stats if stats is not None else StatSet()
         self._q: Deque[ServeRequest] = collections.deque()
         self._cond = threading.Condition()
@@ -96,7 +110,8 @@ class DynamicBatcher:
 
     # -- client side -------------------------------------------------------
     def submit_async(self, data: np.ndarray,
-                     deadline: Optional[float] = None) -> ServeRequest:
+                     deadline: Optional[float] = None,
+                     meta=None) -> ServeRequest:
         """Enqueue a request; returns immediately.  Raises
         ``ServeOverloadError`` when the queue is full and ``ServeError``
         after ``close()`` — admission control never blocks."""
@@ -104,7 +119,7 @@ class DynamicBatcher:
         if data.ndim < 2:
             raise ValueError('request must be (n, ...) with a row axis')
         req = ServeRequest(data, self.deadline if deadline is None
-                           else deadline)
+                           else deadline, meta=meta)
         with self._cond:
             if self._closed:
                 raise ServeError('batcher is closed')
@@ -174,6 +189,37 @@ class DynamicBatcher:
         return batch
 
     def _execute(self, batch: List[ServeRequest]) -> None:
+        # the coalescing window just closed: a request whose deadline
+        # already passed while it waited must not ride the batch — a
+        # stale answer wastes a forward (or a decode slot) nobody will
+        # read.  Shed it here, counted as a deadline miss, not forwarded
+        # (an abandoned request was already counted on the caller side).
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.abandoned:
+                r.event.set()
+            elif now >= r.deadline_abs:
+                self._expire(r, now)
+            else:
+                live.append(r)
+        if not live:
+            return
+        batch = live
+        if self._exec is not None:
+            # engine-owned completion (decode): admission into slots may
+            # block per-request; errors land per request inside the
+            # engine, but a non-request fault must not kill the worker
+            try:
+                self._exec(batch)
+                self.stats.observe('coalesced', len(batch))
+            except BaseException as e:
+                self.stats.inc('engine_errors')
+                for r in batch:
+                    if not r.event.is_set():
+                        r.error = e
+                        r.event.set()
+            return
         rows = sum(r.n for r in batch)
         try:
             # the concat stays inside the try: a shape-mismatched request

@@ -1,0 +1,736 @@
+"""Continuous-batching decode engine over a paged KV cache.
+
+PR 2's serving stack covers fixed-shape predict; this module opens the
+autoregressive path (doc/serving.md "Continuous decode").  The design
+goal is the one μ-cuDNN teaches for training applied at serving time:
+the work granularity per step — here, WHICH requests ride each decode
+step — sets utilization, so the decode loop is ONE persistent compiled
+program that requests join and leave at token boundaries:
+
+* **slots** — the compiled step advances a fixed number of request
+  slots at once (inactive slots compute into a scratch page and are
+  ignored).  A request admitted by the ``DynamicBatcher`` joins a free
+  slot at the next token boundary, emits tokens incrementally, and
+  leaves on EOS / horizon / deadline — the program never retraces as
+  traffic changes,
+* **paged KV cache** — K/V live in a fixed pool of fixed-size pages;
+  each slot holds a page table mapping its logical cache positions to
+  physical pages.  Pages are allocated on demand as a stream grows and
+  freed the moment it ends, so memory scales with *live tokens*, not
+  ``slots × horizon``.  When the pool runs dry the youngest stream is
+  preempted with a typed ``DecodePagesExhaustedError`` carrying its
+  token-level progress,
+* **bitwise-twin discipline** — per-request sampling RNG is derived
+  exactly as ``transformer.generate`` derives it
+  (``jax.random.split(rng, max_new + 1)``; pick *n* uses key *n*), the
+  prefill and per-token step run through the SAME module functions
+  (``transformer.prefill_kv`` / ``transformer.decode_step``), and the
+  paged pool gathers into the same dense cache layout before attending
+  — so every request's token stream equals an offline
+  ``transformer.generate`` call with the same seed, no matter when it
+  joined the running loop or who shared its steps.
+
+The attention itself gathers each slot's pages into a dense (T, heads,
+hd) view per step — the page pool is the memory *ledger*; a fused
+flash-decode kernel that reads pages in place is the planned Pallas
+tier (ROADMAP item 4).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import transformer as T
+from ..runtime.faults import (DeadlineExceededError, DecodePagesExhaustedError,
+                              DecodeSlotsExhaustedError, ServeError,
+                              TokenDeadlineExceededError)
+from ..utils.metric import StatSet
+
+__all__ = ['DecodeEngine', 'DecodeService', 'save_lm_params',
+           'load_lm_params', 'lm_loader', 'LM_PATTERN']
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class _Slot:
+    """Host-side record of one occupied decode slot."""
+
+    __slots__ = ('req', 's0b', 'w', 'pos', 'kidx', 'last_tok', 'temp',
+                 'keys', 'max_new', 'join_seq', 'last_emit')
+
+    def __init__(self, req, s0b, w, tok0, keys, temp, max_new, join_seq):
+        self.req = req
+        self.s0b = int(s0b)
+        self.w = int(w)
+        self.pos = int(s0b)       # next cache position to write
+        self.kidx = 1             # next sampling key index (tok0 used 0)
+        self.last_tok = int(tok0)
+        self.temp = float(temp)
+        self.keys = keys          # (max_new + 1, 2) uint32
+        self.max_new = int(max_new)
+        self.join_seq = int(join_seq)
+        self.last_emit = time.monotonic()
+
+
+class DecodeEngine:
+    """Slot-based continuous decode over a paged KV pool.
+
+    ``params``/``cfg`` are a ``models.transformer`` tree and config
+    (single-device; ``cfg.causal`` required).  ``slots`` is the width of
+    the persistent compiled step; ``pages``/``page_size`` size the
+    physical KV pool (page 0 is a scratch page for idle slots, so
+    ``pages - 1`` are allocatable); ``max_prompt``/``max_new_bound``
+    bound one request's horizon and fix the slot cache length ``T``
+    (page-aligned).  ``eos_id`` is engine-wide (it is baked into the
+    compiled step, exactly as ``generate`` bakes it per program).
+
+    Requests arrive through :meth:`execute_requests` (the
+    ``DynamicBatcher`` hands over each coalesced batch — the engine owns
+    completion) or :meth:`submit_direct`.  Per request ``meta``:
+    ``max_new`` (default ``max_new_bound``), ``temperature`` (0 =
+    greedy), ``rng`` (a jax PRNG key or int seed; required when
+    sampling).  Emitted token ids stream into ``req.tokens`` as they are
+    picked; ``req.result`` is the final int32 array.  A stream ends at
+    its first EOS — the offline twin keeps emitting EOS after it, so
+    equality is prefix + implied-EOS tail.
+    """
+
+    def __init__(self, params, cfg, *, slots: int = 4, pages: int = 64,
+                 page_size: int = 16, max_prompt: int = 64,
+                 max_new_bound: int = 64, eos_id: Optional[int] = None,
+                 stats: Optional[StatSet] = None, name: str = 'lm'):
+        if not cfg.causal:
+            raise ValueError('DecodeEngine requires a causal config')
+        if slots < 1 or pages < 2 or page_size < 1:
+            raise ValueError('need slots >= 1, pages >= 2 (page 0 is '
+                             'scratch), page_size >= 1')
+        self.cfg = cfg
+        self.name = name
+        self.slots = int(slots)
+        self.page_size = int(page_size)
+        self.n_pages = int(pages)
+        self.max_prompt = int(max_prompt)
+        self.max_new_bound = int(max_new_bound)
+        self.eos_id = eos_id
+        self.stats = stats if stats is not None else StatSet()
+        horizon = T._size_class(self.max_prompt, floor=8) + max_new_bound
+        self.pages_per_slot = _ceil_div(horizon, self.page_size)
+        self.cache_len = self.pages_per_slot * self.page_size   # T
+        hd = cfg.d_model // cfg.num_heads
+        pool_shape = (cfg.num_stages, self.n_pages, self.page_size,
+                      cfg.num_heads, hd)
+        self._kpool = jax.device_put(np.zeros(pool_shape, cfg.dtype))
+        self._vpool = jax.device_put(np.zeros(pool_shape, cfg.dtype))
+        # physical page 0 is scratch: idle slots write there, nobody reads
+        self._free_pages: List[int] = list(range(self.n_pages - 1, 0, -1))
+        self._table = np.zeros((self.slots, self.pages_per_slot), np.int32)
+        self._slots: List[Optional[_Slot]] = [None] * self.slots
+        self._joinq: collections.deque = collections.deque()
+        self._admitting = 0       # reservations between admit and join
+        self._join_seq = 0
+        self._cond = threading.Condition()
+        self._closed = False
+        self._params = self.place_params(params)
+        self._params_treedef = jax.tree.structure(self._params)
+        self._params_shapes = [(tuple(l.shape), l.dtype)
+                               for l in jax.tree.leaves(self._params)]
+        self._pending_params = None
+        self._pending_version = None
+        self.version: object = 0
+        self.swap_count = 0
+        self._prefill_fns: collections.OrderedDict = collections.OrderedDict()
+        self._write_fns: dict = {}
+        self._step = self._build_step()
+        self._pick1 = jax.jit(self._pick_one)
+        self._loop = threading.Thread(target=self._run, daemon=True,
+                                      name=f'cxxnet-decode-{name}')
+        self._loop.start()
+
+    # -- compiled programs -------------------------------------------------
+    @staticmethod
+    def _pick_one(logits, key, temp):
+        """Traced-temperature pick for ONE request (prefill's first
+        token): same categorical/argmax math as ``generate``'s static-
+        temperature pick — identical operand values give identical
+        draws, so one program covers every request temperature."""
+        safe = jnp.where(temp > 0, temp, jnp.float32(1.0))
+        sampled = jax.random.categorical(key, logits / safe, axis=-1)
+        return jnp.where(temp > 0, sampled,
+                         jnp.argmax(logits, axis=-1)).astype(jnp.int32)
+
+    def _build_step(self):
+        cfg = self.cfg
+        S, ps, pp = self.slots, self.page_size, self.pages_per_slot
+        Tlen = self.cache_len
+        hd = cfg.d_model // cfg.num_heads
+
+        def step(params, kpool, vpool, table, pos, w, tok, r, temp):
+            # gather each slot's pages into the dense cache layout the
+            # shared decode_step math expects (gather is an exact copy:
+            # the paged-vs-dense twin test pins this bitwise)
+            st = kpool.shape[0]
+            kc = kpool[:, table].reshape(st, S, Tlen, cfg.num_heads, hd)
+            vc = vpool[:, table].reshape(st, S, Tlen, cfg.num_heads, hd)
+            logits, _, _, knew, vnew = T.decode_step(
+                params, cfg, tok, kc, vc, pos, w)
+            # scatter only the newly written rows back into the pool
+            page = table[jnp.arange(S), pos // ps]
+            off = pos % ps
+            si = jnp.arange(st)[:, None]
+            kpool = kpool.at[si, page[None, :], off[None, :]].set(knew)
+            vpool = vpool.at[si, page[None, :], off[None, :]].set(vnew)
+            greedy = jnp.argmax(logits, axis=-1)
+            safe = jnp.where(temp > 0, temp, jnp.float32(1.0))
+            # per-slot keys, per-slot draws: bitwise the same stream the
+            # offline b=1 generate pulls from the same key schedule
+            sampled = jax.vmap(
+                lambda k_, lg, t_: jax.random.categorical(
+                    k_, lg / t_, axis=-1))(r, logits, safe)
+            nxt = jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
+            return kpool, vpool, nxt
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _prefill_fn(self, s0b: int):
+        fn = self._prefill_fns.get(s0b)
+        if fn is None:
+            self.stats.inc('prefill_programs')   # retrace visibility
+            cfg = self.cfg
+            fn = jax.jit(lambda params, prompt, w:
+                         T.prefill_kv(params, prompt, w, cfg))
+            self._prefill_fns[s0b] = fn
+            # same LRU bound (and env knob) as generate's program cache
+            while len(self._prefill_fns) > T._gen_cache_max():
+                self._prefill_fns.popitem(last=False)
+        else:
+            self._prefill_fns.move_to_end(s0b)
+        return fn
+
+    def _write_fn(self, n_pages: int, s0b: int):
+        """Jitted prompt-K/V scatter into ``n_pages`` physical pages."""
+        key = (n_pages, s0b)
+        fn = self._write_fns.get(key)
+        if fn is None:
+            ps = self.page_size
+
+            def write(kpool, vpool, ks, vs, pages):
+                st = kpool.shape[0]
+                pad = n_pages * ps - s0b
+                shaped = []
+                for arr in (ks, vs):
+                    a = arr[:, 0]                      # (stages, s0b, H, hd)
+                    a = jnp.pad(a, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                    shaped.append(a.reshape(st, n_pages, ps,
+                                            a.shape[-2], a.shape[-1]))
+                kpool = kpool.at[:, pages].set(shaped[0])
+                vpool = vpool.at[:, pages].set(shaped[1])
+                return kpool, vpool
+
+            fn = self._write_fns[key] = jax.jit(write,
+                                                donate_argnums=(0, 1))
+        return fn
+
+    # -- parameters (PredictEngine-compatible surface) ---------------------
+    @property
+    def params(self):
+        return self._params
+
+    def _check_tree(self, params) -> None:
+        if jax.tree.structure(params) != self._params_treedef:
+            raise ValueError('swap_params: param tree structure differs '
+                             'from the serving model')
+        for leaf, (shape, dtype) in zip(jax.tree.leaves(params),
+                                        self._params_shapes):
+            if tuple(leaf.shape) != shape or leaf.dtype != dtype:
+                raise ValueError(
+                    f'swap_params: leaf {tuple(leaf.shape)}/{leaf.dtype} '
+                    f'!= serving {shape}/{dtype} — a shape change needs '
+                    'a new engine, not a hot swap')
+
+    def place_params(self, host_params):
+        if getattr(self, '_params_treedef', None) is not None:
+            self._check_tree(host_params)
+        return jax.tree.map(
+            lambda h: h if isinstance(h, jax.Array)
+            else jax.device_put(np.asarray(h)), host_params)
+
+    def warm_params(self, params) -> None:
+        placed = self.place_params(params)
+        jax.block_until_ready(jax.tree.leaves(placed))
+
+    def swap_params(self, params, version: object = None) -> None:
+        """Hot-swap with DRAIN semantics: in-flight streams finish on
+        the params they started with (one compiled step takes one tree —
+        mixing versions inside a step is impossible by construction);
+        new admissions wait, join under the new tree once the last
+        pre-swap stream leaves.  Zero requests are dropped.  Blocks
+        until the swap is applied."""
+        placed = self.place_params(params)
+        with self._cond:
+            if self._closed:
+                raise ServeError('decode engine is closed')
+            while self._pending_params is not None:
+                self._cond.wait(0.05)
+            self._pending_params = placed
+            self._pending_version = version
+            self._cond.notify_all()
+            while self._pending_params is not None and not self._closed:
+                self._cond.wait(0.05)
+
+    def resident_bytes(self) -> int:
+        """Device-memory ledger entry for the budgeter: params + pool."""
+        n = sum(l.nbytes for l in jax.tree.leaves(self._params))
+        return int(n + self._kpool.nbytes + self._vpool.nbytes)
+
+    def busy(self) -> bool:
+        with self._cond:
+            return (any(s is not None for s in self._slots)
+                    or bool(self._joinq) or self._admitting > 0)
+
+    # -- admission ---------------------------------------------------------
+    @property
+    def buckets(self):
+        """DynamicBatcher protocol: coalesce at most ``slots`` requests
+        (one row each) per window."""
+        return (self.slots,)
+
+    def execute_requests(self, batch) -> None:
+        """Batcher hand-off: admit each coalesced request into a slot
+        (blocking for capacity up to its deadline).  The engine owns
+        completion — per-request errors land on the request, never the
+        worker."""
+        for req in batch:
+            try:
+                self._admit(req)
+            except BaseException as e:  # typed per-request outcome
+                if isinstance(e, DeadlineExceededError):
+                    self.stats.inc('expired')
+                elif isinstance(e, (DecodeSlotsExhaustedError,
+                                    DecodePagesExhaustedError)):
+                    self.stats.inc('shed_inadmissible')
+                else:
+                    self.stats.inc('engine_errors')
+                req.error = e
+                req.event.set()
+
+    def submit_direct(self, prompt, max_new: int = None,
+                      temperature: float = 0.0, rng=None,
+                      deadline: float = 30.0):
+        """Batcher-less admission (tests / embedding without a queue):
+        returns the ``ServeRequest``; wait on ``req.event``."""
+        from .batcher import ServeRequest
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        req = ServeRequest(prompt, deadline,
+                           meta={'max_new': max_new,
+                                 'temperature': temperature, 'rng': rng})
+        self.execute_requests([req])
+        return req
+
+    def _admit(self, req) -> None:
+        prompt = np.asarray(req.data, np.int32)
+        if prompt.ndim != 2 or prompt.shape[0] != 1 or prompt.shape[1] < 1:
+            raise ValueError('decode request payload must be one prompt '
+                             'row: (1, s0) int tokens')
+        s0 = prompt.shape[1]
+        meta = req.meta or {}
+        raw = meta.get('max_new')
+        max_new = self.max_new_bound if raw is None else int(raw)
+        temp = float(meta.get('temperature') or 0.0)
+        rng = meta.get('rng')
+        if max_new < 1:
+            raise ValueError('max_new must be >= 1')
+        if temp > 0 and rng is None:
+            raise ValueError('temperature>0 sampling needs an rng key')
+        if os.environ.get('CXXNET_GEN_BUCKETS', '1') != '0':
+            s0b = T._size_class(s0, floor=8)
+        else:
+            s0b = s0
+        w = s0b - s0
+        if max_new > self.max_new_bound:
+            raise DecodeSlotsExhaustedError(
+                f'max_new={max_new} > engine bound {self.max_new_bound}')
+        if s0b + max_new - 1 > self.cache_len:
+            raise DecodeSlotsExhaustedError(
+                f'prompt bucket {s0b} + max_new {max_new} exceeds the '
+                f'slot cache ({self.cache_len} positions)')
+        total_pages = (s0b + max_new - 2) // self.page_size + 1 \
+            if max_new >= 2 else _ceil_div(s0b, self.page_size)
+        if total_pages > min(self.pages_per_slot, self.n_pages - 1):
+            raise DecodeSlotsExhaustedError(
+                f'request needs {total_pages} KV pages; the pool can '
+                f'offer at most {min(self.pages_per_slot, self.n_pages - 1)}')
+        n_prompt = _ceil_div(s0b, self.page_size)
+        # reserve the prompt pages plus the first decode position's page
+        # now; later pages allocate on demand as the stream grows
+        n0 = (s0b // self.page_size + 1) if max_new >= 2 else n_prompt
+        # --- reserve capacity (blocks; bounded by the request deadline)
+        with self._cond:
+            while True:
+                if self._closed:
+                    raise ServeError('decode engine is closed')
+                if (self._pending_params is None
+                        and any(s is None for s in self._slots)
+                        and len(self._free_pages) >= n0):
+                    break
+                remaining = req.deadline_abs - time.monotonic()
+                if remaining <= 0:
+                    raise DeadlineExceededError(
+                        req.deadline, time.monotonic() - req.t_submit, 1)
+                self._cond.wait(min(remaining, 0.05))
+            sid = self._slots.index(None)
+            self._slots[sid] = 'RESERVED'          # placeholder
+            pages = [self._free_pages.pop() for _ in range(n0)]
+            self._admitting += 1
+            params = self._params
+            seq = self._join_seq
+            self._join_seq += 1
+        try:
+            # --- RNG schedule: exactly generate()'s derivation
+            if temp > 0:
+                key = (jax.random.PRNGKey(rng) if isinstance(rng, int)
+                       else rng)
+                keys = np.asarray(jax.random.split(key, max_new + 1))
+            else:
+                keys = np.zeros((max_new + 1, 2), np.uint32)
+            # --- prefill off the loop thread (joins stay token-aligned)
+            padded = np.pad(prompt, ((0, 0), (w, 0)))
+            ks, vs, logits0 = self._prefill_fn(s0b)(
+                params, padded, np.int32(w))
+            tok0 = int(self._pick1(logits0[0],
+                                   jax.numpy.asarray(keys[0]),
+                                   np.float32(temp)))
+            now = time.monotonic()
+            req.tokens.append(tok0)
+            req.token_times.append(now)
+            self.stats.inc('tokens')
+            done0 = self.eos_id is not None and tok0 == self.eos_id
+            with self._cond:
+                if done0 or max_new == 1:
+                    self._slots[sid] = None
+                    self._free_pages.extend(pages)
+                    self._finish(req)
+                else:
+                    self._joinq.append(
+                        {'sid': sid, 'pages': pages, 'n_prompt': n_prompt,
+                         's0b': s0b, 'w': w, 'ks': ks, 'vs': vs,
+                         'tok0': tok0, 'keys': keys, 'temp': temp,
+                         'max_new': max_new, 'req': req, 'seq': seq})
+                    self.stats.inc('joined')
+                self._admitting -= 1
+                self._cond.notify_all()
+        except BaseException:
+            with self._cond:
+                self._slots[sid] = None
+                self._free_pages.extend(pages)
+                self._admitting -= 1
+                self._cond.notify_all()
+            raise
+
+    # -- the decode loop ---------------------------------------------------
+    def _finish(self, req, error: Optional[BaseException] = None) -> None:
+        """Complete a request (slot bookkeeping already done)."""
+        if error is not None:
+            req.error = error
+        else:
+            req.result = np.asarray(req.tokens, np.int32)
+            self.stats.inc('completed')
+            self.stats.observe('stream_len', len(req.tokens))
+        req.event.set()
+
+    def _free_slot(self, sid: int) -> None:
+        """Return a slot's pages to the pool (caller holds the lock)."""
+        row = self._table[sid]
+        self._free_pages.extend(int(p) for p in row[row != 0])
+        row[:] = 0
+        self._slots[sid] = None
+        self._cond.notify_all()
+
+    def _integrate_joins(self) -> None:
+        """Token boundary: splice every admitted request into its slot
+        (caller holds the lock; pool writes release it per join)."""
+        while self._joinq:
+            j = self._joinq.popleft()
+            sid = j['sid']
+            self._table[sid, :len(j['pages'])] = j['pages']
+            wfn = self._write_fn(j['n_prompt'], j['s0b'])
+            self._kpool, self._vpool = wfn(
+                self._kpool, self._vpool, j['ks'], j['vs'],
+                np.asarray(j['pages'][:j['n_prompt']], np.int32))
+            self._slots[sid] = _Slot(j['req'], j['s0b'], j['w'],
+                                     j['tok0'], j['keys'], j['temp'],
+                                     j['max_new'], j['seq'])
+
+    def _expire_slots(self, now: float) -> None:
+        for sid, slot in enumerate(self._slots):
+            if not isinstance(slot, _Slot):
+                continue
+            if now >= slot.req.deadline_abs:
+                self.stats.inc('expired')
+                self.stats.inc('tokens_shed',
+                               slot.max_new - len(slot.req.tokens))
+                err = TokenDeadlineExceededError(
+                    slot.req.deadline, now - slot.req.t_submit,
+                    len(slot.req.tokens))
+                req = slot.req
+                self._free_slot(sid)
+                self._finish(req, err)
+
+    def _alloc_step_pages(self) -> None:
+        """On-demand page allocation for every slot about to write into
+        an unmapped logical page; pool-dry sheds the youngest stream."""
+        order = sorted((s.join_seq, sid) for sid, s in
+                       enumerate(self._slots) if isinstance(s, _Slot))
+        for _seq, sid in order:
+            slot = self._slots[sid]
+            if not isinstance(slot, _Slot):
+                continue            # shed as a victim earlier this pass
+            lp = slot.pos // self.page_size
+            if self._table[sid, lp] != 0:
+                continue
+            while not self._free_pages:
+                victims = [(s.join_seq, vid) for vid, s in
+                           enumerate(self._slots) if isinstance(s, _Slot)]
+                vseq, vid = max(victims)
+                vslot = self._slots[vid]
+                self.stats.inc('shed_pages')
+                self.stats.inc('tokens_shed',
+                               vslot.max_new - len(vslot.req.tokens))
+                err = DecodePagesExhaustedError(
+                    len(vslot.req.tokens), self.n_pages - 1)
+                vreq = vslot.req
+                self._free_slot(vid)
+                self._finish(vreq, err)
+                if vid == sid:
+                    break
+            if isinstance(self._slots[sid], _Slot):
+                self._table[sid, lp] = self._free_pages.pop()
+
+    def _run(self) -> None:
+        """Decode-loop thread body; a non-request fault (trace error,
+        device loss) fails every in-flight stream with the error instead
+        of stranding clients until their deadlines."""
+        try:
+            self._run_inner()
+        except BaseException as e:  # noqa: BLE001 — loop must not vanish
+            from ..runtime import faults
+            faults.global_failure_log().record(
+                'decode_loop_error', f'decode loop died: {e!r}')
+            with self._cond:
+                self._closed = True
+                for sid, slot in enumerate(self._slots):
+                    if isinstance(slot, _Slot):
+                        req = slot.req
+                        self._free_slot(sid)
+                        self._finish(req, ServeError(
+                            f'decode loop failed: {e!r}'))
+                while self._joinq:
+                    j = self._joinq.popleft()
+                    self._finish(j['req'], ServeError(
+                        f'decode loop failed: {e!r}'))
+                self._cond.notify_all()
+
+    def _run_inner(self) -> None:
+        S = self.slots
+        while True:
+            with self._cond:
+                while True:
+                    self._expire_slots(time.monotonic())
+                    # joins first: anything admitted before a pending
+                    # swap belongs to the old params' in-flight set
+                    self._integrate_joins()
+                    live = any(isinstance(s, _Slot) for s in self._slots)
+                    if (self._pending_params is not None and not live
+                            and not self._joinq and self._admitting == 0):
+                        self._params = self._pending_params
+                        if self._pending_version is not None:
+                            self.version = self._pending_version
+                        self._pending_params = None
+                        self.swap_count += 1
+                        self._cond.notify_all()
+                        continue
+                    if live:
+                        break
+                    if (self._closed and not self._joinq
+                            and self._admitting == 0):
+                        return
+                    self._cond.wait(0.05)
+                self._alloc_step_pages()
+                if not any(isinstance(s, _Slot) for s in self._slots):
+                    continue        # every stream was shed this pass
+                params = self._params
+                table = np.array(self._table)
+                pos = np.zeros(S, np.int32)
+                w = np.zeros(S, np.int32)
+                tok = np.zeros(S, np.int32)
+                temp = np.zeros(S, np.float32)
+                r = np.zeros((S, 2), np.uint32)
+                stepped = []
+                for sid, slot in enumerate(self._slots):
+                    if isinstance(slot, _Slot):
+                        pos[sid] = slot.pos
+                        w[sid] = slot.w
+                        tok[sid] = slot.last_tok
+                        temp[sid] = slot.temp
+                        r[sid] = slot.keys[slot.kidx]
+                        stepped.append(sid)
+            self._kpool, self._vpool, nxt = self._step(
+                params, self._kpool, self._vpool, table, pos, w, tok, r,
+                temp)
+            nxt = np.asarray(nxt)
+            now = time.monotonic()
+            self.stats.inc('decode_steps')
+            self.stats.observe('step_occupancy', len(stepped) / S)
+            with self._cond:
+                for sid in stepped:
+                    slot = self._slots[sid]
+                    if not isinstance(slot, _Slot):
+                        continue    # shed concurrently (defensive)
+                    token = int(nxt[sid])
+                    slot.req.tokens.append(token)
+                    slot.req.token_times.append(now)
+                    self.stats.inc('tokens')
+                    self.stats.observe('token_ms',
+                                       (now - slot.last_emit) * 1e3)
+                    slot.last_emit = now
+                    slot.last_tok = token
+                    slot.pos += 1
+                    slot.kidx += 1
+                    hit_eos = (self.eos_id is not None
+                               and token == self.eos_id)
+                    if hit_eos or len(slot.req.tokens) >= slot.max_new:
+                        req = slot.req
+                        self._free_slot(sid)
+                        self._finish(req)
+
+    # -- lifecycle / observability -----------------------------------------
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting; finish in-flight streams (bounded by their
+        horizons/deadlines); join the loop thread."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if threading.current_thread() is self._loop:
+            return False
+        self._loop.join(timeout)
+        return not self._loop.is_alive()
+
+    def report(self, name: Optional[str] = None) -> str:
+        """Eval-line stats snapshot; folds in the ``generate`` program-
+        cache hit/miss tallies (the serve surface for them)."""
+        gs = T.gen_cache_stats()
+        self.stats.gauge('gen_cache.hit', gs['hit'])
+        self.stats.gauge('gen_cache.miss', gs['miss'])
+        with self._cond:
+            self.stats.gauge('free_pages', len(self._free_pages))
+        return self.stats.print(name or self.name)
+
+
+# -- on-disk format for transformer param trees ----------------------------
+# ``%04d.lm`` files: an .npz of the flattened tree written through the
+# same atomic+retried+digested path as model files, so the registry's
+# verify/blacklist machinery applies unchanged to decode models.
+
+LM_PATTERN = r'^(\d+)\.lm$'
+
+
+def _flatten_tree(tree, prefix=''):
+    if isinstance(tree, dict):
+        out = {}
+        for k in sorted(tree):
+            out.update(_flatten_tree(tree[k], f'{prefix}{k}/'))
+        return out
+    return {prefix[:-1]: np.asarray(tree)}
+
+
+def save_lm_params(path: str, params, retry=None) -> str:
+    """Atomically write a transformer param tree (+ crc32 sidecar)."""
+    from ..nnet import checkpoint
+    flat = _flatten_tree(params)
+    checkpoint.save_model_file(
+        path, lambda f: np.savez(f, **flat), retry=retry)
+    checkpoint.write_model_digest(path)
+    return path
+
+
+def load_lm_params(path: str, retry=None):
+    """Read a ``save_lm_params`` file back into a nested dict tree."""
+    from ..nnet import checkpoint
+
+    def read(f):
+        z = np.load(f, allow_pickle=False)
+        return {k: z[k] for k in z.files}
+
+    flat = checkpoint.read_model_file(path, read, retry=retry)
+    tree: dict = {}
+    for key, leaf in flat.items():
+        node = tree
+        parts = key.split('/')
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return tree
+
+
+def lm_loader(engine, path: str, retry=None):
+    """Registry ``loader`` hook for decode models (the structural check
+    happens in ``engine.place_params``)."""
+    return load_lm_params(path, retry=retry)
+
+
+class DecodeService:
+    """The embeddable continuous-decode stack: admission-controlled
+    ``DynamicBatcher`` fronting a ``DecodeEngine``, sharing one StatSet
+    (the wrapper/C-ABI surface and the CLI drive both hold one of
+    these)."""
+
+    def __init__(self, params, cfg, *, slots: int = 4, pages: int = 64,
+                 page_size: int = 16, max_prompt: int = 64,
+                 max_new_bound: int = 64, eos_id: Optional[int] = None,
+                 max_queue: int = 64, max_wait: float = 0.002,
+                 deadline: float = 30.0):
+        from .batcher import DynamicBatcher
+        stats = StatSet()
+        self.engine = DecodeEngine(
+            params, cfg, slots=slots, pages=pages, page_size=page_size,
+            max_prompt=max_prompt, max_new_bound=max_new_bound,
+            eos_id=eos_id, stats=stats)
+        self.batcher = DynamicBatcher(self.engine, max_queue=max_queue,
+                                      max_wait=max_wait, deadline=deadline,
+                                      stats=stats)
+
+    def submit_async(self, prompt, max_new: int, temperature: float = 0.0,
+                     rng=None, deadline: Optional[float] = None):
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        return self.batcher.submit_async(
+            prompt, deadline=deadline,
+            meta={'max_new': max_new, 'temperature': temperature,
+                  'rng': rng})
+
+    def generate(self, prompt, max_new: int, temperature: float = 0.0,
+                 rng=None, deadline: Optional[float] = None) -> np.ndarray:
+        """Submit one prompt and block for its full token stream."""
+        req = self.submit_async(prompt, max_new, temperature, rng,
+                                deadline)
+        self.batcher.wait(req)
+        return req.result
+
+    def report(self, name: str = 'decode') -> str:
+        return self.engine.report(name)
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        self.batcher.close(timeout)
+        self.engine.close(timeout)

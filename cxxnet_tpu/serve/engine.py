@@ -79,6 +79,7 @@ class PredictEngine:
         self.compile_count = 0
         self.swap_count = 0
         self.version: object = 0
+        self._inflight = 0      # forwards mid-execution (budgeter: busy())
         self._params = trainer.params
         self._params_treedef = jax.tree.structure(self._params)
         self._params_shapes = [(l.shape, l.dtype)
@@ -175,6 +176,17 @@ class PredictEngine:
         with self._lock:
             return self._params
 
+    # -- fleet accounting (serve/registry.py MultiModelRegistry) -----------
+    def resident_bytes(self) -> int:
+        """Device bytes this engine keeps resident (its param tree) —
+        the multi-model budgeter's ledger entry."""
+        return int(sum(l.nbytes for l in jax.tree.leaves(self._params)))
+
+    def busy(self) -> bool:
+        """True while a forward is executing: the budgeter must never
+        evict the model that is serving right now."""
+        return self._inflight > 0
+
     # -- prediction --------------------------------------------------------
     def _put(self, data: np.ndarray):
         if data.dtype != np.float32:
@@ -205,10 +217,16 @@ class PredictEngine:
         n = data.shape[0]
         params = self._snapshot()
         outs: List[np.ndarray] = []
-        for off, take, bucket in chunk_plan(n, self.buckets):
-            chunk = pad_rows(data[off:off + take], bucket)
-            out = self._fwd(params, self._put(chunk))
-            outs.append(np.asarray(out, np.float32)[:take])
+        with self._lock:
+            self._inflight += 1
+        try:
+            for off, take, bucket in chunk_plan(n, self.buckets):
+                chunk = pad_rows(data[off:off + take], bucket)
+                out = self._fwd(params, self._put(chunk))
+                outs.append(np.asarray(out, np.float32)[:take])
+        finally:
+            with self._lock:
+                self._inflight -= 1
         if not outs:
             return np.empty((0, 1), np.float32)
         scores = np.concatenate(outs, axis=0)

@@ -32,15 +32,47 @@ import os
 import re
 import struct
 import threading
-from typing import Callable, List, Optional, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..nnet import checkpoint
 from ..nnet.net_config import NetConfig
 from ..runtime import faults
 
-__all__ = ['ModelRegistry', 'load_model_params']
+__all__ = ['ModelRegistry', 'MultiModelRegistry', 'MemoryBudgeter',
+           'load_model_params', 'newest_model_file', 'load_into_trainer']
 
 _MODEL_RE = re.compile(r'^(\d+)\.model$')
+
+
+def newest_model_file(model_dir: str,
+                      pattern=None) -> Optional[Tuple[int, str]]:
+    """Highest-counter model file in ``model_dir`` as ``(counter, path)``
+    (None when none match) — the one scan every fleet factory and the
+    registry share."""
+    rx = _MODEL_RE if pattern is None else re.compile(pattern)
+    best: Optional[Tuple[int, str]] = None
+    try:
+        names = os.listdir(os.fspath(model_dir))
+    except OSError:
+        return None
+    for name in names:
+        m = rx.match(name)
+        if m and (best is None or int(m.group(1)) > best[0]):
+            best = (int(m.group(1)), os.path.join(model_dir, name))
+    return best
+
+
+def load_into_trainer(trainer, path: str, retry=None):
+    """Load a model file into ``trainer`` through the retried reader
+    (skipping the net_type prefix) — the fleet factories' load path."""
+
+    def _read(f):
+        f.read(4)
+        trainer.load_model(f)
+
+    checkpoint.read_model_file(path, _read, retry=retry)
+    return trainer
 
 
 def load_model_params(engine, path: str, retry=None):
@@ -87,7 +119,10 @@ class ModelRegistry:
     def __init__(self, engine, model_dir: str, poll_interval: float = 1.0,
                  current: int = -1, retry: Optional[faults.RetryPolicy] = None,
                  log: Optional[faults.FailureLog] = None,
-                 on_swap: Optional[Callable[[int, str], None]] = None):
+                 on_swap: Optional[Callable[[int, str], None]] = None,
+                 pattern: Optional[str] = None,
+                 loader: Optional[Callable] = None,
+                 attempts: Optional[dict] = None):
         self.engine = engine
         self.model_dir = os.fspath(model_dir)
         self.poll_interval = float(poll_interval)
@@ -95,8 +130,15 @@ class ModelRegistry:
         self.retry = faults.DEFAULT_IO_RETRY if retry is None else retry
         self.log = faults.global_failure_log() if log is None else log
         self.on_swap = on_swap
+        # ``pattern``/``loader`` generalize the registry beyond NetTrainer
+        # model files: decode models watch ``%04d.lm`` trees through the
+        # same verify/blacklist machinery (serve/decode.py lm_loader).
+        self._re = _MODEL_RE if pattern is None else re.compile(pattern)
+        self._loader = load_model_params if loader is None else loader
         self.transitions: List[Tuple[str, str]] = []
-        self._attempts: dict = {}          # counter -> failed poll cycles
+        # counter -> failed poll cycles; a MultiModelRegistry passes a
+        # shared dict so the blacklist survives evict/reload cycles
+        self._attempts: dict = {} if attempts is None else attempts
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -126,7 +168,7 @@ class ModelRegistry:
         except OSError:
             return out
         for name in names:
-            m = _MODEL_RE.match(name)
+            m = self._re.match(name)
             if m and int(m.group(1)) > self.current:
                 out.append((int(m.group(1)),
                             os.path.join(self.model_dir, name)))
@@ -157,8 +199,8 @@ class ModelRegistry:
                 if reason:
                     raise faults.CheckpointCorruptError(f'{path}: {reason}')
                 self._note('LOADING', path)
-                params = load_model_params(self.engine, path,
-                                           retry=self.retry)
+                params = self._loader(self.engine, path,
+                                      retry=self.retry)
                 self._note('WARMING', path)
                 placed = self.engine.place_params(params)
                 self.engine.warm_params(placed)
@@ -205,3 +247,286 @@ class ModelRegistry:
             return True
         t.join(timeout)
         return not t.is_alive()
+
+
+# --- multi-model fleet ----------------------------------------------------
+
+
+class MemoryBudgeter:
+    """Device-memory ledger for a fleet of serving models.
+
+    Tracks per-model resident bytes against a budget (0 = unbounded).
+    It does not free anything itself — :class:`MultiModelRegistry` asks
+    it who is over budget and evicts; the split keeps the accounting
+    unit-testable without engines."""
+
+    def __init__(self, budget_bytes: int = 0):
+        self.budget = int(budget_bytes)
+        self._resident: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def account(self, model_id: str, nbytes: int) -> None:
+        with self._lock:
+            self._resident[model_id] = int(nbytes)
+
+    def release(self, model_id: str) -> int:
+        with self._lock:
+            return self._resident.pop(model_id, 0)
+
+    def usage(self) -> int:
+        with self._lock:
+            return sum(self._resident.values())
+
+    def resident(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._resident)
+
+    def over_budget(self) -> int:
+        """Bytes past the budget (0 when inside it or unbounded)."""
+        if self.budget <= 0:
+            return 0
+        return max(0, self.usage() - self.budget)
+
+
+class _ManagedModel:
+    """One fleet entry: how to build its engine, where its checkpoints
+    live, and its load/eviction state."""
+
+    __slots__ = ('model_id', 'factory', 'engine', 'model_dir', 'pattern',
+                 'loader', 'current', 'attempts', 'registry', 'last_used',
+                 'pinned', 'leases')
+
+    def __init__(self, model_id, factory, model_dir, pattern, loader,
+                 current, pinned):
+        self.model_id = model_id
+        self.factory = factory
+        self.engine = None
+        self.model_dir = model_dir
+        self.pattern = pattern
+        self.loader = loader
+        self.current = int(current)
+        self.attempts: dict = {}       # blacklist survives evictions
+        self.registry: Optional[ModelRegistry] = None
+        self.last_used = 0.0
+        self.pinned = bool(pinned)
+        self.leases = 0                # callers inside lease() blocks
+
+
+class MultiModelRegistry:
+    """N-model registry with a device-memory budgeter: one chip serves a
+    fleet of workloads (doc/serving.md "Multi-model serving").
+
+    Each model is registered with a ``factory`` (zero-arg callable
+    building its engine — a ``PredictEngine`` or ``DecodeEngine``; the
+    factory owns EVERY reference to the model's device state, so
+    evicting the entry really frees the memory) and optionally a
+    ``model_dir`` to hot-reload from (the per-model ``ModelRegistry``
+    machinery — digest verification, newest-first fallback, blacklist —
+    applied per model id; blacklists survive evict/reload cycles).
+
+    Policy:
+
+    * ``get(model_id)`` loads on demand and touches the LRU clock,
+    * after any load, models are evicted **coldest-first** (oldest
+      ``last_used``) until the ledger fits the budget — but never a
+      model that is ``busy()`` (serving in-flight work) or pinned, and
+      never the one just requested,
+    * when nothing evictable remains and the ledger still exceeds the
+      budget, the requested load is rolled back and a typed
+      ``MemoryBudgetExceededError`` is raised — overload degrades the
+      *cold* workload, never the serving one.
+    """
+
+    def __init__(self, mem_budget: int = 0, poll_interval: float = 1.0,
+                 log: Optional[faults.FailureLog] = None):
+        self.budgeter = MemoryBudgeter(mem_budget)
+        self.poll_interval = float(poll_interval)
+        self.log = faults.global_failure_log() if log is None else log
+        self._models: Dict[str, _ManagedModel] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.evictions = 0
+
+    # -- registration / loading -------------------------------------------
+    def add_model(self, model_id: str, factory: Callable,
+                  model_dir: Optional[str] = None,
+                  pattern: Optional[str] = None,
+                  loader: Optional[Callable] = None,
+                  current: int = -1, pinned: bool = False,
+                  load: bool = False) -> None:
+        with self._lock:
+            if model_id in self._models:
+                raise ValueError(f'model {model_id!r} already registered')
+            self._models[model_id] = _ManagedModel(
+                model_id, factory, model_dir, pattern, loader, current,
+                pinned)
+        if load:
+            self.get(model_id)
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def loaded(self) -> List[str]:
+        with self._lock:
+            return sorted(m for m, e in self._models.items()
+                          if e.engine is not None)
+
+    def _entry(self, model_id: str) -> _ManagedModel:
+        entry = self._models.get(model_id)
+        if entry is None:
+            raise KeyError(f'unknown model {model_id!r}')
+        return entry
+
+    def get(self, model_id: str):
+        """The serving engine for ``model_id`` — loaded on demand,
+        LRU-touched, budget enforced after a cold load.  NOTE: the
+        returned reference is only eviction-safe while the engine
+        reports ``busy()``; a caller about to run a forward should use
+        :meth:`lease` instead, which holds off eviction for the whole
+        block (``get`` alone leaves a window between returning and the
+        forward marking the engine in-flight)."""
+        with self._lock:
+            entry = self._entry(model_id)
+            if entry.engine is None:
+                self._load(entry)
+            entry.last_used = time.monotonic()
+            return entry.engine
+
+    def lease(self, model_id: str):
+        """Context manager: the engine for ``model_id``, protected from
+        eviction until the block exits — closes the get()-then-use race
+        where a concurrent cold load could evict the engine between the
+        lookup and the forward."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _leased():
+            with self._lock:
+                entry = self._entry(model_id)
+                if entry.engine is None:
+                    self._load(entry)
+                entry.last_used = time.monotonic()
+                entry.leases += 1
+                engine = entry.engine
+            try:
+                yield engine
+            finally:
+                with self._lock:
+                    entry.leases -= 1
+        return _leased()
+
+    def _load(self, entry: _ManagedModel) -> None:
+        entry.engine = entry.factory()
+        self.budgeter.account(entry.model_id,
+                              int(entry.engine.resident_bytes()))
+        if entry.model_dir is not None:
+            entry.registry = ModelRegistry(
+                entry.engine, entry.model_dir, current=entry.current,
+                pattern=entry.pattern, loader=entry.loader,
+                attempts=entry.attempts, log=self.log,
+                on_swap=lambda c, p, e=entry: setattr(e, 'current', c))
+        try:
+            self._enforce_budget(protect=entry.model_id)
+        except faults.MemoryBudgetExceededError:
+            self._evict(entry)      # roll back: the cold load loses
+            raise
+
+    def _enforce_budget(self, protect: str) -> None:
+        while self.budgeter.over_budget():
+            victims = [e for e in self._models.values()
+                       if e.engine is not None and e.model_id != protect
+                       and not e.pinned and e.leases == 0
+                       and not getattr(e.engine, 'busy', lambda: False)()]
+            if not victims:
+                resident = self.budgeter.resident()
+                raise faults.MemoryBudgetExceededError(
+                    protect, resident.get(protect, 0),
+                    self.budgeter.budget, sum(resident.values()))
+            coldest = min(victims, key=lambda e: e.last_used)
+            self._evict(coldest)
+
+    def _evict(self, entry: _ManagedModel) -> None:
+        freed = self.budgeter.release(entry.model_id)
+        if entry.registry is not None:
+            entry.registry.close(timeout=5.0)
+            entry.registry = None
+        eng = entry.engine
+        entry.engine = None
+        if eng is not None and hasattr(eng, 'close'):
+            eng.close(timeout=5.0)
+        self.evictions += 1
+        self.log.record('serve_evicted',
+                        f'model {entry.model_id!r} evicted '
+                        f'({freed} bytes freed)')
+
+    def evict(self, model_id: str) -> None:
+        """Explicitly unload a model (it reloads on next ``get``)."""
+        with self._lock:
+            entry = self._entry(model_id)
+            if entry.engine is not None:
+                self._evict(entry)
+
+    # -- hot swap ----------------------------------------------------------
+    def swap_model(self, model_id: str, host_params,
+                   version: object = None) -> None:
+        """Warm-before-swap a new param tree into a model's live engine
+        (decode engines drain in-flight streams first — zero drops)."""
+        engine = self.get(model_id)
+        placed = engine.place_params(host_params)
+        engine.warm_params(placed)
+        engine.swap_params(placed, version=version)
+
+    def poll_once(self) -> int:
+        """One reload cycle across every loaded, watched model; returns
+        the number of swaps."""
+        with self._lock:
+            regs = [e.registry for e in self._models.values()
+                    if e.registry is not None]
+        return sum(1 for r in regs if r.poll_once())
+
+    # -- watcher / observability -------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name='serve-fleet')
+        self._thread.start()
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.poll_once()
+            except Exception as e:   # watcher must outlive bad cycles
+                self.log.record('serve_reload_error',
+                                f'fleet poll failed: {e!r}')
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout)
+        with self._lock:
+            for entry in self._models.values():
+                if entry.registry is not None:
+                    entry.registry.close(timeout=timeout)
+                    entry.registry = None
+                if entry.engine is not None and hasattr(entry.engine,
+                                                        'close'):
+                    entry.engine.close(timeout)
+
+    def report(self, stats=None, name: str = 'fleet') -> str:
+        """Budget ledger in eval-line format (optionally onto a shared
+        ``StatSet``)."""
+        from ..utils.metric import StatSet
+        stats = StatSet() if stats is None else stats
+        stats.gauge('resident_bytes', self.budgeter.usage())
+        stats.gauge('budget_bytes', self.budgeter.budget)
+        stats.gauge('models_loaded', len(self.loaded()))
+        stats.gauge('models_total', len(self.models()))
+        stats.gauge('evictions', self.evictions)
+        for mid, nb in sorted(self.budgeter.resident().items()):
+            stats.gauge(f'bytes[{mid}]', nb)
+        return stats.print(name)

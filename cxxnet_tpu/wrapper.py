@@ -103,6 +103,7 @@ class Net:
         self._trainer: Optional[NetTrainer] = None
         self._engine = None     # serve.PredictEngine after serve_start
         self._batcher = None    # serve.DynamicBatcher after serve_start
+        self._fleet = None      # serve.MultiModelRegistry (models=)
 
     def _require(self) -> NetTrainer:
         if self._trainer is None:
@@ -195,10 +196,17 @@ class Net:
     # --- online serving (doc/serving.md) ----------------------------------
     def serve_start(self, buckets='1,8,32', max_queue: int = 64,
                     max_wait: float = 0.002, deadline: float = 1.0,
-                    warm: bool = True) -> None:
+                    warm: bool = True, models=None,
+                    mem_budget: int = 0) -> None:
         """Stand up the serving stack over this net's loaded params: a
         bucketed ``PredictEngine`` plus a ``DynamicBatcher``.  Call once;
-        ``serve_stop()`` tears down (and must precede a restart)."""
+        ``serve_stop()`` tears down (and must precede a restart).
+
+        ``models`` (optional) is a ``{model_id: model_dir}`` dict of
+        sibling checkpoints (same architecture as this net) served
+        through a ``MultiModelRegistry`` under ``mem_budget`` bytes —
+        route to one with ``serve_scores(..., model=id)``; cold models
+        load on demand and evict coldest-first under pressure."""
         from .serve import DynamicBatcher, PredictEngine
         from .utils.bucketing import parse_buckets
         if self._batcher is not None:
@@ -211,25 +219,66 @@ class Net:
             self._engine.warm()
         self._batcher = DynamicBatcher(self._engine, max_queue=max_queue,
                                        max_wait=max_wait, deadline=deadline)
+        self._fleet = None
+        if models:
+            from .serve import MultiModelRegistry
+            self._fleet = MultiModelRegistry(mem_budget=mem_budget)
+            for mid, mdir in dict(models).items():
+                self._fleet.add_model(
+                    mid, self._fleet_factory(mdir, bks), model_dir=mdir)
+
+    def _fleet_factory(self, model_dir: str, buckets):
+        """Factory closure for one fleet sibling: builds an isolated
+        inference-only trainer from this net's config pairs and loads the
+        newest checkpoint in ``model_dir`` through the retried reader
+        (the factory owns every reference, so eviction really frees the
+        device memory)."""
+        from .serve import PredictEngine
+        from .serve.registry import load_into_trainer, newest_model_file
+
+        def factory():
+            best = newest_model_file(model_dir)
+            if best is None:
+                raise FileNotFoundError(f'no model files in {model_dir}')
+            tr = load_into_trainer(
+                NetTrainer(self._pairs + [('inference_only', '1')]),
+                best[1])
+            return PredictEngine(tr, buckets)
+        return factory
 
     def _require_serving(self):
         if self._batcher is None:
             raise RuntimeError('call serve_start() first')
         return self._batcher
 
-    def serve_scores(self, data, deadline: Optional[float] = None) \
-            -> np.ndarray:
+    def serve_scores(self, data, deadline: Optional[float] = None,
+                     model: Optional[str] = None) -> np.ndarray:
         """Submit one request through the batcher; blocks for the final
         node's score rows.  Raises the typed serving errors
-        (``ServeOverloadError`` / ``DeadlineExceededError``)."""
+        (``ServeOverloadError`` / ``DeadlineExceededError``).
+        ``model=`` routes to a fleet sibling (engine-direct: fleet
+        models are budget-managed, not micro-batched — a cold model may
+        load first, so the path is unbounded and ``deadline`` is
+        rejected rather than silently ignored).  The fleet lease holds
+        off eviction for the whole forward."""
+        if model is not None:
+            if self._fleet is None:
+                raise RuntimeError('serve_start(models=...) first')
+            if deadline is not None:
+                raise ValueError(
+                    'deadline is not enforced on the fleet path (a cold '
+                    'model may need to load); pass deadline=None')
+            with self._fleet.lease(model) as engine:
+                return engine.predict_scores(np.asarray(data, np.float32))
         return self._require_serving().submit(
             np.asarray(data, np.float32), deadline)
 
-    def serve_predict(self, data, deadline: Optional[float] = None) \
-            -> np.ndarray:
+    def serve_predict(self, data, deadline: Optional[float] = None,
+                      model: Optional[str] = None) -> np.ndarray:
         """Like :meth:`predict` but through the serving stack (micro-
         batched with concurrent callers, bucket-padded)."""
-        return NetTrainer._pred_transform(self.serve_scores(data, deadline))
+        return NetTrainer._pred_transform(
+            self.serve_scores(data, deadline, model=model))
 
     def serve_reload(self, fname: str) -> None:
         """Manually hot-swap a checkpoint into the live engine (the
@@ -248,14 +297,21 @@ class Net:
         self._engine.swap_params(placed, version=fname)
 
     def serve_stats(self, name: str = 'serve') -> str:
-        """Per-bucket latency/throughput counters in eval-line format."""
-        return self._require_serving().report(name)
+        """Per-bucket latency/throughput counters in eval-line format
+        (+ the fleet's memory ledger when ``models=`` is serving)."""
+        out = self._require_serving().report(name)
+        if self._fleet is not None:
+            out += self._fleet.report()
+        return out
 
     def serve_stop(self, timeout: Optional[float] = None) -> None:
         """Drain and tear down the serving stack (idempotent)."""
         if self._batcher is not None:
             self._batcher.close(timeout)
             self._batcher = None
+        if self._fleet is not None:
+            self._fleet.close(timeout)
+            self._fleet = None
         self._engine = None
 
     # --- weight access (visitor equivalent) -------------------------------

@@ -51,12 +51,19 @@ def pytest_configure(config):
         'suite — worker-pool determinism, thread lifecycle, '
         'steps_per_dispatch bitwise equality; CPU-only '
         '(tier-1: runs under -m "not slow"; select with -m io_perf)')
+    config.addinivalue_line(
+        'markers',
+        'serve_decode: continuous-batching decode suite — paged KV '
+        'cache, slot join/leave, offline-generate stream twins, '
+        'multi-model budgeter; CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m serve_decode)')
 
 
 # every pipeline thread the framework starts carries a cxxnet- name
 # prefix (utils/thread_buffer.py producers, utils/parallel_pool.py
-# workers) precisely so this fixture can hold the line on lifecycle
-_PIPELINE_THREAD_PREFIXES = ('cxxnet-tb-', 'cxxnet-pool-')
+# workers, serve/decode.py loop threads) precisely so this fixture can
+# hold the line on lifecycle
+_PIPELINE_THREAD_PREFIXES = ('cxxnet-tb-', 'cxxnet-pool-', 'cxxnet-decode-')
 
 
 def _pipeline_threads():

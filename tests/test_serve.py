@@ -314,6 +314,56 @@ def test_batcher_deadline_typed_error_counted_once():
         b.close()
 
 
+def test_wrapper_fleet_routes_models_and_reports_budget(tmp_path):
+    """serve_start(models=...) stands up the multi-model fleet: requests
+    route per model id, the memory ledger prints with the stats."""
+    a_dir, b_dir = tmp_path / 'a', tmp_path / 'b'
+    a_dir.mkdir()
+    b_dir.mkdir()
+    rig_constant_class(make_net(1), cls=1).save_model(
+        str(a_dir / '0001.model'))
+    rig_constant_class(make_net(2), cls=3).save_model(
+        str(b_dir / '0001.model'))
+    net = make_net()
+    net.serve_start(buckets='1,4', models={'a': str(a_dir),
+                                           'b': str(b_dir)})
+    try:
+        x = np.zeros((2, 1, 1, 8), np.float32)
+        assert (net.serve_predict(x, model='a') == 1).all()
+        assert (net.serve_predict(x, model='b') == 3).all()
+        stats = net.serve_stats()
+        assert 'fleet-models_loaded:2' in stats
+        assert 'fleet-bytes[a]' in stats
+        net._fleet.evict('a')
+        assert net._fleet.loaded() == ['b']
+        # an evicted model reloads transparently on the next request
+        assert (net.serve_predict(x, model='a') == 1).all()
+    finally:
+        net.serve_stop()
+
+
+def test_batcher_drops_requests_expired_at_coalesce_close():
+    """A request whose deadline passes WHILE the coalescing window is
+    open is shed when the window closes — counted as a deadline miss,
+    never forwarded to the engine (it must not waste a forward or a
+    decode slot on an answer nobody will read)."""
+    eng = FakeEngine()
+    b = DynamicBatcher(eng, max_queue=8, max_wait=0.3, deadline=10.0)
+    try:
+        first = b.submit_async(np.zeros((1, 4), np.float32))
+        # joins the window immediately (deadline still live at pop time),
+        # then expires before the 0.3s window closes
+        doomed = b.submit_async(np.zeros((2, 4), np.float32),
+                                deadline=0.05)
+        with pytest.raises(DeadlineExceededError):
+            b.wait(doomed)
+        assert b.wait(first).shape == (1, 1)
+        assert eng.batches == [1], 'expired rows must not be forwarded'
+        assert b.stats.get('expired') == 1
+    finally:
+        b.close()
+
+
 def test_batcher_engine_error_propagates_per_request():
     b = DynamicBatcher(FakeEngine(fail=True), max_queue=8, max_wait=0.0,
                        deadline=5.0)
