@@ -21,6 +21,9 @@ Extra modes for the BASELINE.md ledger (same JSON shape):
   python bench.py io               # host input pipeline only (no chip):
                                    #   imgbinx chain + nworker pool sweep
                                    #   (alias: bench_io; BENCH_IO_r01.json)
+  python bench.py scan             # SUPERVISED steps/sec A/B: K=4 scanned
+                                   #   dispatch vs per-step with the
+                                   #   supervisor on (BENCH_SCAN_r01.json)
 
 ``CXXNET_BENCH_CONF_EXTRA`` appends config lines (';'-separated) to every
 model bench conf — the execution-plan A/B hook (e.g.
@@ -685,6 +688,124 @@ def bench_io() -> int:
     return 0
 
 
+_SCAN_MLP = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 512
+  init_sigma = 0.05
+layer[+1:ac1] = relu
+layer[+1:do1] = dropout
+  threshold = 0.3
+layer[+1:fc2] = fullc:fc2
+  nhidden = 512
+  init_sigma = 0.05
+layer[+1:ac2] = relu
+layer[+1:fc3] = fullc:fc3
+  nhidden = 16
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,256
+dev = cpu
+eta = 0.05
+momentum = 0.9
+metric[label] = error
+eval_train = 0
+"""
+
+
+def bench_scan() -> int:
+    """SUPERVISED steps/sec, scanned K-dispatch vs per-step — the receipt
+    that the ExecutionPlan refactor (doc/trainer.md) keeps the
+    steps_per_dispatch win under production constraints: both legs run
+    the REAL supervised loop (TrainSupervisor watchdog ThreadBuffer,
+    anchor + final exact-resume checkpoints, divergence gate armed via
+    nan_breaker), differing ONLY in the plan's K.  Final params of the
+    two legs are bitwise-asserted in-bench, so the speedup can never be
+    bought with a semantics drift.  On a remote-chip tunnel the per-step
+    leg pays the link RTT every step and K recovers it; on CPU fallback
+    the dispatch overhead is host-call-only, so speedup ~1x is expected
+    and the receipt is a trend point, not a chip number."""
+    import tempfile
+
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.execution import ExecutionPlan
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.runtime.supervisor import (SupervisorConfig,
+                                               TrainSupervisor)
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    batch_size = _bench_batch(64)
+    scan_k = int(os.environ.get('CXXNET_SCAN_K', '4'))
+    n_batches = int(os.environ.get('CXXNET_SCAN_BATCHES', '96'))
+    # whole windows for a clean A/B, floor of one window (a sub-K request
+    # would otherwise round to zero batches and a 0/0 speedup)
+    n_batches = max(scan_k, n_batches - n_batches % scan_k)
+    conf = _SCAN_MLP + f'batch_size = {batch_size}\n' + _extra_conf()
+
+    rng = np.random.RandomState(0)
+    centers = rng.randn(16, 256).astype(np.float32) * 2
+    batches = []
+    for _ in range(n_batches):
+        y = rng.randint(0, 16, batch_size)
+        x = centers[y] + 0.3 * rng.randn(batch_size, 256).astype(np.float32)
+        batches.append(DataBatch(x.reshape(batch_size, 1, 1, 256),
+                                 y[:, None].astype(np.float32)))
+
+    def leg(k, tmp):
+        trainer = NetTrainer(parse_config_string(conf))
+        trainer.init_model()
+        plan = ExecutionPlan.resolve(requested_k=k, strict=True,
+                                     silent=True)
+        sup = TrainSupervisor(
+            trainer, os.path.join(tmp, f'sup_k{k}'),
+            SupervisorConfig(batch_deadline=120.0, nan_breaker=3,
+                             save_every=0))
+        stepper = lambda: plan.round_stepper(trainer, lookahead=0)  # noqa: E731
+        factory = lambda s: iter(batches[s % n_batches:])           # noqa: E731
+        sup.run(factory, before_step=None, make_stepper=stepper)  # warm
+        # min over reps, like _quotient_per_step: scheduler spikes only
+        # ever ADD time, so min is the honest steady-state epoch
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            n = sup.run(factory, make_stepper=stepper)
+            times.append(time.perf_counter() - t0)
+        return n / min(times), trainer
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rate_1, t1 = leg(1, tmp)
+        rate_k, tk = leg(scan_k, tmp)
+    bitwise = all(
+        np.array_equal(np.asarray(t1.params[lk][fk]),
+                       np.asarray(tk.params[lk][fk]))
+        for lk, fields in t1.params.items() for fk in fields)
+    if not bitwise:
+        raise AssertionError(
+            'supervised scanned leg diverged from the per-step leg — '
+            'the speedup number would be meaningless')
+    import jax
+    _emit({
+        'metric': 'supervised_scan_steps_per_sec',
+        'value': round(rate_k, 1),
+        'unit': 'steps/sec',
+        # steps/sec is platform-bound: say where it was measured even
+        # when the cpu-fallback machinery didn't have to engage (the
+        # probe short-circuits on an explicit JAX_PLATFORMS=cpu run)
+        'platform': jax.devices()[0].platform,
+        'vs_baseline': None,
+        'per_step_steps_per_sec': round(rate_1, 1),
+        'speedup': round(rate_k / rate_1, 3),
+        'k': scan_k,
+        'batch': batch_size,
+        'steps': n_batches,
+        'supervise': 1,
+        'bitwise_equal': True,
+        'timing': 'min wall over 3 supervised epochs, warm leg discarded',
+    })
+    return 0
+
+
 def bench_e2e_alexnet() -> int:
     """END-TO-END AlexNet throughput: the real CLI training-loop path —
     imgbin pages -> native/PIL JPEG decode -> augment (crop+mirror) ->
@@ -1009,6 +1130,7 @@ _MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
                            bench_eval_alexnet),
           'io': ('host_io_images_per_sec', bench_io),
           'bench_io': ('host_io_images_per_sec', bench_io),  # alias
+          'scan': ('supervised_scan_steps_per_sec', bench_scan),
           'mnist_tta': ('mnist_time_to_2pct_error', bench_mnist_tta),
           'transformer': ('transformer_tokens_per_sec_per_chip',
                           bench_transformer),

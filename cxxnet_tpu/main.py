@@ -62,8 +62,9 @@ class LearnTask:
         # scanned hot loop: K staged batches per device dispatch
         # (doc/trainer.md; steps_per_dispatch=1 = per-step reference path)
         self.steps_per_dispatch = 1
-        self._scan_fns = {}            # K -> compiled multi-step fn
-        self._scan_note_printed = False
+        self.scan_strict = 0           # 1 = a demotion raises
+                                       # ScanStrictError instead of
+                                       # silently falling back per-step
         self.extract_node_name = ''
         self.name_pred = 'pred.txt'
         self.output_format = 1
@@ -119,6 +120,8 @@ class LearnTask:
             'save_workers': ('save_workers', int),
             'steps_per_dispatch': ('steps_per_dispatch', int),
             'train.steps_per_dispatch': ('steps_per_dispatch', int),
+            'scan_strict': ('scan_strict', int),
+            'train.scan_strict': ('scan_strict', int),
             'serve.buckets': ('serve_buckets', str),
             'serve.max_queue': ('serve_max_queue', int),
             'serve.max_wait': ('serve_max_wait', float),
@@ -437,15 +440,19 @@ class LearnTask:
             self.net_trainer,
             os.path.join(self.name_model_dir, 'supervised_state'), cfg)
 
-    def _supervised_round(self, sup, tracer, batch_counter, start) -> int:
+    def _supervised_round(self, sup, plan, tracer, batch_counter,
+                          start) -> int:
         """One round's batches under the supervisor: watchdog on the
         pipeline, divergence breaker on the loss, restore-and-resume from
         the exact sidecar on recoverable faults.  ``batch_factory(k)``
-        re-winds a fresh epoch pass to batch k after a restore; bitwise
-        recovery additionally needs a replay-stable iterator
-        (``is_replay_stable`` — _make_supervisor warns otherwise).  The
-        supervised path trades the one-batch H2D lookahead for
-        recoverability."""
+        re-winds a fresh epoch pass to batch k after a restore — k counts
+        DISPATCHED steps (epoch-absolute), so recovery composes with the
+        scanned window (a fault mid-window abandons staged batches and
+        re-pulls them); bitwise recovery additionally needs a
+        replay-stable iterator (``is_replay_stable`` — _make_supervisor
+        warns otherwise).  The supervised per-step path dispatches
+        immediately (lookahead=0); the scanned path's K-deep staging
+        window provides the H2D overlap instead."""
         import itertools
         it = self._sup_iter
 
@@ -457,14 +464,26 @@ class LearnTask:
             tracer.before_update(batch_counter + i)
             self._progress(i + 1, start)
 
-        return sup.run(factory, before_step=before_step)
+        return sup.run(
+            factory, before_step=before_step,
+            make_stepper=lambda: plan.round_stepper(self.net_trainer,
+                                                    lookahead=0))
 
     def _train_rounds(self, tracer, batch_counter, start) -> None:
+        from .nnet.execution import ExecutionPlan
         sup = None
         if self.supervise and self.test_io == 0:
             sup = self._make_supervisor()
+        # ONE plan per run: everything the old fallback matrix excluded
+        # (supervise, update_period>1, eval_train metrics, async saves)
+        # now composes with the scan — only profiling and test_io demote
+        # statically, extra_data demotes per round (doc/trainer.md)
+        plan = ExecutionPlan.resolve(
+            requested_k=self.steps_per_dispatch,
+            profiling=tracer.enabled, test_io=bool(self.test_io),
+            strict=bool(self.scan_strict), silent=bool(self.silent))
         try:
-            self._run_rounds(sup, tracer, batch_counter, start)
+            self._run_rounds(sup, plan, tracer, batch_counter, start)
         finally:
             if sup is not None:
                 sup.close()
@@ -475,136 +494,43 @@ class LearnTask:
             print(f'round {self.start_counter - 1:8d}:'
                   f'[{sample_counter:8d}] {elapsed} sec elapsed', flush=True)
 
-    def _resolve_scan_k(self, sup, tracer) -> int:
-        """Effective ``steps_per_dispatch`` for this run — the scanned
-        hot loop (one ``lax.scan`` dispatch per K batches, zero per-step
-        link RTT) engages only when its semantics are exactly the
-        per-step path's; otherwise fall back to K=1 and say why once
-        (the fallback matrix, doc/trainer.md)."""
-        k = self.steps_per_dispatch
-        if k <= 1 or self.test_io:
-            return 1
-        tr = self.net_trainer
-        why = None
-        if sup is not None:
-            why = 'train.supervise=1 (recovery re-winds per batch)'
-        elif tracer.enabled:
-            # a batch-windowed trace needs per-step dispatch boundaries
-            # — inside a scanned window there is nothing to start/stop
-            # the profiler between
-            why = 'profile_dir set (trace window brackets per-step ' \
-                  'dispatches)'
-        elif tr.update_period != 1:
-            why = f'update_period={tr.update_period} (scan applies the ' \
-                  'optimizer every step)'
-        elif tr.eval_train and len(tr.train_metric):
-            why = 'eval_train=1 with train metrics (per-step metric ' \
-                  'readback); set eval_train=0 to scan'
-        if why is not None:
-            if not self.silent and not self._scan_note_printed:
-                print(f'steps_per_dispatch={k} falls back to per-step: '
-                      f'{why}', flush=True)
-                self._scan_note_printed = True
-            return 1
-        return k
-
-    def _scan_fn(self, k: int):
-        if k not in self._scan_fns:
-            self._scan_fns[k] = self.net_trainer.compile_multi_step(k)
-        return self._scan_fns[k]
-
-    def _plain_round(self, tracer, batch_counter, start):
-        """Per-step dispatch with the one-batch host->device lookahead:
-        batch i+1's transfers are enqueued (stage_batch, async) before
+    def _round(self, plan, tracer, batch_counter, start):
+        """One unsupervised round through the plan's WindowedStepper:
+        per-step (K=1) keeps the classic one-batch host->device lookahead
+        — batch i+1's transfers are enqueued (stage_batch, async) before
         batch i's step is dispatched, so the host link rides behind
-        device compute — the H2D half of the reference's prefetch
-        pipeline (iter_thread_buffer covers the disk->host half)."""
-        sample_counter = updates = 0
-        pending = None
+        device compute; scanned (K>1) accumulates K staged batches (the
+        lookahead runs K deep) into ONE ``compile_multi_step`` dispatch,
+        with the short epoch tail finishing per-step (bitwise-identical,
+        so epoch length need not divide K).  An ``attachtxt`` chain
+        (extra_data) demotes THIS round only — the next round's stepper
+        re-probes."""
+        stepper = plan.round_stepper(
+            self.net_trainer,
+            before_dispatch=lambda u: tracer.before_update(
+                batch_counter + u))
+        sample_counter = 0
         for batch in self.itr_train:
             if self.test_io == 0:
-                staged = self.net_trainer.stage_batch(batch)
-                if pending is not None:
-                    tracer.before_update(batch_counter + updates)
-                    self.net_trainer.update_staged(pending)
-                    updates += 1
-                pending = staged
+                stepper.feed(batch)
             sample_counter += 1
             self._progress(sample_counter, start)
-        if pending is not None:
-            tracer.before_update(batch_counter + updates)
-            self.net_trainer.update_staged(pending)
-            updates += 1
-        return updates, sample_counter
+        stepper.finish()
+        return stepper.updates, sample_counter
 
-    def _scanned_round(self, k, tracer, batch_counter, start):
-        """Scanned hot loop: accumulate K staged batches (each an async
-        H2D enqueue — the lookahead now runs K batches deep) and drive
-        them through ONE ``compile_multi_step`` dispatch.  A short tail
-        window finishes on the per-step path, which is bitwise-identical
-        (trainer.update_staged_window), so epoch length need not divide
-        K.  An ``attachtxt`` chain (extra_data) is detected on the first
-        batch and demotes the whole round to per-step."""
-        sample_counter = updates = 0
-        window = []
-        demoted = False
-
-        def step_one(st):
-            nonlocal updates
-            tracer.before_update(batch_counter + updates)
-            self.net_trainer.update_staged(st)
-            updates += 1
-
-        for batch in self.itr_train:
-            staged = self.net_trainer.stage_batch(batch)
-            if not demoted and staged[2]:
-                # extra_data (attachtxt): the scan body can't carry it —
-                # demote mid-epoch WITHOUT re-winding the iterator
-                demoted = True
-                self.steps_per_dispatch = 1  # future rounds resolve to 1
-                if not self.silent and not self._scan_note_printed:
-                    print(f'steps_per_dispatch={k} falls back to per-step: '
-                          'iterator attaches extra_data', flush=True)
-                self._scan_note_printed = True
-                for st in window:
-                    step_one(st)
-                window = []
-            if demoted:
-                step_one(staged)
-            else:
-                window.append(staged)
-                if len(window) == k:
-                    # no tracer hook here: profile_dir demotes to
-                    # per-step in _resolve_scan_k (a trace window can't
-                    # bracket steps inside one dispatch)
-                    self.net_trainer.update_staged_window(
-                        self._scan_fn(k), window)
-                    updates += k
-                    window = []
-            sample_counter += 1
-            self._progress(sample_counter, start)
-        for st in window:            # tail: per-step, bitwise-identical
-            step_one(st)
-        return updates, sample_counter
-
-    def _run_rounds(self, sup, tracer, batch_counter, start) -> None:
+    def _run_rounds(self, sup, plan, tracer, batch_counter, start) -> None:
         cc = self.max_round
         while self.start_counter <= self.num_round and cc > 0:
             cc -= 1
             if not self.silent:
                 print(f'update round {self.start_counter - 1}', flush=True)
             self.net_trainer.start_round(self.start_counter)
-            scan_k = self._resolve_scan_k(sup, tracer)
             if sup is not None:
-                n = self._supervised_round(sup, tracer, batch_counter,
-                                           start)
-                batch_counter += n
-            elif scan_k > 1:
-                n, _ = self._scanned_round(scan_k, tracer, batch_counter,
+                n = self._supervised_round(sup, plan, tracer, batch_counter,
                                            start)
                 batch_counter += n
             else:
-                n, _ = self._plain_round(tracer, batch_counter, start)
+                n, _ = self._round(plan, tracer, batch_counter, start)
                 batch_counter += n
             # settle the one-step-deferred divergence gate (no-op unless
             # nan_action=halt / nan_breaker armed the check)

@@ -361,11 +361,11 @@ class NetTrainer:
         self._forward_fn = forward_step
         self._stack_jit = None     # mesh may have changed: rebuild lazily
 
-    def compile_multi_step(self, n_steps: int):
+    def compile_multi_step(self, n_steps: int, train_eval: bool = False):
         """Jitted ``n_steps``-training-step function: ONE dispatch runs the
-        whole loop on device via ``lax.scan`` over the (params, opt_state)
-        carry, cycling round-robin through a leading-axis stack of
-        pre-staged batches.
+        whole loop on device via ``lax.scan`` over the (params, opt_state,
+        grad_acc) carry, cycling round-robin through a leading-axis stack
+        of pre-staged batches.
 
         Exists because per-step dispatch does not pipeline over the remote
         chip tunnel (each call costs the full link RTT, ~7 ms, regardless
@@ -376,11 +376,25 @@ class NetTrainer:
         (``nnet_impl-inl.hpp:141-185``), which never pays a per-step
         dispatch boundary either.
 
-        Requires ``update_period == 1`` (each scan step applies the
-        optimizer).  Returns ``fn(params, opt_state, data_stack,
-        label_stack, base_rng, epoch0, sc0, mask_stack, rnd) -> (params,
-        opt_state, losses)`` with the compiled step count attached as
-        ``fn.n_steps``; drive it through :meth:`update_n_on_device` to keep
+        Composes with the production constraints the per-step path
+        carries (the ExecutionPlan contract, doc/trainer.md):
+
+        * ``update_period = P`` — the gradient accumulator rides the scan
+          carry; step ``t`` adds its grads and the optimizer applies (and
+          the epoch counter advances) only when ``(sc0 + t + 1) % P == 0``
+          — the EXACT per-step cadence, so windows need not align with
+          accumulation boundaries (a partial accumulation carries across
+          dispatches through the trainer's live ``grad_acc``).
+        * ``train_eval=True`` — each step's eval-node outputs ride the
+          scan's stacked ys, so ``eval_train=1`` train metrics cost ONE
+          host readback per dispatch instead of one per step
+          (:meth:`update_staged_window` defers it one dispatch, mirroring
+          the per-step deferred readback).
+
+        Returns ``fn(params, opt_state, grad_acc, data_stack, label_stack,
+        base_rng, epoch0, sc0, mask_stack, rnd) -> (params, opt_state,
+        grad_acc, losses, evals)`` with ``fn.n_steps`` / ``fn.train_eval``
+        attached; drive it through :meth:`update_n_on_device` to keep
         trainer counters coherent (round-dependent layers and tail-batch
         masks follow the same semantics as the per-step :meth:`update`
         path: ``rnd`` is traced, ``mask_stack`` rides the batch stack).
@@ -389,25 +403,23 @@ class NetTrainer:
         1 + (sc0 + t) * 131 + rnd)`` — the EXACT key the per-step
         :meth:`update_staged` path computes at sample counter ``sc0+t``,
         so a K-step dispatch is bitwise-identical to K per-step
-        dispatches even for stochastic nets (the production
-        ``steps_per_dispatch`` contract, doc/trainer.md); ``losses`` is
-        the full ``(n_steps,)`` per-step loss vector so the divergence
-        gate sees every step, not just the last.
+        dispatches even for stochastic nets; ``losses`` is the full
+        ``(n_steps,)`` per-step loss vector so the divergence gate sees
+        every step, not just the last.
         """
-        if self.update_period != 1:
-            raise ValueError('compile_multi_step requires update_period=1')
         loss_fn = self._make_loss_fn()
         updater_type = self.net_cfg.updater_type
         hypers = self.hypers
         nan_skip = self.nan_action == 'skip'
+        period = max(1, int(self.update_period))
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def multi_step(params, opt_state, data_stack, label_stack, base_rng,
-                       epoch0, sc0, mask_stack, rnd, norm=()):
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def multi_step(params, opt_state, grad_acc, data_stack, label_stack,
+                       base_rng, epoch0, sc0, mask_stack, rnd, norm=()):
             nstack = data_stack.shape[0]
 
             def body(carry, t):
-                params, opt_state, epoch = carry
+                params, opt_state, grad_acc, epoch = carry
                 data = jax.lax.dynamic_index_in_dim(
                     data_stack, t % nstack, keepdims=False)
                 label = jax.lax.dynamic_index_in_dim(
@@ -415,7 +427,7 @@ class NetTrainer:
                 mask = jax.lax.dynamic_index_in_dim(
                     mask_stack, t % nstack, keepdims=False)
                 rng = jax.random.fold_in(base_rng, 1 + (sc0 + t) * 131 + rnd)
-                (loss, _), grads = jax.value_and_grad(
+                (loss, evals), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params, data, label, (), mask,
                                            rng, rnd, norm)
                 if nan_skip:
@@ -424,20 +436,45 @@ class NetTrainer:
                         ok &= jnp.all(jnp.isfinite(g))
                     grads = jax.tree.map(
                         lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads)
-                params, opt_state = apply_updates(
-                    updater_type, hypers, params, grads, opt_state, epoch)
-                return (params, opt_state, epoch + 1), loss
+                # accumulate-then-apply, exactly as the per-step path: the
+                # 0+g add is kept even at P=1 so the float ops match
+                # bitwise (the per-step train_step always adds into the
+                # zeroed accumulator before applying)
+                grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+                if period == 1:
+                    params, opt_state = apply_updates(
+                        updater_type, hypers, params, grad_acc, opt_state,
+                        epoch)
+                    grad_acc = jax.tree.map(jnp.zeros_like, grad_acc)
+                    epoch = epoch + 1
+                else:
+                    def _apply(args):
+                        p, o, g, e = args
+                        p, o = apply_updates(updater_type, hypers, p, g, o,
+                                             e)
+                        return p, o, jax.tree.map(jnp.zeros_like, g), e + 1
 
-            (params, opt_state, _), losses = jax.lax.scan(
-                body, (params, opt_state, epoch0), jnp.arange(n_steps))
-            return params, opt_state, losses
+                    params, opt_state, grad_acc, epoch = jax.lax.cond(
+                        (sc0 + t + 1) % period == 0, _apply,
+                        lambda args: args,
+                        (params, opt_state, grad_acc, epoch))
+                ys = (loss, tuple(evals) if train_eval else ())
+                return (params, opt_state, grad_acc, epoch), ys
 
-        def multi_fn(params, opt_state, data_stack, label_stack, base_rng,
-                     epoch0, sc0, mask_stack, rnd, norm=()):
-            return multi_step(params, opt_state, data_stack, label_stack,
-                              base_rng, epoch0, sc0, mask_stack, rnd, norm)
+            (params, opt_state, grad_acc, _), (losses, evals) = jax.lax.scan(
+                body, (params, opt_state, grad_acc, epoch0),
+                jnp.arange(n_steps))
+            return params, opt_state, grad_acc, losses, evals
+
+        def multi_fn(params, opt_state, grad_acc, data_stack, label_stack,
+                     base_rng, epoch0, sc0, mask_stack, rnd, norm=()):
+            return multi_step(params, opt_state, grad_acc, data_stack,
+                              label_stack, base_rng, epoch0, sc0,
+                              mask_stack, rnd, norm)
 
         multi_fn.n_steps = n_steps
+        multi_fn.train_eval = train_eval
+        multi_fn.update_period = period
         return multi_fn
 
     def compile_multi_forward(self, n_steps: int):
@@ -495,7 +532,8 @@ class NetTrainer:
         return jax.device_put(jnp.asarray(stack), sh)
 
     def update_n_on_device(self, multi_fn, data_stack, label_stack,
-                           n_steps: int = None, mask_stack=None, norm=()):
+                           n_steps: int = None, mask_stack=None, norm=(),
+                           train_eval=None):
         """Run a :meth:`compile_multi_step` function over pre-staged stacks,
         keeping epoch/sample counters coherent.  ``n_steps`` defaults to —
         and must match — the step count baked into ``multi_fn`` at compile
@@ -506,8 +544,15 @@ class NetTrainer:
         deferred (mean, scale) device constants — pass
         ``trainer._norm_args(batch)`` of any batch carrying the chain's
         spec; the default () means the stack is already normalized.
-        Returns the last loss (device scalar — fetching it is a real
-        completion barrier)."""
+        ``train_eval``: a ``(label_infos, ns)`` pair (one per step) when
+        ``multi_fn`` was compiled with ``train_eval=True`` — the stacked
+        eval-node outputs then feed ``train_metric`` exactly as K per-step
+        readbacks would, deferred one dispatch.  Returns the last loss
+        (device scalar — fetching it is a real completion barrier)."""
+        if self.inference_only:
+            raise RuntimeError(
+                'trainer was built inference_only=1 (no optimizer state); '
+                'it can predict/evaluate but not train')
         compiled = getattr(multi_fn, 'n_steps', None)
         if n_steps is None:
             n_steps = compiled
@@ -518,11 +563,35 @@ class NetTrainer:
         if mask_stack is None:
             mask_stack = self._ones_mask_stack(data_stack.shape[:2])
         sc0 = self.sample_counter
-        self.params, self.opt_state, losses = multi_fn(
-            self.params, self.opt_state, data_stack, label_stack, self._rng,
-            self.epoch_counter, sc0, mask_stack, self.round, norm)
-        self.epoch_counter += n_steps
+        old_pending = self._pending_train_eval
+        self._pending_train_eval = None
+        (self.params, self.opt_state, self.grad_acc, losses, evals) = \
+            multi_fn(self.params, self.opt_state, self.grad_acc, data_stack,
+                     label_stack, self._rng, self.epoch_counter, sc0,
+                     mask_stack, self.round, norm)
+        # the accumulation cadence BAKED INTO the compiled body, not the
+        # live config — a multi_fn compiled before an update_period tweak
+        # applies the optimizer on its compile-time cadence, and the host
+        # epoch counter must follow the same one
+        period = getattr(multi_fn, 'update_period',
+                         max(1, self.update_period))
+        if period == 1:
+            self.epoch_counter += n_steps
+        else:
+            # optimizer applications this window — same cadence the scan
+            # body's in-graph counter followed
+            self.epoch_counter += sum(
+                1 for t in range(n_steps) if (sc0 + t + 1) % period == 0)
         self.sample_counter += n_steps
+        if train_eval is not None:
+            label_infos, ns = train_eval
+            # window-shaped pending (dict-tagged): one readback per
+            # dispatch, drained one dispatch late like the per-step path
+            self._pending_train_eval = {
+                'losses': losses, 'evals': evals,
+                'infos': label_infos, 'ns': ns}
+        if old_pending is not None:
+            self._drain_train_eval(old_pending)
         self._gate_losses(losses, sc0)
         return losses[-1]
 
@@ -591,12 +660,24 @@ class NetTrainer:
                 raise ValueError(
                     'scanned dispatch does not carry extra_data '
                     '(attachtxt chains); use the per-step path')
+        train_eval = None
+        armed = bool(self.eval_train and len(self.train_metric))
+        if armed and not getattr(multi_fn, 'train_eval', False):
+            raise ValueError(
+                'eval_train=1 with train metrics needs a multi_fn compiled '
+                'with train_eval=True, or the window\'s metrics are lost')
+        if getattr(multi_fn, 'train_eval', False):
+            infos = [_HostLabelInfo(s[4], self.net_cfg.label_name_map,
+                                    self.net_cfg.label_range)
+                     for s in staged_list]
+            ns = [s[5] - s[6] for s in staged_list]
+            train_eval = (infos, ns)
         data_stack = self._device_stack([s[0] for s in staged_list])
         label_stack = self._device_stack([s[1] for s in staged_list])
         mask_stack = self._device_stack([s[3] for s in staged_list])
         return self.update_n_on_device(
             multi_fn, data_stack, label_stack, mask_stack=mask_stack,
-            norm=staged_list[0][7])
+            norm=staged_list[0][7], train_eval=train_eval)
 
     # --- training ---------------------------------------------------------
     def start_round(self, round_: int) -> None:
@@ -795,6 +876,19 @@ class NetTrainer:
         return cached
 
     def _drain_train_eval(self, pending) -> None:
+        if isinstance(pending, dict):
+            # a scanned window's stacked eval outputs: ONE readback, then
+            # the per-step host math in step order — bitwise the same
+            # metric accumulation as K per-step drains
+            losses = np.asarray(pending['losses'])
+            evals = [np.asarray(e) for e in pending['evals']]
+            for t, (info, n) in enumerate(zip(pending['infos'],
+                                              pending['ns'])):
+                if self.nan_action == 'skip' and not np.isfinite(losses[t]):
+                    continue
+                self.train_metric.add_eval([e[t][:n] for e in evals],
+                                           info.slice(n))
+            return
         loss, evals, label_info, n = pending
         if self.nan_action == 'skip' and not np.isfinite(float(loss)):
             return  # poisoned batch: its NaN outputs would wreck the

@@ -69,6 +69,22 @@ class CheckpointCorruptError(TrainingFault):
     """A checkpoint failed integrity verification on restore."""
 
 
+class ScanStrictError(RuntimeError):
+    """``scan_strict=1`` asserted the scanned K-dispatch path and an
+    ExecutionPlan demotion would have silently fallen back to per-step.
+    A configuration outcome, not a :class:`TrainingFault`: the supervisor
+    must NOT restore-and-retry a run whose config contradicts itself —
+    the operator asked to fail loudly instead of losing the dispatch win.
+    ``reason`` is the demotion key from
+    ``nnet.execution.DEMOTION_REASONS``."""
+
+    def __init__(self, reason: str, detail: str):
+        self.reason = str(reason)
+        super().__init__(
+            f'scan_strict=1: steps_per_dispatch would demote to per-step '
+            f'[{reason}]: {detail}')
+
+
 class ServeError(RuntimeError):
     """Base of the online-serving failure taxonomy (doc/serving.md).
     Deliberately NOT a :class:`TrainingFault`: serving errors are

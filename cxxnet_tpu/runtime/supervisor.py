@@ -231,21 +231,40 @@ class TrainSupervisor:
     # --- the supervised loop ----------------------------------------------
     def run(self, batch_factory: Callable[[int], Iterator],
             n_steps: Optional[int] = None,
-            before_step: Optional[Callable[[int], None]] = None) -> int:
+            before_step: Optional[Callable[[int], None]] = None,
+            make_stepper: Optional[Callable[[], object]] = None) -> int:
         """Supervised step loop over one epoch of batches.
 
         ``batch_factory(k)`` must yield batch k, k+1, ... deterministically
-        (see module docstring).  Runs until ``n_steps`` updates have been
-        applied this call, or until the factory's iterator is exhausted
-        when ``n_steps`` is None.  Returns the number of updates applied.
-        ``before_step(i)`` (i = updates applied so far this call) runs
-        before each update — progress printing / trace windows hook here.
+        (see module docstring).  Runs until at least ``n_steps`` updates
+        have been applied this call (a windowed stepper can overshoot by
+        up to K-1 inside the dispatch that crosses the budget; staged
+        batches beyond it are discarded, never dispatched), or until the
+        factory's iterator is exhausted when ``n_steps`` is None.
+        Returns the number of updates applied.
+        ``before_step(i)`` (i = batches consumed so far this call) runs
+        before each batch — progress printing / trace windows hook here.
+
+        ``make_stepper`` composes the scanned K-dispatch hot loop with
+        supervision (``nnet.execution.ExecutionPlan.round_stepper``): a
+        fresh ``WindowedStepper`` is built per (re)start, batches feed it
+        instead of ``trainer.update``, and recovery operates at
+        dispatch-window granularity — the re-wind targets the restored
+        ``sample_counter`` (epoch-absolute, counts only DISPATCHED
+        steps), so batches staged into a window a fault destroyed are
+        simply re-pulled.  The divergence gate still sees every per-step
+        loss (the scan returns the full vector; ``trainer._gate_losses``).
+        Default (None) is the classic per-step loop.  Periodic saves land
+        at window boundaries: a save fires when a dispatch CROSSES a
+        ``save_every`` multiple, which for the per-step default reduces to
+        the historical every-``save_every``-steps cadence exactly.
 
         On a recoverable fault: log -> restore last good checkpoint ->
         re-create the batch stream at the restored position -> continue.
         After ``max_restarts`` recoveries the fault propagates (with the
         failure log telling the whole story).
         """
+        from ..nnet.execution import WindowedStepper
         cfg = self.config
         tr = self.trainer
         base = tr.sample_counter
@@ -292,13 +311,21 @@ class TrainSupervisor:
                                fault_scope='batch',
                                fault_base=start)
             buf.stats = cfg.pipeline_stats
+            # a FRESH stepper per (re)start: a fault mid-window abandons
+            # the staged-but-undispatched batches, and the re-wound
+            # stream re-pulls them into a new window
+            stepper = (make_stepper() if make_stepper is not None
+                       else WindowedStepper(tr, k=1, lookahead=0))
+            fed = start
             try:
                 for batch in buf:
                     if before_step is not None:
-                        before_step(tr.sample_counter - base)
-                    tr.update(batch)
+                        before_step(fed)
+                    fed += 1
+                    delta = stepper.feed(batch)
                     done = tr.sample_counter - base
-                    if cfg.save_every and done % cfg.save_every == 0:
+                    if delta and cfg.save_every \
+                            and done % cfg.save_every < delta:
                         # a periodic save must never checkpoint
                         # NaN-poisoned params — it would become the
                         # "newest intact" restore target (a CRC digest
@@ -312,7 +339,16 @@ class TrainSupervisor:
                             self.save()
                             last_saved = tr.sample_counter
                     if n_steps is not None and done >= n_steps:
+                        # budget reached: staged-but-undispatched batches
+                        # are DISCARDED, not finished — a windowed stepper
+                        # may overshoot by at most K-1 within the dispatch
+                        # that crossed the line, never by a whole tail
+                        stepper.discard()
                         break
+                # the epoch tail (a part-filled window, or the K=1
+                # lookahead's last batch) dispatches per-step INSIDE the
+                # try: a tail-step fault recovers like any other
+                stepper.finish()
                 # the divergence gate is deferred one step: the LAST
                 # update's loss is still pending — settle it inside the
                 # try so a final-step NaN recovers like any other

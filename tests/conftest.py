@@ -57,6 +57,13 @@ def pytest_configure(config):
         'cache, slot join/leave, offline-generate stream twins, '
         'multi-model budgeter; CPU-only '
         '(tier-1: runs under -m "not slow"; select with -m serve_decode)')
+    config.addinivalue_line(
+        'markers',
+        'execution: ExecutionPlan / composable step-loop suite — '
+        'scanned K-dispatch composed with update_period, train metrics, '
+        'supervision and chaos recovery, bitwise twins + demotion-matrix '
+        'drift; CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m execution)')
 
 
 # every pipeline thread the framework starts carries a cxxnet- name

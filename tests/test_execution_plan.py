@@ -1,0 +1,439 @@
+"""ExecutionPlan: one composable step loop (``-m execution``).
+
+The properties this suite pins down (doc/trainer.md "The execution
+plan"):
+
+* the scanned K-dispatch window composes with everything the PR 5
+  fallback matrix excluded — ``update_period>1`` (grad accumulator in
+  the scan carry), ``eval_train=1`` train metrics (one readback per
+  dispatch), and ``train.supervise=1`` (recovery at window granularity)
+  — and every leg is **bitwise identical** to the per-step path;
+* the remaining demotions are profiling/test_io-only (static) plus the
+  per-round ``extra_data`` case, ``scan_strict=1`` turns any of them
+  into a typed error, fallback notes print once PER REASON, and the
+  documented matrix cannot drift from ``execution.DEMOTION_REASONS``.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet import execution
+from cxxnet_tpu.nnet.execution import (DEMOTION_REASONS, ExecutionPlan,
+                                       WindowedStepper)
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.runtime import faults
+from cxxnet_tpu.runtime.supervisor import SupervisorConfig, TrainSupervisor
+from cxxnet_tpu.utils.config import parse_config_string
+
+from test_device_normalize import assert_params_equal, snap_params
+from test_io_perf import (DROPOUT_MLP, MNIST_CONF, _mlp_batches, _run_cli,
+                          _write_mnist)
+
+pytestmark = pytest.mark.execution
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NO_WAIT = faults.NO_WAIT_RETRY
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    prev = faults.install_plan(None)
+    yield
+    faults.install_plan(prev)
+
+
+def _trainer(extra=''):
+    tr = NetTrainer(parse_config_string(DROPOUT_MLP + extra))
+    tr.init_model()
+    return tr
+
+
+def _run_per_step(tr, batches):
+    for b in batches:
+        tr.update_staged(tr.stage_batch(b))
+
+
+def _run_windowed(tr, batches, k):
+    plan = ExecutionPlan.resolve(requested_k=k, silent=True)
+    stepper = plan.round_stepper(tr)
+    for b in batches:
+        stepper.feed(b)
+    stepper.finish()
+    return stepper
+
+
+# --- composition: update_period rides the scan carry ----------------------
+
+@pytest.mark.parametrize('pad_last', [False, True])
+def test_update_period_scan_bitwise_matches_per_step(pad_last):
+    """K=4 windows == per-step micro-steps under update_period=2,
+    bitwise — with a DROPOUT layer and (pad_last leg) a synthetic-pad
+    tail batch whose loss mask rides the stack."""
+    batches = _mlp_batches(pad_last=pad_last)
+    per = _trainer('update_period = 2\n')
+    _run_per_step(per, batches)
+    win = _trainer('update_period = 2\n')
+    _run_windowed(win, batches, 4)
+    assert win.epoch_counter == per.epoch_counter == len(batches) // 2
+    assert win.sample_counter == per.sample_counter == len(batches)
+    assert_params_equal(snap_params(win), snap_params(per), rtol=0, atol=0)
+
+
+def test_update_period_straddles_window_boundaries():
+    """P=3 with K=2: no window aligns with an accumulation boundary, so
+    the partial gradient sum must carry ACROSS dispatches (through the
+    trainer's live grad_acc) and the per-step tail must continue a
+    mid-window accumulation — still bitwise."""
+    batches = _mlp_batches(n=7)
+    per = _trainer('update_period = 3\n')
+    _run_per_step(per, batches)
+    win = _trainer('update_period = 3\n')
+    stepper = _run_windowed(win, batches, 2)
+    assert stepper.updates == 7
+    assert win.epoch_counter == per.epoch_counter == 7 // 3
+    assert_params_equal(snap_params(win), snap_params(per), rtol=0, atol=0)
+    # the open accumulation (7 % 3 = 1 step) matches too
+    np.testing.assert_array_equal(
+        np.asarray(win.grad_acc['0']['wmat']),
+        np.asarray(per.grad_acc['0']['wmat']))
+
+
+# --- composition: eval_train metrics, one readback per dispatch -----------
+
+@pytest.mark.parametrize('pad_last', [False, True])
+def test_train_metrics_scan_bitwise_matches_per_step(pad_last):
+    """eval_train=1 with train metrics scans: the stacked eval outputs
+    feed the identical host-side metric math in step order, so the
+    round's train-metric line is byte-equal to the per-step path's (pad
+    rows excluded on both)."""
+    conf = 'eval_train = 1\n'
+    batches = _mlp_batches(pad_last=pad_last)
+    per = _trainer(conf)
+    _run_per_step(per, batches)
+    win = _trainer(conf)
+    _run_windowed(win, batches, 4)
+    for t in (per, win):
+        t.flush_train_metrics()
+    line_per = per.train_metric.print('train')
+    line_win = win.train_metric.print('train')
+    assert line_per == line_win and 'train-error' in line_win
+    assert_params_equal(snap_params(win), snap_params(per), rtol=0, atol=0)
+
+
+def test_window_requires_train_eval_compiled_fn():
+    """A metric-armed trainer driven through a multi_fn compiled without
+    train_eval=True would silently lose the window's metrics — typed
+    refusal instead."""
+    tr = _trainer('eval_train = 1\n')
+    fn = tr.compile_multi_step(2, train_eval=False)
+    staged = [tr.stage_batch(b) for b in _mlp_batches(n=2)]
+    with pytest.raises(ValueError, match='train_eval=True'):
+        tr.update_staged_window(fn, staged)
+
+
+# --- composition: supervision at window granularity -----------------------
+
+def _sup(tr, ckpt_dir, **kw):
+    base = dict(batch_deadline=60.0, max_restarts=3, nan_breaker=0,
+                save_every=2, buffer_size=2, retry=NO_WAIT)
+    base.update(kw)
+    return TrainSupervisor(tr, ckpt_dir, SupervisorConfig(**base))
+
+
+def test_supervised_scan_bitwise_twin(tmp_path):
+    """Supervised K=4 == supervised per-step == unsupervised per-step,
+    bitwise — the flagship composition: the watchdog buffer, anchor +
+    periodic saves, and recovery machinery change nothing about the
+    math, and the scanned window survives them."""
+    batches = _mlp_batches(n=10)     # 2 windows + a 2-step tail
+    ref = _trainer()
+    _run_per_step(ref, batches)
+
+    t1 = _trainer()
+    n1 = _sup(t1, str(tmp_path / 's1')).run(lambda k: iter(batches[k:]))
+    tk = _trainer()
+    plan = ExecutionPlan.resolve(requested_k=4, silent=True)
+    nk = _sup(tk, str(tmp_path / 'sk')).run(
+        lambda k: iter(batches[k:]),
+        make_stepper=lambda: plan.round_stepper(tk, lookahead=0))
+    assert n1 == nk == 10
+    assert_params_equal(snap_params(t1), snap_params(ref), rtol=0, atol=0)
+    assert_params_equal(snap_params(tk), snap_params(ref), rtol=0, atol=0)
+
+
+def test_supervised_scan_chaos_recovers_bitwise(tmp_path):
+    """The chaos drill through a scanned window boundary: a NaN injected
+    mid-window trips the breaker DURING a K-dispatch, recovery restores
+    the last window-boundary checkpoint, re-winds the stream by
+    dispatched steps, and the run still ends bitwise-identical to an
+    unfaulted per-step run."""
+    batches = _mlp_batches(n=8)
+    ref = _trainer()
+    _run_per_step(ref, batches)
+
+    plan_f = faults.FaultPlan(seed=3, nan_at_step=(6,))
+    faults.install_plan(plan_f)
+    tr = _trainer()
+    log = faults.FailureLog()
+    sup = TrainSupervisor(
+        tr, str(tmp_path / 'sup'),
+        SupervisorConfig(batch_deadline=60.0, max_restarts=3, nan_breaker=1,
+                         save_every=2, retry=NO_WAIT), failure_log=log)
+    plan = ExecutionPlan.resolve(requested_k=4, silent=True)
+    n = sup.run(lambda k: iter(batches[k:]),
+                make_stepper=lambda: plan.round_stepper(tr, lookahead=0))
+    assert n == 8
+    assert plan_f.fired() == ['nan_at_step=6']
+    assert len(log.records('DivergenceError')) == 1
+    # the restore landed on a window boundary (multiple of K=4)
+    assert log.records('restored')[0].step % 4 == 0
+    assert_params_equal(snap_params(tr), snap_params(ref), rtol=0, atol=0)
+
+
+def test_supervised_scan_stall_recovers_bitwise(tmp_path):
+    """Watchdog leg of the chaos drill: the producer stalls while a
+    window is FILLING — staged-but-undispatched batches are abandoned
+    and re-pulled after the restore, bitwise."""
+    batches = _mlp_batches(n=8)
+    ref = _trainer()
+    _run_per_step(ref, batches)
+
+    plan_f = faults.FaultPlan(seed=4, stall_batch=((5, 4.0),))
+    faults.install_plan(plan_f)
+    tr = _trainer()
+    log = faults.FailureLog()
+    sup = TrainSupervisor(
+        tr, str(tmp_path / 'sup'),
+        SupervisorConfig(batch_deadline=0.3, max_restarts=3, nan_breaker=1,
+                         save_every=2, retry=NO_WAIT), failure_log=log)
+    plan = ExecutionPlan.resolve(requested_k=4, silent=True)
+    n = sup.run(lambda k: iter(batches[k:]),
+                make_stepper=lambda: plan.round_stepper(tr, lookahead=0))
+    assert n == 8
+    assert plan_f.fired() == ['stall_batch=5:4']
+    assert len(log.records('PipelineStallError')) == 1
+    assert_params_equal(snap_params(tr), snap_params(ref), rtol=0, atol=0)
+
+
+def test_supervised_n_steps_budget_bounded_overshoot(tmp_path):
+    """n_steps with a windowed stepper: the budget check can only move at
+    dispatch boundaries, so overshoot is bounded to the window that
+    crossed the line — and the staged leftovers are DISCARDED, never
+    dispatched as a tail."""
+    batches = _mlp_batches(n=10)
+    tr = _trainer()
+    plan = ExecutionPlan.resolve(requested_k=4, silent=True)
+    n = _sup(tr, str(tmp_path / 's'), save_every=0).run(
+        lambda k: iter(batches[k:]), n_steps=2,
+        make_stepper=lambda: plan.round_stepper(tr, lookahead=0))
+    assert n == 4                       # one K=4 window, nothing more
+    assert tr.sample_counter == 4
+
+
+# --- the demotion matrix ---------------------------------------------------
+
+def test_static_demotions_and_strict():
+    plan = ExecutionPlan.resolve(requested_k=4, profiling=True, silent=True)
+    assert plan.k == 1 and plan.requested_k == 4
+    plan = ExecutionPlan.resolve(requested_k=4, test_io=True, silent=True)
+    assert plan.k == 1
+    with pytest.raises(faults.ScanStrictError) as ei:
+        ExecutionPlan.resolve(requested_k=4, profiling=True, strict=True,
+                              silent=True)
+    assert ei.value.reason == 'profile_dir'
+    # no demotion: strict is satisfied, K stands
+    plan = ExecutionPlan.resolve(requested_k=4, strict=True, silent=True)
+    assert plan.k == 4
+
+
+def test_fallback_note_printed_once_per_reason(capsys):
+    """A run that demotes for reason A must still report a later,
+    different reason B — one note PER REASON, not one note per run."""
+    plan = ExecutionPlan.resolve(requested_k=4, profiling=True)
+    assert plan.note('profile_dir') is None          # already noted
+    msg = plan.note('extra_data')
+    assert msg and 'falls back to per-step' in msg
+    assert plan.note('extra_data') is None
+    out = capsys.readouterr().out
+    assert out.count('falls back to per-step') == 2
+
+
+class _StubTrainer:
+    """Just enough surface for WindowedStepper/round_stepper: records
+    which dispatch path each staged batch took."""
+
+    def __init__(self, extra=False):
+        self.eval_train = 0
+        self.train_metric = ()
+        self.extra = extra
+        self.calls = []
+
+    def compile_multi_step(self, k, train_eval=False):
+        def fn(*_a, **_kw):
+            raise AssertionError('stub scan_fn should not be invoked raw')
+        fn.n_steps = k
+        fn.train_eval = train_eval
+        return fn
+
+    def stage_batch(self, batch):
+        return (batch, None, (1,) if self.extra else (), None, None,
+                0, 0, ())
+
+    def update_staged(self, staged):
+        self.calls.append(('step', staged[0]))
+
+    def update_staged_window(self, fn, window):
+        self.calls.append(('window', [s[0] for s in window]))
+
+
+def test_extra_data_demotes_current_round_only():
+    """The mid-epoch extra_data demotion is a ROUND property: the plan is
+    not mutated, and the next round's stepper re-probes and scans."""
+    plan = ExecutionPlan.resolve(requested_k=2, silent=True)
+    tr = _StubTrainer(extra=True)
+    s1 = plan.round_stepper(tr)
+    for i in range(3):
+        s1.feed(i)
+    s1.finish()
+    assert s1.demoted
+    assert [c[0] for c in tr.calls] == ['step'] * 3
+    assert plan.k == 2                         # no permanent mutation
+    tr2 = _StubTrainer(extra=False)
+    s2 = plan.round_stepper(tr2)
+    for i in range(4):
+        s2.feed(i)
+    s2.finish()
+    assert not s2.demoted
+    assert [c[0] for c in tr2.calls] == ['window', 'window']
+    assert s2.updates == 4
+
+
+def test_extra_data_strict_raises_mid_round():
+    plan = ExecutionPlan.resolve(requested_k=2, strict=True, silent=True)
+    stepper = plan.round_stepper(_StubTrainer(extra=True))
+    with pytest.raises(faults.ScanStrictError) as ei:
+        stepper.feed(0)
+    assert ei.value.reason == 'extra_data'
+
+
+def test_stepper_k1_keeps_one_batch_lookahead():
+    """K=1 IS the classic plain loop: exactly one staged batch rides
+    ahead of the dispatch, and finish() drains it."""
+    tr = _StubTrainer()
+    s = WindowedStepper(tr, k=1, lookahead=1)
+    assert s.feed('a') == 0                    # staged, not dispatched
+    assert s.feed('b') == 1                    # dispatches 'a'
+    assert tr.calls == [('step', 'a')]
+    assert s.finish() == 1                     # drains 'b'
+    assert tr.calls == [('step', 'a'), ('step', 'b')]
+
+
+def test_demotion_matrix_matches_documented_table():
+    """doc/trainer.md's fallback matrix cannot silently rot: its reason
+    keys — and their static/runtime split — must equal the programmatic
+    registry in nnet/execution.py."""
+    doc = open(os.path.join(REPO, 'doc', 'trainer.md')).read()
+    # everything after the matrix heading: the matrix is the last table
+    # in the file, so backtick-keyed rows below the marker are its rows
+    section = doc.split('Fallback matrix', 1)[1]
+    rows = re.findall(r'^\| `(\w+)` \| (.+?) \|', section, re.M)
+    assert {r[0] for r in rows} == set(DEMOTION_REASONS)
+    assert set(execution.STATIC_REASONS) | set(execution.RUNTIME_REASONS) \
+        == set(DEMOTION_REASONS)
+    for key, cond in rows:
+        expect = ('static' if key in execution.STATIC_REASONS
+                  else 'runtime')
+        assert expect in cond, (key, cond)
+
+
+# --- CLI end-to-end twins --------------------------------------------------
+
+def test_cli_supervised_scan_bitwise_twin(tmp_path):
+    """The acceptance run: train.supervise=1 steps_per_dispatch=4 keeps
+    the scanned path (no fallback note) and bitwise-matches the
+    supervised per-step twin — model files AND eval lines."""
+    _write_mnist(tmp_path)
+    conf = tmp_path / 'mlp.conf'
+    conf.write_text(MNIST_CONF)
+    r1 = _run_cli('mlp.conf', str(tmp_path), 'model_dir=m1',
+                  'train.supervise=1')
+    r4 = _run_cli('mlp.conf', str(tmp_path), 'model_dir=m4',
+                  'train.supervise=1', 'steps_per_dispatch=4',
+                  'scan_strict=1')
+    assert 'falls back' not in r4.stdout
+    evals1 = [l for l in r1.stderr.splitlines() if l.startswith('[')]
+    evals4 = [l for l in r4.stderr.splitlines() if l.startswith('[')]
+    assert evals1 == evals4 and len(evals1) == 2
+    for rd in (1, 2):
+        a = (tmp_path / 'm1' / f'{rd:04d}.model').read_bytes()
+        b = (tmp_path / 'm4' / f'{rd:04d}.model').read_bytes()
+        assert a == b, f'round {rd} diverged under supervised scan'
+
+
+def test_cli_update_period_and_metrics_scan_twin(tmp_path):
+    """update_period=2 + eval_train=1 train metrics — the two remaining
+    production demotions — now scan: K=4 vs per-step twin runs produce
+    identical models and identical train-metric eval lines."""
+    _write_mnist(tmp_path)
+    conf = tmp_path / 'mlp.conf'
+    conf.write_text(MNIST_CONF.replace('eval_train = 0', 'eval_train = 1'))
+    r1 = _run_cli('mlp.conf', str(tmp_path), 'model_dir=m1',
+                  'update_period=2')
+    r4 = _run_cli('mlp.conf', str(tmp_path), 'model_dir=m4',
+                  'update_period=2', 'steps_per_dispatch=4',
+                  'scan_strict=1')
+    assert 'falls back' not in r4.stdout
+    evals1 = [l for l in r1.stderr.splitlines() if l.startswith('[')]
+    evals4 = [l for l in r4.stderr.splitlines() if l.startswith('[')]
+    assert evals1 == evals4 and len(evals1) == 2
+    assert all('train-error' in l for l in evals4)
+    for rd in (1, 2):
+        a = (tmp_path / 'm1' / f'{rd:04d}.model').read_bytes()
+        b = (tmp_path / 'm4' / f'{rd:04d}.model').read_bytes()
+        assert a == b, f'round {rd} diverged under update_period scan'
+
+
+def test_cli_supervised_chaos_scan_twin(tmp_path):
+    """The CLI chaos drill: a NaN fired inside a scanned window under
+    train.supervise=1 recovers through the window boundary and the final
+    models bitwise-match an unfaulted supervised per-step run."""
+    _write_mnist(tmp_path)
+    conf = tmp_path / 'mlp.conf'
+    conf.write_text(MNIST_CONF)
+    r1 = _run_cli('mlp.conf', str(tmp_path), 'model_dir=m1',
+                  'train.supervise=1')
+    rf = _run_cli('mlp.conf', str(tmp_path), 'model_dir=mf',
+                  'train.supervise=1', 'steps_per_dispatch=4',
+                  'train.save_every=2', 'train.nan_breaker=1',
+                  'train.fault_plan=nan_at_step=2')
+    assert 'fault plan fired: nan_at_step=2' in rf.stdout
+    for rd in (1, 2):
+        a = (tmp_path / 'm1' / f'{rd:04d}.model').read_bytes()
+        b = (tmp_path / 'mf' / f'{rd:04d}.model').read_bytes()
+        assert a == b, f'round {rd} diverged after scanned-window recovery'
+
+
+def test_cli_scan_strict_raises_typed_error(tmp_path):
+    """scan_strict=1 on a config that would demote (test_io=1) fails
+    loudly with the typed error instead of silently losing the
+    K-dispatch win."""
+    _write_mnist(tmp_path, n_train=200)
+    conf = tmp_path / 'mlp.conf'
+    conf.write_text(MNIST_CONF.replace('num_round = 2', 'num_round = 1'))
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    r = subprocess.run(
+        [sys.executable, '-m', 'cxxnet_tpu.main', 'mlp.conf',
+         'steps_per_dispatch=4', 'scan_strict=1', 'test_io=1'],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=240)
+    assert r.returncode != 0
+    assert 'ScanStrictError' in r.stderr
+    assert 'test_io' in r.stderr

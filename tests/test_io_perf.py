@@ -497,14 +497,20 @@ def test_cli_steps_per_dispatch_bitwise_twin(tmp_path):
 
 
 def test_cli_scan_fallback_matrix(tmp_path):
-    """eval_train=1 with train metrics demotes the scanned loop to
-    per-step, and says so (the fallback matrix, doc/trainer.md)."""
+    """The fallback matrix is profiling/test_io-only now
+    (nnet/execution.py, doc/trainer.md): test_io=1 demotes the scanned
+    loop and says so; eval_train=1 with train metrics SCANS (no note)
+    and still reports its metrics."""
     _write_mnist(tmp_path, n_train=200)
     conf = tmp_path / 'mlp.conf'
+    conf.write_text(MNIST_CONF.replace('num_round = 2', 'num_round = 1'))
+    r = _run_cli('mlp.conf', str(tmp_path), 'steps_per_dispatch=4',
+                 'test_io=1')
+    assert 'falls back to per-step' in r.stdout
     conf.write_text(MNIST_CONF.replace('eval_train = 0', 'eval_train = 1')
                     .replace('num_round = 2', 'num_round = 1'))
     r = _run_cli('mlp.conf', str(tmp_path), 'steps_per_dispatch=4')
-    assert 'falls back to per-step' in r.stdout
+    assert 'falls back' not in r.stdout
     assert 'train-error' in r.stderr
 
 
