@@ -24,6 +24,9 @@ Extra modes for the BASELINE.md ledger (same JSON shape):
   python bench.py scan             # SUPERVISED steps/sec A/B: K=4 scanned
                                    #   dispatch vs per-step with the
                                    #   supervisor on (BENCH_SCAN_r01.json)
+  python bench.py online           # train-while-serve: steps/sec under
+                                   #   live traffic + freshness p50/p99 +
+                                   #   swap count (BENCH_ONLINE_r01.json)
 
 ``CXXNET_BENCH_CONF_EXTRA`` appends config lines (';'-separated) to every
 model bench conf — the execution-plan A/B hook (e.g.
@@ -806,6 +809,135 @@ def bench_scan() -> int:
     return 0
 
 
+def _q_ms(tracker, name: str, q: float):
+    """A tracker quantile in ms, or None when unmeasured — the receipt
+    must stay strict JSON (NaN is not)."""
+    v = tracker.stats.quantile(name, q)
+    return None if v != v else round(v * 1e3, 2)
+
+
+def bench_online() -> int:
+    """Train-while-serve ledger (doc/online.md): the FULL OnlinePipeline —
+    supervised trainer publishing a serving checkpoint every
+    ``save_every`` steps, colocated engine/batcher/registry hot-swapping
+    them under constant-rate traffic — against a train-only supervised
+    twin differing ONLY in the serving stack being absent.  Reports
+    steps/sec while serving, the serving tax (ratio vs train-only),
+    freshness/swap-lag p50/p99, swap count, and the zero-drop counter.
+    On CPU the two tasks share cores, so the tax reads high; on a real
+    chip the serve forwards interleave into trainer bubbles."""
+    import tempfile
+
+    from cxxnet_tpu.io.data import DataBatch, IIterator
+    from cxxnet_tpu.nnet.execution import ExecutionPlan
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.online import OnlineConfig, OnlinePipeline
+    from cxxnet_tpu.runtime.supervisor import (SupervisorConfig,
+                                               TrainSupervisor)
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    batch_size = _bench_batch(64)
+    n_batches = int(os.environ.get('CXXNET_ONLINE_BATCHES', '96'))
+    save_every = int(os.environ.get('CXXNET_ONLINE_SAVE_EVERY', '16'))
+    rounds = int(os.environ.get('CXXNET_ONLINE_ROUNDS', '3'))
+    conf = _SCAN_MLP + f'batch_size = {batch_size}\n' + _extra_conf()
+
+    rng = np.random.RandomState(0)
+    centers = rng.randn(16, 256).astype(np.float32) * 2
+    batches = []
+    for _ in range(n_batches):
+        y = rng.randint(0, 16, batch_size)
+        x = centers[y] + 0.3 * rng.randn(batch_size, 256).astype(np.float32)
+        batches.append(DataBatch(x.reshape(batch_size, 1, 1, 256),
+                                 y[:, None].astype(np.float32)))
+
+    class ListIter(IIterator):
+        def __iter__(self):
+            return iter(batches)
+
+    def request_rows():
+        y = rng.randint(0, 16, 8)
+        return (centers[y]
+                + 0.3 * rng.randn(8, 256).astype(np.float32)
+                ).reshape(8, 1, 1, 256)
+
+    # train-only twin: same supervised loop, no serving stack
+    def train_only(tmp):
+        trainer = NetTrainer(parse_config_string(conf))
+        trainer.init_model()
+        plan = ExecutionPlan.resolve(requested_k=1, silent=True)
+        sup = TrainSupervisor(
+            trainer, os.path.join(tmp, 'train_only'),
+            SupervisorConfig(batch_deadline=120.0, nan_breaker=3,
+                             save_every=save_every, save_async=1))
+        factory = lambda s: iter(batches[s % n_batches:])   # noqa: E731
+        sup.run(factory,
+                make_stepper=lambda: plan.round_stepper(trainer,
+                                                        lookahead=0))
+        t0 = time.perf_counter()
+        n = 0
+        for _ in range(rounds):
+            n += sup.run(factory,
+                         make_stepper=lambda: plan.round_stepper(
+                             trainer, lookahead=0))
+        sup.close()
+        return n / (time.perf_counter() - t0)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rate_train_only = train_only(tmp)
+        trainer = NetTrainer(parse_config_string(conf))
+        trainer.init_model()
+        pipe = OnlinePipeline(
+            trainer, ListIter(),
+            lambda: NetTrainer(parse_config_string(
+                conf + 'inference_only = 1\n')),
+            OnlineConfig(model_dir=os.path.join(tmp, 'online'),
+                         save_every=save_every, reload_poll=0.02,
+                         buckets=(8,), qps=100.0,
+                         watchdog_deadline=120.0, silent=True),
+            request_source=request_rows)
+        import io as _io
+        sink = _io.StringIO()
+        try:
+            warm = pipe.run(num_rounds=1, out=sink)
+            # scope every receipt field to the measured window: drop the
+            # warm round's freshness/lag samples and snapshot its counts
+            # so the reported swaps/served/dropped are deltas
+            pipe.tracker.stats.clear()
+            t0 = time.perf_counter()
+            summary = pipe.run(num_rounds=rounds, start_round=2, out=sink)
+            wall = time.perf_counter() - t0
+        finally:
+            pipe.close(timeout=30.0)
+    steps = rounds * n_batches
+    rate = steps / wall
+    tr = pipe.tracker
+    import jax
+    _emit({
+        'metric': 'online_steps_per_sec_while_serving',
+        'value': round(rate, 1),
+        'unit': 'steps/sec',
+        'platform': jax.devices()[0].platform,
+        'vs_baseline': None,
+        'train_only_steps_per_sec': round(rate_train_only, 1),
+        'serving_tax': round(1.0 - rate / rate_train_only, 3),
+        'freshness_p50_ms': _q_ms(tr, 'freshness_s', 0.5),
+        'freshness_p99_ms': _q_ms(tr, 'freshness_s', 0.99),
+        'swap_lag_p50_ms': _q_ms(tr, 'swap_lag_s', 0.5),
+        'swaps': summary['swaps'] - warm['swaps'],
+        'served': summary['served'] - warm['served'],
+        'dropped': summary['dropped'] - warm['dropped'],
+        'slo_breaches': summary['slo_breaches'] - warm['slo_breaches'],
+        'save_every': save_every,
+        'batch': batch_size,
+        'steps': steps,
+        'rounds': rounds,
+        'timing': f'wall over {rounds} supervised epochs under traffic; '
+                  'warm epoch excluded from every field',
+    })
+    return 0
+
+
 def bench_e2e_alexnet() -> int:
     """END-TO-END AlexNet throughput: the real CLI training-loop path —
     imgbin pages -> native/PIL JPEG decode -> augment (crop+mirror) ->
@@ -1131,6 +1263,7 @@ _MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
           'io': ('host_io_images_per_sec', bench_io),
           'bench_io': ('host_io_images_per_sec', bench_io),  # alias
           'scan': ('supervised_scan_steps_per_sec', bench_scan),
+          'online': ('online_steps_per_sec_while_serving', bench_online),
           'mnist_tta': ('mnist_time_to_2pct_error', bench_mnist_tta),
           'transformer': ('transformer_tokens_per_sec_per_chip',
                           bench_transformer),

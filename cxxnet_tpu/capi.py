@@ -199,6 +199,61 @@ def net_serve_stop(net: Net) -> None:
     net.serve_stop()
 
 
+# ---- train-while-serve surface (CXNNetOnline*) ----------------------------
+
+def net_online_start(net: Net, it: DataIter, cfg: str) -> None:
+    """Start the train-while-serve loop (doc/online.md): training runs on
+    a background thread over ``it`` while the colocated serving stack
+    answers ``net_online_predict``.  ``cfg`` is a compact ``k=v[;k=v...]``
+    list: ``model_dir`` (required), ``rounds``, ``save_every``,
+    ``freshness_slo``/``freshness_strict``, ``reload``, ``buckets``
+    (``:``-separated), ``max_queue``, ``max_wait``, ``deadline``,
+    ``steps_per_dispatch``, ``watchdog_deadline``."""
+    from .utils.config import parse_kv_list
+    kw = {}
+    ints = ('rounds', 'save_every', 'max_queue', 'steps_per_dispatch')
+    floats = ('freshness_slo', 'reload', 'max_wait', 'deadline',
+              'watchdog_deadline')
+    for key, val in parse_kv_list(cfg or ''):
+        if key == 'model_dir':
+            kw['model_dir'] = val
+        elif key == 'buckets':
+            kw['buckets'] = val.replace(':', ',')
+        elif key == 'freshness_strict':
+            kw['freshness_strict'] = bool(int(val))
+        elif key in ints:
+            kw[key] = int(val)
+        elif key in floats:
+            kw[key] = float(val)
+        else:
+            raise ValueError(f'unknown online option: {key!r}')
+    if 'model_dir' not in kw:
+        raise ValueError('online cfg must set model_dir=')
+    net.online_start(it, **kw)
+
+
+def net_online_predict(net: Net, data_mv, dshape) -> np.ndarray:
+    """One request through the live online stack: class id per row.
+    Typed serving errors propagate as Python exceptions."""
+    return _as_f32(net.online_predict(_from_buffer(data_mv, tuple(dshape))))
+
+
+def net_online_stats(net: Net) -> str:
+    return net.online_stats()
+
+
+def net_online_wait(net: Net) -> str:
+    """Block until the background training run finishes; returns its
+    summary as one JSON line (freshness p50/p99, swaps, served,
+    dropped, ...)."""
+    import json
+    return json.dumps(net.online_wait(), sort_keys=True)
+
+
+def net_online_stop(net: Net) -> None:
+    net.online_stop()
+
+
 # ---- continuous decode surface (CXNLMServe*) ------------------------------
 
 def lm_serve_start(cfg: str):

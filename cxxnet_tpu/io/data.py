@@ -261,7 +261,7 @@ def create_iterator(cfg: List[ConfigEntry]) -> IIterator:
             if val == 'mnist':
                 assert it is None, 'mnist cannot chain over another iterator'
                 it = MNISTIterator()
-            elif val in ('imgbin', 'imgbinx', 'img'):
+            elif val in ('imgbin', 'imgbinx', 'imgbin_stream', 'img'):
                 assert it is None, f'{val} cannot chain over another iterator'
                 from .iter_augment import AugmentIterator
                 if val == 'img':
@@ -270,6 +270,9 @@ def create_iterator(cfg: List[ConfigEntry]) -> IIterator:
                 elif val == 'imgbinx':
                     from .iter_imbin import ImageBinXIterator
                     src = ImageBinXIterator()
+                elif val == 'imgbin_stream':
+                    from .iter_stream import ImageBinStreamIterator
+                    src = ImageBinStreamIterator()
                 else:
                     from .iter_imbin import ImageBinIterator
                     src = ImageBinIterator()

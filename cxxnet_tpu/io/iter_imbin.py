@@ -37,13 +37,20 @@ from .data import DataInst, IIterator
 from .iter_img import parse_lst_line
 
 
-def scan_page_table(bin_path: str):
+def scan_page_table(bin_path: str, start_page: int = 0):
     """Per-page object counts of a ``.bin`` file, read from the page
-    headers only (4 bytes at each 64MB boundary) — no payload IO."""
+    headers only (4 bytes at each 64MB boundary) — no payload IO.
+    ``start_page`` skips already-scanned pages: re-scanning a GROWN file
+    reads only the appended pages' headers (the file size is read fresh
+    on every call, never cached across calls — an appendable file's size
+    is only valid for the scan that observed it).  Only COMPLETE pages
+    are reported; a partially-appended tail page is invisible until the
+    writer finishes it."""
     counts = []
     size = os.path.getsize(bin_path)
     with open(bin_path, 'rb') as f:
-        for off in range(0, size - BinaryPage.N_BYTES + 1, BinaryPage.N_BYTES):
+        for off in range(start_page * BinaryPage.N_BYTES,
+                         size - BinaryPage.N_BYTES + 1, BinaryPage.N_BYTES):
             f.seek(off)
             counts.append(int.from_bytes(f.read(4), 'little'))
     return counts
@@ -144,7 +151,9 @@ class ImageBinIterator(IIterator):
 
     def _page_starts(self, part):
         """(counts, starts): per-page object counts and the cumulative
-        .lst line offset of each page of this part."""
+        .lst line offset of each page of this part.  Cached per part;
+        :meth:`_refresh_page_table` extends the cache when the file has
+        grown."""
         if part not in self._tables:
             counts = scan_page_table(self._bins[part])
             starts = [0]
@@ -152,6 +161,20 @@ class ImageBinIterator(IIterator):
                 starts.append(starts[-1] + c)
             self._tables[part] = (counts, starts)
         return self._tables[part]
+
+    def _refresh_page_table(self, part):
+        """Extend the cached page table with any pages appended since it
+        was last scanned, reading ONLY the new pages' headers — a
+        re-opened/grown file yields its new tail without re-reading (or
+        re-decoding) the pages already indexed.  The incremental scan
+        the streaming source (``imgbin_stream``) polls on."""
+        if part not in self._tables:
+            return self._page_starts(part)
+        counts, starts = self._tables[part]
+        for c in scan_page_table(self._bins[part], start_page=len(counts)):
+            counts.append(c)
+            starts.append(starts[-1] + c)
+        return counts, starts
 
     def _page_stream(self, part, page_order=None):
         """Yield (page_idx, blobs); ``page_order=None`` streams the file

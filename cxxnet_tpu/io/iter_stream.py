@@ -1,0 +1,205 @@
+"""Streaming imgbin source (``iter = imgbin_stream``): tail an
+APPENDABLE ``.bin``/``.lst`` pair instead of snapshotting it.
+
+The train-while-serve pipeline (doc/online.md) ingests data that keeps
+arriving: a producer appends complete ``BinaryPage`` records to the
+``.bin`` and their ``index \\t labels \\t name`` lines to the ``.lst``
+(:func:`append_records` is the writer-side helper with the required
+commit order — lines first, then the page).  This source reads the file
+front-to-back like plain ``imgbin``, and when it catches up it polls for
+growth (``stream_poll`` seconds between checks) and continues into the
+new tail; a pass ends after ``stream_idle`` seconds with no growth
+(``stream_idle = 0`` = snapshot pass: read what's there, stop at EOF).
+
+Determinism contract (tested in ``tests/test_online.py``):
+
+* **bitwise twin** — over the same final bytes, the stream yields
+  exactly the instance sequence a static ``imgbin`` pass yields, no
+  matter how the file grew while it was being read (append-only order
+  IS arrival order; ``shuffle=1`` is rejected — a tail reader cannot
+  permute pages it hasn't seen),
+* **incremental tail** — catching up after growth re-reads ONLY the new
+  pages (header scan via ``ImageBinIterator._refresh_page_table``),
+  never re-decoding pages already consumed,
+* **epoch-absolute indexing preserved** — ``iter_thunks`` (the
+  ``nworker`` pool's submission stream) derives from the same page walk
+  as ``__iter__``, so per-instance augmentation RNG (seeded from the
+  epoch-absolute instance index, doc/io.md) is bitwise identical to the
+  static source and to any worker count,
+* **replay-stable** — an append-only file replays the same prefix, so
+  supervised fault recovery may re-wind the stream to batch k
+  (``is_replay_stable`` is True; the whole chaos contract of
+  doc/online.md hangs on it).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..utils.io_stream import BinaryPage
+from .iter_img import parse_lst_line
+from .iter_imbin import ImageBinIterator
+
+
+def append_records(bin_path: str, lst_path: str, records) -> int:
+    """Writer-side helper: append ``records`` — an iterable of
+    ``(index, label_or_labels, blob)`` — as one or more complete
+    ``BinaryPage``s.  Commit order is the stream reader's contract:
+    ``.lst`` lines first (flushed + fsynced), then the page bytes — a
+    reader that sees a page always finds its lines.  Returns the number
+    of records appended."""
+    records = list(records)
+    if not records:
+        return 0
+    with open(lst_path, 'a') as fl:
+        for index, labels, _blob in records:
+            try:
+                lab = '\t'.join(f'{float(v):g}' for v in labels)
+            except TypeError:
+                lab = f'{float(labels):g}'
+            fl.write(f'{index}\t{lab}\tstream\n')
+        fl.flush()
+        os.fsync(fl.fileno())
+    page = BinaryPage()
+    with open(bin_path, 'ab') as fb:
+        for _index, _labels, blob in records:
+            if not page.push(blob):
+                page.save(fb)
+                page.clear()
+                if not page.push(blob):
+                    raise ValueError('append_records: blob larger than '
+                                     'a page')
+        if page.size:
+            page.save(fb)
+        fb.flush()
+        os.fsync(fb.fileno())
+    return len(records)
+
+
+class ImageBinStreamIterator(ImageBinIterator):
+    """Tail one appendable imgbin file (see module docstring).
+
+    Config keys beyond plain ``imgbin`` (``image_list``/``image_bin``):
+
+    * ``stream_poll``  — seconds between growth checks once caught up
+      (default 0.05),
+    * ``stream_idle``  — end the pass after this many seconds with no
+      growth; 0 (default) reads the current snapshot and stops at EOF.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self.stream_poll = 0.05
+        self.stream_idle = 0.0
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == 'stream_poll':
+            self.stream_poll = float(val)
+        if name == 'stream_idle':
+            self.stream_idle = float(val)
+
+    def init(self):
+        if self.conf_prefix:
+            raise ValueError('imgbin_stream tails ONE appendable file; '
+                             'multi-part image_conf_prefix datasets are '
+                             'a static-imgbin feature')
+        if self.shuffle:
+            raise ValueError(
+                'imgbin_stream cannot shuffle: a tail reader cannot '
+                'permute pages it has not seen yet — arrival order IS '
+                'the stream order (and the bitwise-twin/replay contract '
+                'depends on it)')
+        if self.dist_num_worker > 1:
+            raise ValueError('imgbin_stream does not shard across '
+                             'workers yet (single-tail contract)')
+        super().init()
+        # incremental .lst tail state (the .lst twin of the page-table
+        # refresh): parsed lines + the byte offset they came from
+        self._lines_buf = []
+        self._lst_offset = 0
+
+    def is_replay_stable(self) -> bool:
+        # append-only: every pass replays the same prefix in the same
+        # order — supervised recovery may re-wind this stream
+        return True
+
+    def _load_lines(self, part):
+        """Incremental tail read — the ``.lst`` twin of
+        :meth:`_refresh_page_table`: only bytes appended since the last
+        read are parsed (a long-lived stream must not re-parse the whole
+        file per page), and a trailing line not yet terminated by
+        ``\\n`` stays unconsumed until the writer finishes it.  The
+        file is append-only by contract, so the accumulated parse is
+        the file's parse."""
+        try:
+            with open(self._lists[part], 'rb') as f:
+                f.seek(self._lst_offset)
+                chunk = f.read()
+        except FileNotFoundError:
+            return self._lines_buf
+        if chunk:
+            cut = chunk.rfind(b'\n')
+            if cut >= 0:
+                text = chunk[:cut + 1].decode()
+                self._lst_offset += cut + 1
+                self._lines_buf.extend(
+                    parse_lst_line(l) for l in text.split('\n')
+                    if l.strip())
+        return self._lines_buf
+
+    def _await_lines(self, part, need: int):
+        """The ``.lst`` lines covering the first ``need`` instances.
+        A page committed before its lines are visible gets a short grace
+        (the writer contract is lines-first, so this only waits out a
+        racing writer), then fails like the static reader."""
+        lines = self._load_lines(part)
+        if len(lines) >= need:
+            return lines
+        budget = max(self.stream_idle, 10 * self.stream_poll)
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            time.sleep(self.stream_poll)
+            lines = self._load_lines(part)
+            if len(lines) >= need:
+                return lines
+        raise RuntimeError('imgbin_stream: .lst shorter than .bin '
+                           f'contents ({len(lines)} lines < {need} '
+                           'instances) — append lines before pages')
+
+    def _epoch_pages(self, rng_page):
+        """One streaming pass at page granularity: drain every complete
+        page on disk, then poll for growth until ``stream_idle`` elapses
+        with none.  Only the APPENDED pages are header-scanned on growth
+        (:meth:`_refresh_page_table`); consumed pages are never re-read."""
+        part = 0
+        pidx = 0
+        idle_since = None
+        while True:
+            try:
+                counts, starts = self._refresh_page_table(part)
+            except FileNotFoundError:
+                # the writer hasn't created the file yet: an empty
+                # stream, not an error — poll like any caught-up tail
+                counts, starts = [], [0]
+            if pidx < len(counts):
+                idle_since = None
+                order = list(range(pidx, len(counts)))
+                pidx = len(counts)
+                for p, blobs in self._page_stream(part, order):
+                    if len(blobs) != counts[p]:
+                        raise RuntimeError(
+                            f'imgbin_stream: page {p} holds {len(blobs)} '
+                            f'objects but its header said {counts[p]}')
+                    lines = self._await_lines(part, starts[p] + len(blobs))
+                    yield blobs, lines[starts[p]:starts[p] + len(blobs)]
+                continue
+            if self.stream_idle <= 0:
+                return
+            now = time.monotonic()
+            if idle_since is None:
+                idle_since = now
+            elif now - idle_since >= self.stream_idle:
+                return
+            time.sleep(self.stream_poll)

@@ -90,6 +90,13 @@ class LearnTask:
         self.serve_seed = 0            # serve.seed drive prompt/rng seed
         self.serve_models = ''         # serve.models fleet: id=dir;id=dir
         self.serve_mem_budget = 0      # serve.mem_budget bytes (0 = off)
+        # train-while-serve (task=online, doc/online.md); batcher shape
+        # comes from the serve.* keys above
+        self.online_save_every = 8     # online.save_every steps/checkpoint
+        self.online_freshness_slo = 0.0  # online.freshness_slo seconds
+        self.online_freshness_strict = 0  # online.freshness_strict 1=raise
+        self.online_reload = 0.05      # online.reload registry poll (s)
+        self.online_qps = 50.0         # online.qps traffic driver rate
         self.cfg: List[ConfigEntry] = []
         self.net_trainer: Optional[NetTrainer] = None
         self.itr_train = None
@@ -142,6 +149,11 @@ class LearnTask:
             'serve.seed': ('serve_seed', int),
             'serve.models': ('serve_models', str),
             'serve.mem_budget': ('serve_mem_budget', int),
+            'online.save_every': ('online_save_every', int),
+            'online.freshness_slo': ('online_freshness_slo', float),
+            'online.freshness_strict': ('online_freshness_strict', int),
+            'online.reload': ('online_reload', float),
+            'online.qps': ('online_qps', float),
         }
         if name in simple:
             attr, typ = simple[name]
@@ -330,7 +342,7 @@ class LearnTask:
                     self.itr_evals.append(create_iterator(itcfg))
                     self.eval_names.append(evname)
                 if flag == 3 and self.task in ('pred', 'pred_raw', 'extract',
-                                               'serve'):
+                                               'serve', 'online'):
                     assert self.itr_pred is None, 'only one pred section'
                     self.itr_pred = create_iterator(itcfg)
                 flag = 0
@@ -362,7 +374,8 @@ class LearnTask:
             return
         self.continue_training = 0
         if self.name_model_in == 'NULL':
-            assert self.task == 'train', 'must specify model_in if not training'
+            assert self.task in ('train', 'online'), \
+                'must specify model_in if not training'
             self.net_trainer = self._create_net()
             self.net_trainer.init_model()
         elif self.task == 'finetune':
@@ -680,6 +693,10 @@ class LearnTask:
                 registry.close(timeout=5.0)
             batcher.close(timeout=30.0)
             sys.stderr.write(f'[serve]{batcher.report("serve")}\n')
+            if registry is not None:
+                # swap stamps: which step is serving and how stale it is
+                # (the serving half of the freshness metric, doc/online.md)
+                sys.stderr.write(f'[serve]{registry.report()}\n')
             if fleet is not None:
                 sys.stderr.write(f'[serve]{fleet.report()}\n')
                 fleet.close(timeout=5.0)
@@ -687,6 +704,90 @@ class LearnTask:
         print(f'finished serving {served} instances, predictions in '
               f'{self.name_pred} (compiled {engine.compile_count} programs '
               f'for {len(engine.buckets)} buckets)')
+
+    def task_online(self) -> None:
+        """``task=online``: the train-while-serve loop (doc/online.md) —
+        a supervised trainer over the ``data=`` section (idiomatically
+        ``iter = imgbin_stream``) publishing a serving checkpoint every
+        ``online.save_every`` steps, while the colocated
+        engine/batcher/registry stack hot-reloads them under traffic
+        replayed from the ``pred=`` section at ``online.qps``.  Each
+        round's eval line carries the freshness gauges; the serving
+        ledger and a one-line JSON summary print at shutdown."""
+        assert self.itr_train is not None, 'task=online needs a data section'
+        import json
+
+        import numpy as np
+
+        from .online import OnlineConfig, OnlinePipeline
+        from .utils.bucketing import parse_buckets
+
+        request_source = None
+        if self.itr_pred is not None:
+            # replay the pred section's (normalized) rows cyclically —
+            # the CLI's stand-in for a fronting server's live traffic
+            rows_pool = []
+            for batch in self.itr_pred:
+                n = batch.batch_size - batch.num_batch_padd
+                if not n:
+                    continue
+                data = batch.data
+                if batch.norm_spec is not None:
+                    data = batch.norm_spec.apply(data)
+                rows_pool.append(np.ascontiguousarray(
+                    np.asarray(data, np.float32)[:n]))
+            if rows_pool:
+                state = {'i': 0}
+
+                def request_source():
+                    r = rows_pool[state['i'] % len(rows_pool)]
+                    state['i'] += 1
+                    return r
+        # online runs default to async publishing (the whole point is a
+        # step loop that never waits on storage); an explicit
+        # save_async=0 in the conf still wins
+        save_async = self.save_async
+        if not any(k == 'save_async' for k, _ in self.cfg):
+            save_async = 1
+        cfg = OnlineConfig(
+            model_dir=self.name_model_dir,
+            save_every=self.online_save_every,
+            save_workers=self.save_workers,
+            freshness_slo=self.online_freshness_slo,
+            freshness_strict=bool(self.online_freshness_strict),
+            reload_poll=self.online_reload,
+            buckets=parse_buckets(self.serve_buckets),
+            max_queue=self.serve_max_queue,
+            max_wait=self.serve_max_wait,
+            deadline=self.serve_deadline,
+            qps=self.online_qps,
+            watchdog_deadline=self.watchdog_deadline or None,
+            max_restarts=self.max_restarts,
+            nan_breaker=self.nan_breaker,
+            keep_last=self.keep_last,
+            save_async=save_async,
+            steps_per_dispatch=self.steps_per_dispatch,
+            net_type=self.net_type,
+            silent=bool(self.silent))
+        serve_factory = (
+            lambda: NetTrainer(self.cfg + [('inference_only', '1')]))
+        pipe = OnlinePipeline(self.net_trainer, self.itr_train,
+                              serve_factory, cfg,
+                              request_source=request_source)
+        print('start online training-while-serving...')
+        start = time.time()
+        try:
+            summary = pipe.run(
+                num_rounds=self.num_round,
+                evals=list(zip(self.itr_evals, self.eval_names)),
+                before_step=lambda i: self._progress(i + 1, start))
+            sys.stderr.write(f'[online]{pipe.serve_report()}\n')
+            sys.stderr.flush()
+            print(f'online summary: {json.dumps(summary, sort_keys=True)}',
+                  flush=True)
+        finally:
+            pipe.close(timeout=30.0)
+        print(f'finished online run, {int(time.time() - start)} sec in all')
 
     def _lm_spec(self):
         """Build the decode model: ``serve.lm`` is a compact
@@ -872,6 +973,8 @@ class LearnTask:
                 self.task_serve_decode()
             else:
                 self.task_serve()
+        elif self.task == 'online':
+            self.task_online()
         if plan is not None and not self.silent:
             # chaos-drill closure: which events actually fired, and what
             # the runtime saw/did about them (doc/fault_tolerance.md)

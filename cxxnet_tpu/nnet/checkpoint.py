@@ -233,6 +233,56 @@ def save_model_file(path: str, write_fn: Callable, retry=None) -> str:
     return os.fspath(path)
 
 
+def publish_model_file(path: str, write_fn: Callable, retry=None) -> str:
+    """Atomic model-file publish for hot-reload watchers (the online
+    pipeline's serving checkpoints, doc/online.md): like
+    :func:`save_model_file` + :func:`write_model_digest`, but the digest
+    sidecar is computed from the staged bytes and committed BEFORE the
+    model file is renamed into place.  A watcher polling the directory
+    can therefore never observe a model without its digest — the
+    save-then-digest order of the train CLI leaves a brief no-sidecar
+    window in which the registry's "unverified-but-plausible" policy
+    would adopt the file unchecked.  The ``corrupt_model`` chaos hook
+    fires on the STAGED file, between digest and rename, so an injected
+    corruption is deterministically caught by digest verification —
+    there is no instant at which the poisoned bytes are visible
+    unverifiable."""
+    import json
+
+    from ..runtime import faults
+    retry = faults.DEFAULT_IO_RETRY if retry is None else retry
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = os.path.join(d, f'.{os.path.basename(path)}.pub.{os.getpid()}')
+
+    def attempt():
+        faults.checkpoint_write_attempt(path)
+        os.makedirs(d, exist_ok=True)
+        try:
+            with open(tmp, 'wb') as f:
+                write_fn(f)
+                f.flush()
+                os.fsync(f.fileno())
+            digest = {'size': os.path.getsize(tmp),
+                      'crc32': file_crc32(tmp)}
+            with atomic_write(model_digest_path(path)) as f:
+                f.write(json.dumps(digest).encode())
+            # chaos hook on the STAGED file: the digest above recorded
+            # the good bytes, so a truncation here is caught by verify
+            # the moment the file becomes visible
+            faults.model_committed(path, staged=tmp)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    retry.call(attempt, op_name=f'publish_model:{os.path.basename(path)}')
+    return path
+
+
 def read_model_file(path: str, read_fn: Callable, retry=None):
     """Read a model file with retry: ``read_fn(fileobj)``'s return value is
     passed through.  A missing file raises immediately (not retryable —
@@ -274,10 +324,16 @@ def write_model_digest(path: str) -> str:
     reader (``serve/registry.py`` verifies it before swapping a new
     checkpoint into a live engine)."""
     import json
+
+    from ..runtime import faults
     digest = {'size': os.path.getsize(path), 'crc32': file_crc32(path)}
     side = model_digest_path(path)
     with atomic_write(side) as f:
         f.write(json.dumps(digest).encode())
+    # commit point for chaos drills: file + sidecar both durable — the
+    # corrupt_model event truncates the model HERE so a hot-reloading
+    # registry must catch the mismatch (runtime/faults.py)
+    faults.model_committed(path)
     return side
 
 

@@ -1,0 +1,15 @@
+"""Train-while-serve: the continuous online-learning subsystem
+(doc/online.md).
+
+``OnlinePipeline`` runs a long-lived supervised trainer and a colocated
+serving stack as ONE orchestrated process: the trainer async-saves
+``%04d.model`` checkpoints every N steps, a ``ModelRegistry``-backed
+``PredictEngine`` watches the same directory and hot-swaps them under
+live traffic, and a ``FreshnessTracker`` measures the step-to-serving
+lag of every swap against a configurable SLO.
+"""
+
+from .freshness import FreshnessTracker
+from .pipeline import OnlineConfig, OnlinePipeline
+
+__all__ = ['FreshnessTracker', 'OnlineConfig', 'OnlinePipeline']

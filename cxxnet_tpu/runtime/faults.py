@@ -157,6 +157,26 @@ class DecodePagesExhaustedError(ServeError):
             f'after {tokens_emitted} tokens')
 
 
+class FreshnessSLOError(ServeError):
+    """The train-while-serve freshness SLO was breached: a hot-swapped
+    model version took longer than ``online.freshness_slo`` seconds to
+    travel from its optimizer step to the first request served on it
+    (doc/online.md).  An *observability* outcome, not a request error:
+    the pipeline counts breaches per swap and only raises (strict mode)
+    at run boundaries — a stale-but-correct model must keep serving."""
+
+    def __init__(self, step: int, freshness_s: float, slo_s: float,
+                 breaches: int = 1):
+        self.step = int(step)
+        self.freshness_s = float(freshness_s)
+        self.slo_s = float(slo_s)
+        self.breaches = int(breaches)
+        super().__init__(
+            f'freshness SLO breached: checkpoint step {step} first served '
+            f'{freshness_s:.3f}s after its optimizer step '
+            f'(slo={slo_s:g}s, {breaches} breach(es) total)')
+
+
 class MemoryBudgetExceededError(ServeError):
     """Loading a model would exceed the serve fleet's device-memory
     budget and no cold model could be evicted to make room."""
@@ -317,7 +337,7 @@ def _parse_event(val: str) -> Tuple[int, Optional[float]]:
 
 
 class FaultPlan:
-    """A seeded plan of one-shot fault events, driven by ambient hooks.
+    """A seeded plan of fault events, driven by ambient hooks.
 
     Event kinds (grammar ``kind=arg[;kind=arg...]``, parsed from the
     ``train.fault_plan=`` config value by :meth:`parse`):
@@ -338,17 +358,46 @@ class FaultPlan:
     * ``nan_at_step=S`` — the loss observed at sample step S reads as NaN,
       exercising ``nan_action`` / the divergence circuit breaker without
       needing genuinely divergent math.
+    * ``corrupt_model=N`` — after the N-th ``%04d.model`` file *commits*
+      (model bytes + digest sidecar both on disk), the model file is
+      truncated so a hot-reloading server's digest verification must
+      reject it (the serving half of the chaos contract,
+      doc/online.md).
 
-    Every event fires at most once; :meth:`fired` exposes what actually
-    triggered so tests can assert the plan executed.  All hooks are
-    thread-safe (the stall hook runs on the producer thread)."""
+    Any event kind also accepts the RECURRING form ``kind@every=K``
+    (e.g. ``raise_on_write@every=3``, ``stall_batch@every=50:0.2``):
+    the event fires deterministically on every K-th occurrence of its
+    hook (1-based count / step multiples of K) for the life of the
+    plan — how a long-lived online run keeps faults arriving instead of
+    spending its plan in the first minute.  One-shot events fire at
+    most once; :meth:`fired` exposes everything that actually triggered
+    (recurring firings are tagged ``kind@every=K#occurrence``) so tests
+    can assert the plan executed.  All hooks are thread-safe (the stall
+    hook runs on the producer thread)."""
 
     def __init__(self, seed: int = 0,
                  raise_on_write: Tuple[int, ...] = (),
                  stall_batch: Tuple[Tuple[int, Optional[float]], ...] = (),
                  corrupt_shard: Tuple[int, ...] = (),
                  nan_at_step: Tuple[int, ...] = (),
-                 stall_write: Tuple[Tuple[int, Optional[float]], ...] = ()):
+                 stall_write: Tuple[Tuple[int, Optional[float]], ...] = (),
+                 corrupt_model: Tuple[int, ...] = (),
+                 raise_on_write_every: Tuple[int, ...] = (),
+                 stall_batch_every: Tuple[Tuple[int, Optional[float]],
+                                          ...] = (),
+                 corrupt_shard_every: Tuple[int, ...] = (),
+                 nan_at_step_every: Tuple[int, ...] = (),
+                 stall_write_every: Tuple[Tuple[int, Optional[float]],
+                                          ...] = (),
+                 corrupt_model_every: Tuple[int, ...] = ()):
+        def _periods(vals):
+            out = set()
+            for k in vals:
+                if int(k) <= 0:
+                    raise ValueError(f'@every period must be > 0, got {k}')
+                out.add(int(k))
+            return out
+
         self.seed = int(seed)
         self._raise_on_write = set(raise_on_write)
         self._stall = {k: (30.0 if s is None else s) for k, s in stall_batch}
@@ -356,7 +405,27 @@ class FaultPlan:
                              for n, s in stall_write}
         self._corrupt = set(corrupt_shard)
         self._nan = set(nan_at_step)
+        self._corrupt_model = set(corrupt_model)
+        # recurring (@every=K) variants: period -> fire on every K-th
+        # occurrence; deterministic, never consumed
+        self._raise_every = _periods(raise_on_write_every)
+        self._stall_every = {int(k): (30.0 if s is None else s)
+                             for k, s in stall_batch_every}
+        self._stall_write_every = {int(k): (0.5 if s is None else s)
+                                   for k, s in stall_write_every}
+        self._corrupt_every = _periods(corrupt_shard_every)
+        self._nan_every = _periods(nan_at_step_every)
+        self._corrupt_model_every = _periods(corrupt_model_every)
+        if 0 in self._stall_every or 0 in self._stall_write_every:
+            raise ValueError('@every period must be > 0')
+        # step-keyed recurring events fire once per DISTINCT step: a
+        # supervised restore replays step numbers, and re-firing on the
+        # replay would turn every recovery into a death loop (the
+        # count-based hooks are monotone and need no such guard)
+        self._nan_fired_steps: set = set()
+        self._corrupt_fired_steps: set = set()
         self._write_count = 0
+        self._model_count = 0
         self._fired: List[str] = []
         self._lock = threading.Lock()
 
@@ -364,29 +433,28 @@ class FaultPlan:
     def parse(cls, text: str) -> 'FaultPlan':
         from ..utils.config import parse_kv_list
         seed = 0
-        raise_w: List[int] = []
-        stall: List[Tuple[int, Optional[float]]] = []
-        stall_w: List[Tuple[int, Optional[float]]] = []
-        corrupt: List[int] = []
-        nan: List[int] = []
+        kw: Dict[str, list] = {
+            'raise_on_write': [], 'stall_batch': [], 'stall_write': [],
+            'corrupt_shard': [], 'nan_at_step': [], 'corrupt_model': [],
+            'raise_on_write_every': [], 'stall_batch_every': [],
+            'stall_write_every': [], 'corrupt_shard_every': [],
+            'nan_at_step_every': [], 'corrupt_model_every': []}
+        timed = ('stall_batch', 'stall_write',
+                 'stall_batch_every', 'stall_write_every')
         for key, val in parse_kv_list(text):
             if key == 'seed':
                 seed = int(val)
-            elif key == 'raise_on_write':
-                raise_w.append(int(val))
-            elif key == 'stall_batch':
-                stall.append(_parse_event(val))
-            elif key == 'stall_write':
-                stall_w.append(_parse_event(val))
-            elif key == 'corrupt_shard':
-                corrupt.append(int(val))
-            elif key == 'nan_at_step':
-                nan.append(int(val))
-            else:
+                continue
+            # recurring form: kind@every=K (keeps one-shot specs intact)
+            kind, at, mod = key.partition('@')
+            if at and mod != 'every':
                 raise ValueError(f'unknown fault_plan event: {key!r}')
-        return cls(seed=seed, raise_on_write=tuple(raise_w),
-                   stall_batch=tuple(stall), corrupt_shard=tuple(corrupt),
-                   nan_at_step=tuple(nan), stall_write=tuple(stall_w))
+            name = f'{kind}_every' if at else kind
+            if name not in kw:
+                raise ValueError(f'unknown fault_plan event: {key!r}')
+            kw[name].append(_parse_event(val) if name in timed
+                            else int(val))
+        return cls(seed=seed, **{k: tuple(v) for k, v in kw.items()})
 
     # -- introspection --
     def fired(self) -> List[str]:
@@ -400,13 +468,35 @@ class FaultPlan:
     def describe(self) -> str:
         parts = [f'seed={self.seed}']
         parts += [f'raise_on_write={n}' for n in sorted(self._raise_on_write)]
+        parts += [f'raise_on_write@every={k}'
+                  for k in sorted(self._raise_every)]
         parts += [f'stall_batch={k}:{s:g}'
                   for k, s in sorted(self._stall.items())]
+        parts += [f'stall_batch@every={k}:{s:g}'
+                  for k, s in sorted(self._stall_every.items())]
         parts += [f'stall_write={n}:{s:g}'
                   for n, s in sorted(self._stall_write.items())]
+        parts += [f'stall_write@every={n}:{s:g}'
+                  for n, s in sorted(self._stall_write_every.items())]
         parts += [f'corrupt_shard={s}' for s in sorted(self._corrupt)]
+        parts += [f'corrupt_shard@every={s}'
+                  for s in sorted(self._corrupt_every)]
+        parts += [f'corrupt_model={s}' for s in sorted(self._corrupt_model)]
+        parts += [f'corrupt_model@every={s}'
+                  for s in sorted(self._corrupt_model_every)]
         parts += [f'nan_at_step={s}' for s in sorted(self._nan)]
+        parts += [f'nan_at_step@every={s}' for s in sorted(self._nan_every)]
         return ';'.join(parts)
+
+    @staticmethod
+    def _periodic_hit(count: int, periods) -> Optional[int]:
+        """The period that makes occurrence ``count`` (1-based) fire, or
+        None.  Smallest matching period wins the tag; one fire per
+        occurrence regardless of how many periods divide it."""
+        for k in sorted(periods):
+            if count > 0 and count % k == 0:
+                return k
+        return None
 
     # -- hooks (called from production code when a plan is installed) --
     def on_checkpoint_write(self, path: str) -> None:
@@ -418,10 +508,20 @@ class FaultPlan:
             secs = self._stall_write.pop(n, None)
             if secs is not None:
                 self._fired.append(f'stall_write={n}:{secs:g}')
+            else:
+                k = self._periodic_hit(n, self._stall_write_every)
+                if k is not None:
+                    secs = self._stall_write_every[k]
+                    self._fired.append(f'stall_write@every={k}#{n}')
             hit = n in self._raise_on_write
             if hit:
                 self._raise_on_write.discard(n)
                 self._fired.append(f'raise_on_write={n}')
+            else:
+                k = self._periodic_hit(n, self._raise_every)
+                if k is not None:
+                    hit = True
+                    self._fired.append(f'raise_on_write@every={k}#{n}')
         if secs is not None:
             time.sleep(secs)
         if hit:
@@ -430,19 +530,26 @@ class FaultPlan:
 
     def on_pipeline_item(self, scope: str, index: int) -> None:
         """Producer-side hook, per item; only batch-scoped buffers
-        participate (inner page/instance buffers pass other scopes)."""
+        participate (inner page/instance buffers pass other scopes).
+        Recurring stalls count batches 1-based (batch index K-1 is the
+        K-th batch)."""
         if scope != 'batch':
             return
         with self._lock:
             secs = self._stall.pop(index, None)
             if secs is not None:
                 self._fired.append(f'stall_batch={index}:{secs:g}')
+            else:
+                k = self._periodic_hit(index + 1, self._stall_every)
+                if k is not None:
+                    secs = self._stall_every[k]
+                    self._fired.append(f'stall_batch@every={k}#{index}')
         if secs is not None:
             time.sleep(secs)
 
     def has_nan_events(self) -> bool:
         with self._lock:
-            return bool(self._nan)
+            return bool(self._nan) or bool(self._nan_every)
 
     def on_loss(self, step: int, loss: float) -> float:
         with self._lock:
@@ -450,17 +557,55 @@ class FaultPlan:
                 self._nan.discard(step)
                 self._fired.append(f'nan_at_step={step}')
                 return float('nan')
+            k = self._periodic_hit(step, self._nan_every)
+            if k is not None and step not in self._nan_fired_steps:
+                self._nan_fired_steps.add(step)
+                self._fired.append(f'nan_at_step@every={k}#{step}')
+                return float('nan')
         return loss
+
+    def on_model_committed(self, path: str) -> None:
+        """After the N-th model-file commit (file + digest sidecar both
+        durable), truncate the model file: the digest no longer matches,
+        so a hot-reloading registry must reject the checkpoint and keep
+        the previous version serving (doc/online.md chaos drill)."""
+        with self._lock:
+            self._model_count += 1
+            n = self._model_count
+            hit = n in self._corrupt_model
+            if hit:
+                self._corrupt_model.discard(n)
+                self._fired.append(f'corrupt_model={n}')
+            else:
+                k = self._periodic_hit(n, self._corrupt_model_every)
+                if k is not None:
+                    hit = True
+                    self._fired.append(f'corrupt_model@every={k}#{n}')
+        if not hit:
+            return
+        import os
+        size = os.path.getsize(path)
+        if size > 1:
+            with open(path, 'r+b') as f:
+                f.truncate(size // 2)
+        else:
+            os.unlink(path)
 
     def on_shard_committed(self, step: int, path: str) -> None:
         """Truncate one payload file of a just-committed sharded
         checkpoint (seeded pick) so restore-time verification must
-        reject it."""
+        reject it.  Recurring form fires on every step that is a
+        multiple of K."""
         with self._lock:
-            if step not in self._corrupt:
-                return
-            self._corrupt.discard(step)
-            self._fired.append(f'corrupt_shard={step}')
+            if step in self._corrupt:
+                self._corrupt.discard(step)
+                self._fired.append(f'corrupt_shard={step}')
+            else:
+                k = self._periodic_hit(step, self._corrupt_every)
+                if k is None or step in self._corrupt_fired_steps:
+                    return
+                self._corrupt_fired_steps.add(step)
+                self._fired.append(f'corrupt_shard@every={k}#{step}')
         import os
         victims = []
         for root, _dirs, files in os.walk(path):
@@ -520,3 +665,16 @@ def shard_committed(step: int, path: str) -> None:
     plan = _ACTIVE
     if plan is not None:
         plan.on_shard_committed(step, path)
+
+
+def model_committed(path: str, staged: Optional[str] = None) -> None:
+    """Call when a model file's bytes + digest are both durable.  The
+    train CLI's save-then-digest path calls it after the commit
+    (``nnet.checkpoint.write_model_digest`` — corruption lands on the
+    live file); the online publish path calls it with ``staged=`` the
+    pre-rename temp file (``publish_model_file`` — corruption lands
+    BEFORE the file is visible, so digest verification catches it
+    deterministically)."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.on_model_committed(path if staged is None else staged)

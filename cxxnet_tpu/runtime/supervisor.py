@@ -69,6 +69,13 @@ class SupervisorConfig:
     # (nworker*4 decoded+augmented instances), so the first-deadline
     # grace doubles rather than deterministically tripping the watchdog
     pipeline_stats: Optional[object] = None
+    # called as on_save(step) after EVERY accepted checkpoint save
+    # (anchor, periodic, final) — i.e. only at moments the NaN gate
+    # allowed a save, so a listener mirroring params elsewhere (the
+    # online pipeline's serving model files, doc/online.md) inherits the
+    # never-publish-poisoned-params guarantee for free.  Runs on the
+    # step-loop thread at a window boundary: keep it snapshot-cheap.
+    on_save: Optional[Callable[[int], None]] = None
     retry: faults.RetryPolicy = field(
         default_factory=lambda: faults.DEFAULT_IO_RETRY)
 
@@ -135,6 +142,8 @@ class TrainSupervisor:
             self._async.save_sharded_async(
                 self.ckpt_dir, step, tr.snapshot_training_state(),
                 retry=self.config.retry, on_commit=lambda _p: self._prune())
+            if self.config.on_save is not None:
+                self.config.on_save(step)
             return sharded_ckpt.step_dir(self.ckpt_dir, step)
         old = sharded_ckpt.step_dir(self.ckpt_dir, step)
         if os.path.isdir(old):
@@ -142,6 +151,8 @@ class TrainSupervisor:
         path = tr.save_training_state(self.ckpt_dir, step,
                                       retry=self.config.retry)
         self._prune()
+        if self.config.on_save is not None:
+            self.config.on_save(step)
         return path
 
     def _async_usable(self) -> bool:

@@ -79,6 +79,12 @@ class PredictEngine:
         self.compile_count = 0
         self.swap_count = 0
         self.version: object = 0
+        # observability hook: called as on_serve(version) after every
+        # completed forward with the version whose params ACTUALLY served
+        # it (captured with the snapshot, so a swap mid-request reports
+        # the old version) — the freshness tracker's serving-side probe
+        # (online/freshness.py)
+        self.on_serve = None
         self._inflight = 0      # forwards mid-execution (budgeter: busy())
         self._params = trainer.params
         self._params_treedef = jax.tree.structure(self._params)
@@ -176,6 +182,13 @@ class PredictEngine:
         with self._lock:
             return self._params
 
+    def _snapshot_versioned(self):
+        """(params, version) captured atomically: the version a request
+        reports is the version whose params it was computed with, even
+        when a swap lands mid-request."""
+        with self._lock:
+            return self._params, self.version
+
     # -- fleet accounting (serve/registry.py MultiModelRegistry) -----------
     def resident_bytes(self) -> int:
         """Device bytes this engine keeps resident (its param tree) —
@@ -215,7 +228,7 @@ class PredictEngine:
         request is never served by two model versions."""
         data = _as_4d(data)
         n = data.shape[0]
-        params = self._snapshot()
+        params, version = self._snapshot_versioned()
         outs: List[np.ndarray] = []
         with self._lock:
             self._inflight += 1
@@ -227,6 +240,8 @@ class PredictEngine:
         finally:
             with self._lock:
                 self._inflight -= 1
+        if self.on_serve is not None:
+            self.on_serve(version)
         if not outs:
             return np.empty((0, 1), np.float32)
         scores = np.concatenate(outs, axis=0)

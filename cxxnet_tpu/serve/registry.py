@@ -136,6 +136,13 @@ class ModelRegistry:
         self._re = _MODEL_RE if pattern is None else re.compile(pattern)
         self._loader = load_model_params if loader is None else loader
         self.transitions: List[Tuple[str, str]] = []
+        # swap stamps: the step number of the last adopted checkpoint
+        # (parsed from its %04d name — group 1 of ``pattern``) and when
+        # it swapped in, the serving half of the freshness metric
+        # (doc/online.md); surfaced via :meth:`report` / serve stats
+        self.swaps = 0
+        self.last_swap_step: int = -1        # -1: never swapped
+        self.last_swap_time: Optional[float] = None   # time.monotonic()
         # counter -> failed poll cycles; a MultiModelRegistry passes a
         # shared dict so the blacklist survives evict/reload cycles
         self._attempts: dict = {} if attempts is None else attempts
@@ -215,11 +222,41 @@ class ModelRegistry:
                 continue
             self.engine.swap_params(placed, version=counter)
             self.current = counter
+            with self._lock:
+                self.swaps += 1
+                self.last_swap_step = counter
+                self.last_swap_time = time.monotonic()
             self._note('SWAPPED', path)
             if self.on_swap is not None:
                 self.on_swap(counter, path)
             return True
         return False
+
+    # -- freshness stamps ---------------------------------------------------
+    def last_swap_age_s(self) -> float:
+        """Seconds since the last successful swap (NaN before the first
+        one) — how stale the serving version is, from the server's own
+        clock."""
+        with self._lock:
+            t = self.last_swap_time
+        return float('nan') if t is None else time.monotonic() - t
+
+    def report(self, stats=None, name: str = 'registry') -> str:
+        """Swap stamps + reject counters in eval-line format (optionally
+        onto a shared ``StatSet``) — the serving half of the freshness
+        metric (doc/online.md)."""
+        from ..utils.metric import StatSet
+        stats = StatSet() if stats is None else stats
+        with self._lock:
+            stats.gauge('swaps', self.swaps)
+            stats.gauge('last_swap_step', self.last_swap_step)
+            t = self.last_swap_time
+        if t is not None:
+            stats.gauge('last_swap_age_s', time.monotonic() - t)
+        stats.gauge('blacklisted',
+                    sum(1 for v in self._attempts.copy().values()
+                        if v >= self.retry.max_attempts))
+        return stats.print(name)
 
     # -- watcher lifecycle -------------------------------------------------
     def start(self) -> None:

@@ -59,6 +59,12 @@ def pytest_configure(config):
         '(tier-1: runs under -m "not slow"; select with -m serve_decode)')
     config.addinivalue_line(
         'markers',
+        'online: train-while-serve suite — streaming imgbin source, '
+        'freshness SLO, hot-swap-under-traffic pipeline, chaos drill; '
+        'CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m online)')
+    config.addinivalue_line(
+        'markers',
         'execution: ExecutionPlan / composable step-loop suite — '
         'scanned K-dispatch composed with update_period, train metrics, '
         'supervision and chaos recovery, bitwise twins + demotion-matrix '

@@ -124,6 +124,96 @@ def test_fault_plan_events_fire_once():
     assert plan.fired() == ['raise_on_write=2', 'nan_at_step=5']
 
 
+# --- recurring (@every=K) events ------------------------------------------
+
+def test_fault_plan_recurring_parse_roundtrip():
+    """The ``kind@every=K`` grammar parses next to one-shot specs of the
+    SAME kind, round-trips through describe(), and rejects junk."""
+    plan = faults.FaultPlan.parse(
+        'seed=2; raise_on_write=3; raise_on_write@every=5; '
+        'stall_batch@every=50:0.2; nan_at_step@every=7; '
+        'corrupt_model=1; corrupt_model@every=4')
+    assert plan.describe() == (
+        'seed=2;raise_on_write=3;raise_on_write@every=5;'
+        'stall_batch@every=50:0.2;corrupt_model=1;corrupt_model@every=4;'
+        'nan_at_step@every=7')
+    assert plan.fired() == []
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse('raise_on_write@often=3')
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse('explode@every=3')
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse('raise_on_write@every=0')
+
+
+def test_fault_plan_recurring_write_fires_every_k():
+    """Periodic writer faults fire on every K-th attempt, forever —
+    alongside (not consuming) a one-shot on a different attempt."""
+    plan = faults.FaultPlan(raise_on_write=(2,), raise_on_write_every=(5,))
+    hits = []
+    for n in range(1, 16):
+        try:
+            plan.on_checkpoint_write('p')
+        except faults.FaultInjected:
+            hits.append(n)
+    assert hits == [2, 5, 10, 15]
+    assert plan.fired() == ['raise_on_write=2', 'raise_on_write@every=5#5',
+                            'raise_on_write@every=5#10',
+                            'raise_on_write@every=5#15']
+
+
+def test_fault_plan_recurring_stall_batch(monkeypatch):
+    """stall_batch@every=K stalls every K-th batch (1-based: 0-based
+    indices K-1, 2K-1, ...); non-batch scopes pass through."""
+    slept = []
+    monkeypatch.setattr(faults.time, 'sleep', slept.append)
+    plan = faults.FaultPlan(stall_batch_every=((3, 0.25),))
+    for idx in range(9):
+        plan.on_pipeline_item('batch', idx)
+        plan.on_pipeline_item('page', idx)             # other scope: no-op
+    assert slept == [0.25, 0.25, 0.25]
+    assert plan.fired() == ['stall_batch@every=3#2', 'stall_batch@every=3#5',
+                            'stall_batch@every=3#8']
+
+
+def test_fault_plan_recurring_nan_fires_once_per_step():
+    """Periodic NaNs fire at every K-th step — but only ONCE per distinct
+    step: a supervised restore replays step numbers, and re-firing on
+    the replay would turn every recovery into a death loop."""
+    plan = faults.FaultPlan(nan_at_step_every=(4,))
+    assert plan.has_nan_events()
+    assert np.isnan(plan.on_loss(4, 1.0))
+    assert np.isnan(plan.on_loss(8, 1.0))
+    # the replay after a restore sees the same steps clean
+    assert plan.on_loss(4, 1.0) == 1.0
+    assert plan.on_loss(8, 1.0) == 1.0
+    assert np.isnan(plan.on_loss(12, 1.0))             # fresh step: fires
+    assert plan.on_loss(0, 1.0) == 1.0                 # step 0 never fires
+
+
+def test_fault_plan_corrupt_model_truncates_after_commit(tmp_path):
+    """corrupt_model=N truncates the N-th committed model file AFTER its
+    digest sidecar landed, so digest verification must reject it."""
+    from cxxnet_tpu.nnet import checkpoint
+    plan = faults.FaultPlan(corrupt_model=(2,))
+    faults.install_plan(plan)
+    try:
+        paths = []
+        for i in (1, 2, 3):
+            p = str(tmp_path / f'{i:04d}.model')
+            with open(p, 'wb') as f:
+                f.write(b'model-payload-' * 8)
+            checkpoint.write_model_digest(p)
+            paths.append(p)
+    finally:
+        faults.clear_plan()
+    assert plan.fired() == ['corrupt_model=2']
+    assert checkpoint.verify_model_digest(paths[0]) is None
+    assert checkpoint.verify_model_digest(paths[2]) is None
+    reason = checkpoint.verify_model_digest(paths[1])
+    assert reason is not None and 'size' in reason
+
+
 # --- atomic model-file I/O ------------------------------------------------
 
 def test_atomic_write_commits_complete_file(tmp_path):
