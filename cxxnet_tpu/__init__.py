@@ -27,7 +27,7 @@ def _honor_platform_env() -> None:
     try:
         import jax
         jax.config.update('jax_platforms', want)
-    except Exception:       # jax absent/too old: backend selection is moot
+    except Exception:  # lint: allow(fault-taxonomy): jax absent/too old — backend selection is moot, nothing to route
         pass
 
 

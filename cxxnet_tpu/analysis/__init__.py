@@ -1,0 +1,42 @@
+"""graftlint — project-native static analysis (doc/static_analysis.md).
+
+Eight PRs accumulated invariants that were enforced only by runtime
+tests and reviewer memory: bitwise-twin determinism, the typed fault
+taxonomy, lock-guarded shared state across the threaded subsystems, a
+host-sync-free scanned hot loop, and config keys that must not drift
+from their doc tables.  This package encodes each as a stdlib-``ast``
+checker so a regression fails tier-1 (``pytest -m lint``) before any
+chip time is spent, not after a fleet run goes wrong.
+
+The five checkers (one module each; ``core`` holds the shared
+machinery):
+
+* ``lock_discipline`` — shared attributes of thread-spawning classes
+  are accessed under their declared lock (``# guarded-by:``), and lock
+  acquisition order is globally consistent (rules ``lock-discipline``,
+  ``lock-order``),
+* ``tracer_hygiene``  — no implicit device→host syncs or
+  nondeterminism inside jitted/scanned code (rule ``tracer-hygiene``),
+* ``fault_taxonomy``  — ``raise`` sites in runtime/serve/online use the
+  typed ``faults.*`` taxonomy; broad ``except Exception`` routes to the
+  FailureLog or carries an explicit allow (rule ``fault-taxonomy``),
+* ``config_keys``     — every config key the CLI/wrapper parse is
+  documented in the doc tables (rule ``config-key-drift``); also home
+  of the shared doc-table extractor other tests consume,
+* ``monotonic_clock`` — durations/deadlines use ``time.monotonic()``,
+  never ``time.time()`` (rule ``monotonic-clock``).
+
+Triaged legacy findings live in the committed ``lint_baseline.json``
+(shrink-only: entries may be removed as findings are fixed, never
+added); new findings always fail.  Drive it with ``python
+tools/lint.py`` (exit 0 clean/baselined, 1 new findings or stale
+baseline, 2 internal error).
+"""
+
+from __future__ import annotations
+
+from .core import (ALL_RULES, Finding, Repo, diff_against_baseline,
+                   load_baseline, run_all)
+
+__all__ = ['ALL_RULES', 'Finding', 'Repo', 'diff_against_baseline',
+           'load_baseline', 'run_all']

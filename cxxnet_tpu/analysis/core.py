@@ -1,0 +1,241 @@
+"""Shared machinery for the graftlint checkers.
+
+A checker module exposes ``RULES`` (the rule ids it can emit) and
+``run(repo) -> List[Finding]``.  :func:`run_all` drives every checker
+over a :class:`Repo`, applies inline suppressions, and returns the
+surviving findings; :func:`diff_against_baseline` splits them against
+the committed ``lint_baseline.json``.
+
+Conventions (the full grammar lives in doc/static_analysis.md):
+
+* ``# lint: allow(<rule>): <reason>`` — suppress ``<rule>`` findings on
+  this line or the line directly below (``*`` = any rule).  The reason
+  is mandatory: an allow without one does not suppress.
+* Baseline entries match findings by ``(rule, path, message)`` — never
+  by line number, so unrelated edits cannot silently re-baseline a
+  finding.  Messages therefore name symbols, not positions.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: repo-relative directory the checkers scan (the shipped package; tests,
+#: tools and benches are driven code, not the 24/7 product surface)
+PACKAGE_DIR = 'cxxnet_tpu'
+
+ALLOW_RE = re.compile(r'#\s*lint:\s*allow\(([\w*.-]+)\)\s*:\s*(\S.*)')
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One typed lint finding.  ``message`` is position-independent (it
+    names symbols); ``line`` is presentation only."""
+
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def format(self) -> str:
+        return f'{self.path}:{self.line}: [{self.rule}] {self.message}'
+
+
+def _scan_allows(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    """lineno -> {rule or '*'} for every well-formed (reason-carrying)
+    ``# lint: allow(rule): reason`` comment."""
+    allows: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, 1):
+        m = ALLOW_RE.search(line)
+        if m:
+            allows.setdefault(i, set()).add(m.group(1))
+    return allows
+
+
+class Module:
+    """One parsed source file: AST + raw lines + inline allows."""
+
+    def __init__(self, root: str, rel: str):
+        self.rel = rel
+        self.path = os.path.join(root, rel)
+        with tokenize.open(self.path) as f:
+            self.src = f.read()
+        self.lines = self.src.splitlines()
+        self.tree = ast.parse(self.src, filename=rel)
+        self.allows = _scan_allows(self.lines)
+
+    def allowed(self, rule: str, line: int) -> bool:
+        for at in (line, line - 1):
+            rules = self.allows.get(at)
+            if rules and (rule in rules or '*' in rules):
+                return True
+        return False
+
+
+class Repo:
+    """Lazy, cached view of the repository for cross-file checkers."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = os.path.abspath(root) if root else default_root()
+        self._cache: Dict[str, Module] = {}
+
+    def module(self, rel: str) -> Module:
+        rel = rel.replace(os.sep, '/')
+        mod = self._cache.get(rel)
+        if mod is None:
+            mod = self._cache[rel] = Module(self.root, rel)
+        return mod
+
+    def has(self, rel: str) -> bool:
+        return os.path.exists(os.path.join(self.root, rel))
+
+    def package_files(self) -> List[str]:
+        """Repo-relative paths of every ``.py`` in the shipped package."""
+        out: List[str] = []
+        base = os.path.join(self.root, PACKAGE_DIR)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames if d != '__pycache__')
+            for name in sorted(filenames):
+                if name.endswith('.py'):
+                    rel = os.path.relpath(os.path.join(dirpath, name),
+                                          self.root)
+                    out.append(rel.replace(os.sep, '/'))
+        return out
+
+    def read_text(self, rel: str) -> str:
+        with open(os.path.join(self.root, rel), encoding='utf-8') as f:
+            return f.read()
+
+
+def default_root() -> str:
+    """The repository root (two levels above this package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _checkers():
+    from . import (config_keys, fault_taxonomy, lock_discipline,
+                   monotonic_clock, tracer_hygiene)
+    return (lock_discipline, tracer_hygiene, fault_taxonomy, config_keys,
+            monotonic_clock)
+
+
+ALL_RULES: Tuple[str, ...] = ('lock-discipline', 'lock-order',
+                              'tracer-hygiene', 'fault-taxonomy',
+                              'config-key-drift', 'monotonic-clock')
+
+
+def run_all(root: Optional[str] = None,
+            rules: Optional[Sequence[str]] = None,
+            repo: Optional[Repo] = None) -> List[Finding]:
+    """Run every checker (or the ``rules`` subset) and return findings
+    that survive inline suppression, sorted by (path, line, rule)."""
+    repo = repo if repo is not None else Repo(root)
+    wanted = set(rules) if rules else set(ALL_RULES)
+    unknown = wanted - set(ALL_RULES)
+    if unknown:
+        raise ValueError(f'unknown lint rule(s): {sorted(unknown)}; '
+                         f'known: {list(ALL_RULES)}')
+    findings: List[Finding] = []
+    for checker in _checkers():
+        if not wanted.intersection(checker.RULES):
+            continue
+        findings.extend(f for f in checker.run(repo) if f.rule in wanted)
+    out = [f for f in findings
+           if not repo.module(f.path).allowed(f.rule, f.line)]
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return out
+
+
+def apply_suppressions(findings: Iterable[Finding],
+                       mod: 'Module') -> List[Finding]:
+    """Filter one module's findings through its inline allows (the
+    fixture tests' entry point; :func:`run_all` does this repo-wide)."""
+    return [f for f in findings if not mod.allowed(f.rule, f.line)]
+
+
+# --- baseline (shrink-only ratchet) ----------------------------------------
+
+def baseline_path(root: Optional[str] = None) -> str:
+    return os.path.join(root or default_root(), 'lint_baseline.json')
+
+
+def load_baseline(path: Optional[str] = None) -> List[dict]:
+    """Entries of ``lint_baseline.json`` (``[]`` when absent).  Each is
+    ``{rule, path, message, reason}``; a missing/empty reason is a
+    malformed baseline and raises."""
+    path = path or baseline_path()
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding='utf-8') as f:
+        data = json.load(f)
+    entries = data.get('entries', [])
+    for e in entries:
+        for field in ('rule', 'path', 'message', 'reason'):
+            if not str(e.get(field, '')).strip():
+                raise ValueError(
+                    f'baseline entry missing {field!r}: {e!r} — every '
+                    'triaged finding must carry a reason')
+    return entries
+
+
+def diff_against_baseline(findings: Iterable[Finding],
+                          entries: Iterable[dict]
+                          ) -> Tuple[List[Finding], List[dict], int]:
+    """Multiset match on ``(rule, path, message)``.  Returns ``(new
+    findings, stale baseline entries, matched count)``: new findings
+    fail the lint; stale entries fail the shrink-only ratchet (fixing a
+    finding must also delete its baseline entry)."""
+    budget = collections.Counter(
+        (e['rule'], e['path'], e['message']) for e in entries)
+    new: List[Finding] = []
+    matched = 0
+    for f in findings:
+        if budget.get(f.key(), 0) > 0:
+            budget[f.key()] -= 1
+            matched += 1
+        else:
+            new.append(f)
+    stale = []
+    for e in entries:
+        k = (e['rule'], e['path'], e['message'])
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            stale.append(e)
+    return new, stale, matched
+
+
+# --- small AST helpers shared by checkers ----------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def parse_snippet(src: str, rel: str = '<fixture>') -> Module:
+    """Build a Module from an in-memory snippet (checker unit tests)."""
+    mod = Module.__new__(Module)
+    mod.rel = rel
+    mod.path = rel
+    mod.src = src
+    mod.lines = src.splitlines()
+    mod.tree = ast.parse(src, filename=rel)
+    mod.allows = _scan_allows(mod.lines)
+    return mod

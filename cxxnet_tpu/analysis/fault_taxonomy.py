@@ -1,0 +1,164 @@
+"""Typed fault taxonomy enforcement (rule ``fault-taxonomy``).
+
+PR 1 introduced ``runtime/faults.py`` precisely so that every failure
+mode in the long-running subsystems is a TYPED error a supervisor,
+batcher, or client can route on.  A raw ``RuntimeError`` in
+``runtime/``, ``serve/`` or ``online/`` silently falls outside every
+retry/recovery/shedding policy, so this checker pins the contract:
+
+* every ``raise`` of a *newly constructed* exception must be a
+  ``faults.*`` class (resolved statically from the class defs in
+  ``runtime/faults.py``, however it was imported) or a plain
+  ``ValueError``/``TypeError`` on argument validation;
+* re-raises (``raise``, ``raise err``, ``raise req.error``) are always
+  fine — the type was chosen where the error was born;
+* a broad ``except Exception``/bare ``except`` must either route the
+  error to the FailureLog (a ``.record(...)`` call in its body — the
+  watcher-must-outlive-bad-cycles idiom) or carry an explicit
+  ``# lint: allow(fault-taxonomy): <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import Finding, Module, Repo, dotted_name
+
+RULES = ('fault-taxonomy',)
+
+#: directories whose raises must use the taxonomy (repo-relative)
+TARGET_DIRS = ('cxxnet_tpu/runtime/', 'cxxnet_tpu/serve/',
+               'cxxnet_tpu/online/')
+
+FAULTS_MODULE = 'cxxnet_tpu/runtime/faults.py'
+
+#: builtins allowed for argument/usage validation at API boundaries
+VALIDATION_OK = {'ValueError', 'TypeError', 'NotImplementedError',
+                 'StopIteration', 'GeneratorExit', 'KeyboardInterrupt',
+                 'AssertionError'}
+
+
+def fault_class_names(repo: Repo) -> Set[str]:
+    """Every exception class defined in ``runtime/faults.py``: classes
+    whose base chain (within the module) reaches a builtin exception."""
+    if not repo.has(FAULTS_MODULE):
+        return set()        # scratch trees (CLI tests) have no taxonomy
+    mod = repo.module(FAULTS_MODULE)
+    bases = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef):
+            bases[node.name] = [dotted_name(b) or '' for b in node.bases]
+    roots = {'Exception', 'BaseException', 'RuntimeError', 'OSError',
+             'IOError', 'ValueError', 'TypeError', 'ArithmeticError'}
+    out: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, bs in bases.items():
+            if name in out:
+                continue
+            for b in bs:
+                leaf = b.split('.')[-1]
+                if leaf in roots or leaf in out:
+                    out.add(name)
+                    changed = True
+                    break
+    return out
+
+
+def _raise_findings(mod: Module, allowed: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    parents: dict = {}
+    for node in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def context(node: ast.AST) -> str:
+        n = node
+        while n in parents:
+            n = parents[n]
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                return n.name
+        return '<module>'
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Raise) or node.exc is None:
+            continue
+        exc = node.exc
+        if not isinstance(exc, ast.Call):
+            continue        # re-raise of a stored/caught exception
+        name = dotted_name(exc.func)
+        if name is None:
+            continue        # dynamic construction — out of static reach
+        leaf = name.split('.')[-1]
+        if leaf in allowed or leaf in VALIDATION_OK:
+            continue
+        findings.append(Finding(
+            'fault-taxonomy', mod.rel, node.lineno,
+            f'raise {leaf} in {context(node)} is not a typed faults.* '
+            f'error (or ValueError/TypeError argument validation) — '
+            f'untyped errors fall outside every retry/recovery/shedding '
+            f'policy'))
+    return findings
+
+
+def _routes_to_log(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ''
+            if name.split('.')[-1] == 'record':
+                return True
+            if 'failure_log' in name:
+                return True
+    return False
+
+
+def _except_findings(mod: Module) -> List[Finding]:
+    findings: List[Finding] = []
+    def is_broad(t: Optional[ast.AST]) -> bool:
+        if t is None:
+            return True                 # bare except
+        if isinstance(t, ast.Name):
+            # BaseException stays out of scope: the package's
+            # `except BaseException` sites are deliberate
+            # propagate-to-consumer patterns (thread_buffer, pool)
+            return t.id == 'Exception'
+        if isinstance(t, ast.Tuple):
+            # `except (Exception, X):` swallows everything Exception does
+            return any(is_broad(el) for el in t.elts)
+        return False
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not is_broad(node.type):
+            continue
+        if _routes_to_log(node):
+            continue
+        findings.append(Finding(
+            'fault-taxonomy', mod.rel, node.lineno,
+            'broad "except Exception" neither routes to the FailureLog '
+            '(.record(...)) nor carries an explicit allow — swallowed '
+            'errors are invisible at fleet scale'))
+    return findings
+
+
+def check_module(mod: Module, allowed: Optional[Set[str]] = None,
+                 raises: bool = True) -> List[Finding]:
+    allowed = allowed if allowed is not None else set()
+    out = _raise_findings(mod, allowed) if raises else []
+    return out + _except_findings(mod)
+
+
+def run(repo: Repo) -> List[Finding]:
+    allowed = fault_class_names(repo)
+    findings: List[Finding] = []
+    for rel in repo.package_files():
+        # the raise-typing contract binds the fault-routed subsystems;
+        # swallowing-broad-except visibility binds the whole package
+        in_target = any(rel.startswith(d) for d in TARGET_DIRS)
+        findings.extend(check_module(repo.module(rel), allowed,
+                                     raises=in_target))
+    return findings

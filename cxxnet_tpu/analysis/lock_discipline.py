@@ -1,0 +1,426 @@
+"""Lock discipline for the threaded subsystems (rules ``lock-discipline``,
+``lock-order``).
+
+Every class that spawns a worker thread (``threading.Thread`` targeting
+its own code, or closures handed to a ``ThreadPoolExecutor`` it owns)
+shares instance state between that worker and its public methods.  The
+repo's convention makes the guard explicit::
+
+    self._runs = []        # guarded-by: _lock
+    ...
+    def _free_slot(self, sid):   # requires-lock: _cond
+        ...
+
+* ``# guarded-by: <lockname>`` on an attribute assignment declares that
+  every access outside ``__init__`` must happen inside a ``with
+  self.<lockname>:`` block (a ``threading.Lock``/``RLock``/``Condition``
+  attribute) or inside a method annotated ``# requires-lock:
+  <lockname>`` (caller holds it — decode.py's ``_free_slot`` idiom).
+* Undeclared attributes are *inferred* shared when the worker call
+  graph writes them AND a non-worker method touches them; if any such
+  access is unguarded, ONE finding per (class, attribute) asks for a
+  declaration or an explicit ``# lint: allow(lock-discipline): reason``.
+* Code lexically inside a nested ``def``/``lambda`` does not inherit
+  the enclosing ``with`` — closures run later, usually on another
+  thread (exactly the bug class this checker exists for).
+
+``lock-order``: nested ``with self.<lock>`` acquisitions build a global
+directed graph over the package; any cycle (two code paths acquiring
+the same pair of locks in opposite orders) is a potential deadlock and
+fails — the fleet-scale lesson of PAPERS.md's distributed-training
+line: concurrency order bugs, not kernels, are what break at scale.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Module, Repo, dotted_name
+
+RULES = ('lock-discipline', 'lock-order')
+
+GUARDED_RE = re.compile(r'#\s*guarded-by:\s*(\w+)')
+REQUIRES_RE = re.compile(r'#\s*requires-lock:\s*(\w+)')
+
+_LOCK_TYPES = ('Lock', 'RLock', 'Condition', 'Semaphore',
+               'BoundedSemaphore')
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func) or ''
+    return name.split('.')[-1] in _LOCK_TYPES
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``X`` for ``self.X`` nodes, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == 'self'):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    """Everything the per-class analysis needs, gathered in one pass."""
+
+    def __init__(self, mod: Module, node: ast.ClassDef):
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.methods: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.locks: Set[str] = set()          # lock-typed attributes
+        self.guarded: Dict[str, str] = {}     # attr -> lockname
+        self.requires: Dict[str, str] = {}    # method -> held lockname
+        self.spawns = False                   # creates a Thread/Executor
+        # worker FUNCTION NODES (a method, or a closure handed to
+        # Thread(target=)/executor.submit) — node identity, not method
+        # name: a closure's enclosing method is NOT worker code
+        self.workers: Set[ast.AST] = set()
+        self.worker_names: Set[str] = set()   # for messages
+        self._collect()
+
+    # -- declaration collection --------------------------------------------
+    def _line(self, no: int) -> str:
+        return self.mod.lines[no - 1] if no - 1 < len(self.mod.lines) else ''
+
+    def _collect(self) -> None:
+        for meth in self.methods.values():
+            m = REQUIRES_RE.search(self._line(meth.lineno))
+            if m:
+                self.requires[meth.name] = m.group(1)
+            for sub in ast.walk(meth):
+                # lock-typed attributes + guarded-by declarations ride
+                # `self.X = ...` statements (idiomatically in __init__)
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    value = sub.value
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        if value is not None and _is_lock_ctor(value):
+                            self.locks.add(attr)
+                        # the annotation may trail any physical line of
+                        # a multi-line assignment
+                        for no in range(sub.lineno,
+                                        (sub.end_lineno or sub.lineno) + 1):
+                            g = GUARDED_RE.search(self._line(no))
+                            if g:
+                                self.guarded[attr] = g.group(1)
+                                break
+                if isinstance(sub, ast.Call):
+                    callee = dotted_name(sub.func) or ''
+                    tail = callee.split('.')[-1]
+                    if tail in ('Thread', 'Timer'):
+                        self.spawns = True
+                        for kw in sub.keywords:
+                            if kw.arg == 'target':
+                                self._note_worker(kw.value, meth)
+                    if tail == 'ThreadPoolExecutor':
+                        self.spawns = True
+        # executor-submitted closures only count once we know the class
+        # owns an executor (self.spawns), hence the second pass
+        if self.spawns:
+            for meth in self.methods.values():
+                for sub in ast.walk(meth):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == 'submit' and sub.args):
+                        self._note_worker(sub.args[0], meth)
+        self._close_workers()
+
+    def _note_worker(self, target: ast.AST, meth: ast.FunctionDef) -> None:
+        attr = _self_attr(target)
+        if attr is not None and attr in self.methods:
+            self.workers.add(self.methods[attr])
+            self.worker_names.add(attr)
+            return
+        if isinstance(target, ast.Name):
+            # a local def inside `meth` (OrderedWorkerPool's worker /
+            # AsyncCheckpointer's task closure): ONLY that def's body
+            # runs on the worker thread, not the rest of `meth`
+            for sub in ast.walk(meth):
+                if (isinstance(sub, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))
+                        and sub.name == target.id):
+                    self.workers.add(sub)
+                    self.worker_names.add(f'{meth.name}.{sub.name}')
+                    return
+
+    def _close_workers(self) -> None:
+        """Transitive closure: ``self.m()`` calls from worker code pull
+        ``m`` into the worker set (the watcher→poll_once idiom)."""
+        changed = True
+        while changed:
+            changed = False
+            for wnode in list(self.workers):
+                for sub in ast.walk(wnode):
+                    if isinstance(sub, ast.Call):
+                        attr = _self_attr(sub.func)
+                        meth = self.methods.get(attr or '')
+                        if meth is not None and meth not in self.workers:
+                            # pulled into the worker set for analysis,
+                            # but not named in messages: entry points
+                            # (Thread targets / submitted closures) are
+                            # what a reader greps for
+                            self.workers.add(meth)
+                            changed = True
+
+    # -- access analysis ----------------------------------------------------
+    class _Access:
+        __slots__ = ('attr', 'line', 'is_write', 'held', 'in_worker',
+                     'in_init', 'where')
+
+        def __init__(self, attr, line, is_write, held, in_worker,
+                     in_init, where):
+            self.attr = attr
+            self.line = line
+            self.is_write = is_write
+            self.held = held            # frozenset of held lock names
+            self.in_worker = in_worker  # runs on a worker thread
+            self.in_init = in_init      # __init__/__del__ direct code
+            self.where = where          # innermost function label
+
+    def accesses(self):
+        """Every ``self.X`` touch in the class, attributed to its
+        innermost function.  ``held`` is lexical ``with self.<lock>:``
+        scope; a nested function body starts a FRESH scope (closures
+        run later, usually on another thread), seeded only by its own
+        ``# requires-lock:`` annotation."""
+        out = []
+
+        def visit(node, held, in_worker, in_init, where):
+            if isinstance(node, ast.With):
+                newly = []
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    # `with self._lock:` — the lock expr itself is not
+                    # an "access" of guarded state
+                    if attr is not None and attr in self.locks:
+                        newly.append(attr)
+                    else:
+                        visit(item.context_expr, held, in_worker,
+                              in_init, where)
+                    if item.optional_vars is not None:
+                        visit(item.optional_vars, held, in_worker,
+                              in_init, where)
+                inner = held | set(newly)
+                for stmt in node.body:
+                    visit(stmt, inner, in_worker, in_init, where)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fresh = set()
+                r = REQUIRES_RE.search(self._line(node.lineno))
+                if r:
+                    fresh.add(r.group(1))
+                sub_worker = in_worker or node in self.workers
+                for stmt in node.body:
+                    visit(stmt, fresh, sub_worker, False, node.name)
+                return
+            if isinstance(node, ast.Lambda):
+                visit(node.body, set(), in_worker, False, where)
+                return
+            attr = _self_attr(node)
+            if attr is not None and attr not in self.locks:
+                is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+                out.append(self._Access(attr, node.lineno, is_write,
+                                        frozenset(held), in_worker,
+                                        in_init, where))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held, in_worker, in_init, where)
+
+        for meth in self.methods.values():
+            held = set()
+            req = self.requires.get(meth.name)
+            if req:
+                held.add(req)
+            init = meth.name in ('__init__', '__del__')
+            for stmt in meth.body:
+                visit(stmt, held, meth in self.workers, init, meth.name)
+        return out
+
+
+def _check_class(mod: Module, info: _ClassInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    if not info.spawns and not info.guarded:
+        return findings
+
+    all_acc = [a for a in info.accesses() if not a.in_init]
+    per_attr: Dict[str, list] = {}
+    for a in all_acc:
+        per_attr.setdefault(a.attr, []).append(a)
+
+    def holds(a, lock=None):
+        # declared attrs demand THEIR lock; inferred sharing is
+        # satisfied by any held lock (the class picked one)
+        return (lock in a.held) if lock is not None else bool(a.held)
+
+    # 1) declared attributes: every access site must hold the lock
+    for attr, lock in sorted(info.guarded.items()):
+        if lock not in info.locks:
+            findings.append(Finding(
+                'lock-discipline', mod.rel, info.node.lineno,
+                f'{info.name}.{attr} declares guarded-by {lock}, but '
+                f'{info.name} has no lock attribute self.{lock}'))
+            continue
+        for a in per_attr.get(attr, []):
+            if not holds(a, lock):
+                kind = 'written' if a.is_write else 'read'
+                findings.append(Finding(
+                    'lock-discipline', mod.rel, a.line,
+                    f'{info.name}.{attr} is guarded-by {lock} but '
+                    f'{kind} in {a.where} without holding self.{lock}'))
+
+    # 2) inferred shared attributes (thread-spawning classes only):
+    #    written on a worker thread AND touched off it — the unguarded
+    #    counter / torn-publish regression class.  One finding per
+    #    (class, attr), at the first unguarded site.
+    if info.spawns and info.workers:
+        for attr, sites in sorted(per_attr.items()):
+            if attr in info.guarded:
+                continue
+            if not any(a.in_worker and a.is_write for a in sites):
+                continue
+            if not any(not a.in_worker for a in sites):
+                continue
+            bad = [a for a in sites if not holds(a)]
+            if not bad:
+                continue        # every touch is already lock-scoped
+            where = ', '.join(sorted({a.where for a in bad}))
+            workers = '/'.join(sorted(info.worker_names))
+            findings.append(Finding(
+                'lock-discipline', mod.rel, min(a.line for a in bad),
+                f'{info.name}.{attr} is written by worker-thread code '
+                f'({workers}) and touched without a lock in {where} — '
+                f'declare "# guarded-by: <lock>" on its __init__ '
+                f'assignment or allow with a reason'))
+    return findings
+
+
+# --- lock acquisition order -------------------------------------------------
+
+def _order_edges(mod: Module) -> List[Tuple[str, str, int]]:
+    """``(held, acquired, line)`` for every nested/multi-item ``with``
+    over lock-like attributes.  Lock identity is ``Class.attr`` for
+    ``self`` locks and the dotted expression otherwise."""
+    edges: List[Tuple[str, str, int]] = []
+
+    def lock_id(expr: ast.AST, cls: Optional[str]) -> Optional[str]:
+        name = dotted_name(expr)
+        if name is None or '(' in name:
+            return None
+        if name.startswith('self.') and cls:
+            return f'{cls}.{name[5:]}'
+        return name
+
+    def looks_locky(expr: ast.AST) -> bool:
+        name = dotted_name(expr) or ''
+        leaf = name.split('.')[-1]
+        return ('lock' in leaf.lower() or 'cond' in leaf.lower()
+                or 'sem' in leaf.lower())
+
+    def visit(node: ast.AST, held: List[str], cls: Optional[str]) -> None:
+        if isinstance(node, ast.ClassDef):
+            for child in node.body:
+                visit(child, held, node.name)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for child in body:
+                visit(child, [], cls)      # fresh stack: runs later
+            return
+        if isinstance(node, ast.With):
+            inner = list(held)
+            for item in node.items:
+                if looks_locky(item.context_expr):
+                    lid = lock_id(item.context_expr, cls)
+                    if lid is not None:
+                        for h in inner:
+                            edges.append((h, lid, node.lineno))
+                        inner.append(lid)
+            for stmt in node.body:
+                visit(stmt, inner, cls)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, cls)
+
+    for stmt in mod.tree.body:
+        visit(stmt, [], None)
+    return edges
+
+
+def _find_cycle(graph: Dict[str, Set[str]]) -> Optional[List[str]]:
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    stack: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = GREY
+        stack.append(n)
+        for m in sorted(graph.get(n, ())):
+            if color.get(m, WHITE) == GREY:
+                return stack[stack.index(m):] + [m]
+            if color.get(m, WHITE) == WHITE:
+                cyc = dfs(m)
+                if cyc:
+                    return cyc
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            cyc = dfs(n)
+            if cyc:
+                return cyc
+    return None
+
+
+def order_findings(modules: List[Module]) -> List[Finding]:
+    """Cycle detection over the lock-acquisition graph of a set of
+    modules (live run and fixture tests share this entry point)."""
+    graph: Dict[str, Set[str]] = {}
+    sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for mod in modules:
+        for held, acquired, line in _order_edges(mod):
+            graph.setdefault(held, set()).add(acquired)
+            graph.setdefault(acquired, set())
+            sites.setdefault((held, acquired), (mod.rel, line))
+    cycle = _find_cycle(graph)
+    if not cycle:
+        return []
+    rel, line = sites[(cycle[0], cycle[1])]
+    chain = ' -> '.join(cycle)
+    return [Finding(
+        'lock-order', rel, line,
+        f'inconsistent lock acquisition order (potential deadlock): '
+        f'{chain}')]
+
+
+# --- entry points -----------------------------------------------------------
+
+def check_module(mod: Module) -> List[Finding]:
+    """All lock-discipline findings for one parsed module (fixture and
+    live paths share this)."""
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_check_class(mod, _ClassInfo(mod, node)))
+    return findings
+
+
+def run(repo: Repo) -> List[Finding]:
+    files = repo.package_files()
+    findings: List[Finding] = []
+    for rel in files:
+        findings.extend(check_module(repo.module(rel)))
+    findings.extend(order_findings([repo.module(rel) for rel in files]))
+    return findings

@@ -1,0 +1,61 @@
+"""Monotonic-clock discipline (rule ``monotonic-clock``).
+
+Every duration, deadline, and watchdog in this codebase (ThreadBuffer
+deadlines, batcher coalescing windows, freshness SLO, retry backoff)
+is arithmetic over timestamps.  ``time.time()`` is wall-clock: NTP
+slews and steps it, so a deadline computed from it can fire early,
+late, or never — the classic stalled-watchdog-during-clock-step bug.
+``time.monotonic()`` (or ``perf_counter`` for fine measurement) is the
+only correct base for elapsed time, so the rule is blunt: no
+``time.time()`` in the package at all.  A genuine wall-clock need
+(stamping a receipt with calendar time) states itself with
+``# lint: allow(monotonic-clock): <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, Module, Repo, dotted_name
+
+RULES = ('monotonic-clock',)
+
+
+def check_module(mod: Module) -> List[Finding]:
+    # resolve every spelling: `import time [as t]` module aliases and
+    # `from time import time [as wall]` bound names — an aliased
+    # wall-clock deadline is just as wrong as a spelled-out one
+    module_names = set()
+    bound_names = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == 'time':
+                    module_names.add(a.asname or 'time')
+        elif isinstance(node, ast.ImportFrom) and node.module == 'time':
+            for a in node.names:
+                if a.name == 'time':
+                    bound_names.add(a.asname or 'time')
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        hit = (name is not None
+               and (any(name == f'{m}.time' for m in module_names)
+                    or (name in bound_names and not node.args)))
+        if hit:
+            findings.append(Finding(
+                'monotonic-clock', mod.rel, node.lineno,
+                'time.time() is wall-clock — durations and deadlines '
+                'must use time.monotonic() (allow with a reason for '
+                'genuine calendar timestamps)'))
+    return findings
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in repo.package_files():
+        findings.extend(check_module(repo.module(rel)))
+    return findings

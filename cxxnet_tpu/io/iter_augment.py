@@ -370,7 +370,7 @@ class AugmentIterator(IIterator):
         if self.silent == 0:
             print(f'cannot find {self.name_meanimg}: create mean image, '
                   f'this will take some time...')
-        start = time.time()
+        start = time.monotonic()
         mean = None
         cnt = 0
         for _, crop in self._raw_iter():
@@ -378,7 +378,7 @@ class AugmentIterator(IIterator):
             cnt += 1
             if cnt % 1000 == 0 and self.silent == 0:
                 print(f'[{cnt:8d}] images processed, '
-                      f'{int(time.time() - start)} sec elapsed')
+                      f'{int(time.monotonic() - start)} sec elapsed')
         assert cnt > 0, 'input iterator failed.'
         self._meanimg = (mean / cnt).astype(np.float32)
         _save_mean(self.name_meanimg, self._meanimg)

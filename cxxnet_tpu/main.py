@@ -386,7 +386,7 @@ class LearnTask:
 
     # --- tasks ------------------------------------------------------------
     def task_train(self) -> None:
-        start = time.time()
+        start = time.monotonic()
         if self.continue_training == 0 and self.name_model_in == 'NULL':
             self._save_model()
         else:
@@ -503,7 +503,7 @@ class LearnTask:
 
     def _progress(self, sample_counter: int, start: float) -> None:
         if sample_counter % self.print_step == 0 and not self.silent:
-            elapsed = int(time.time() - start)
+            elapsed = int(time.monotonic() - start)
             print(f'round {self.start_counter - 1:8d}:'
                   f'[{sample_counter:8d}] {elapsed} sec elapsed', flush=True)
 
@@ -559,7 +559,7 @@ class LearnTask:
                 sys.stderr.flush()
             self._save_model()
         if not self.silent:
-            print(f'\nupdating end, {int(time.time() - start)} sec in all')
+            print(f'\nupdating end, {int(time.monotonic() - start)} sec in all')
 
     def _write_io_stats(self) -> None:
         """Pipeline observability: when the train chain is instrumented
@@ -639,7 +639,8 @@ class LearnTask:
             for mid in fleet.models():
                 try:
                     fleet.get(mid)       # budgeter decides who stays warm
-                except Exception as e:   # a cold sibling must not kill serve
+                # lint: allow(fault-taxonomy): a cold sibling must not kill serve; printed, and the budgeter retries on demand
+                except Exception as e:
                     print(f'serve: fleet model {mid!r} not loaded: {e}',
                           flush=True)
             if not self.silent:
@@ -775,7 +776,7 @@ class LearnTask:
                               serve_factory, cfg,
                               request_source=request_source)
         print('start online training-while-serving...')
-        start = time.time()
+        start = time.monotonic()
         try:
             summary = pipe.run(
                 num_rounds=self.num_round,
@@ -787,7 +788,7 @@ class LearnTask:
                   flush=True)
         finally:
             pipe.close(timeout=30.0)
-        print(f'finished online run, {int(time.time() - start)} sec in all')
+        print(f'finished online run, {int(time.monotonic() - start)} sec in all')
 
     def _lm_spec(self):
         """Build the decode model: ``serve.lm`` is a compact

@@ -138,9 +138,9 @@ class OnlinePipeline:
         self._ckpt = AsyncCheckpointer(workers=cfg.save_workers,
                                        failure_log=self.log)
         self._last_counter: Optional[int] = None
-        self._served = 0
         self._served_lock = threading.Lock()   # traffic + client threads
-        self._client_errors = 0
+        self._served = 0           # guarded-by: _served_lock
+        self._client_errors = 0    # guarded-by: _served_lock
         self._traffic_stop = threading.Event()
         self._traffic_thread: Optional[threading.Thread] = None
         self._started = False
@@ -268,7 +268,8 @@ class OnlinePipeline:
             try:
                 self.submit(self.request_source())
             except faults.ServeError:
-                self._client_errors += 1
+                with self._served_lock:
+                    self._client_errors += 1
             except RuntimeError:
                 return                       # batcher closed under us
 
@@ -346,7 +347,8 @@ class OnlinePipeline:
         acceptance counter (batcher sheds + engine faults + client-side
         typed errors from the built-in driver)."""
         if self.batcher is None:
-            return self._client_errors
+            with self._served_lock:
+                return self._client_errors
         s = self.batcher.stats
         return int(s.get('expired') + s.get('rejected')
                    + s.get('engine_errors'))
@@ -355,7 +357,8 @@ class OnlinePipeline:
         """Freshness + swap gauges in eval-line format — what rides the
         round eval line (doc/online.md explains each key)."""
         stats = StatSet()
-        stats.gauge('served', self._served)
+        with self._served_lock:
+            stats.gauge('served', self._served)
         stats.gauge('dropped', self.dropped())
         if self.registry is not None:
             stats.gauge('last_swap_step', self.registry.last_swap_step)
@@ -384,10 +387,12 @@ class OnlinePipeline:
             v = t.stats.quantile(name, p)
             return None if v != v else v
 
+        with self._served_lock:
+            served = int(self._served)
         return {
             'steps': int(self.trainer.sample_counter),
             'swaps': int(t.swaps),
-            'served': int(self._served),
+            'served': served,
             'dropped': int(self.dropped()),
             'slo_breaches': int(t.breaches),
             'freshness_p50_s': q('freshness_s', 0.5),

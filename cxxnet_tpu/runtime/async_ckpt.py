@@ -109,8 +109,8 @@ class AsyncCheckpointer:
             max_workers=self.workers, thread_name_prefix='ckpt_io')
         self._lock = threading.Lock()
         self._future: Optional[Future] = None
-        self._in_flight = 0          # introspection for tests/metrics
-        self.commits = 0
+        self._in_flight = 0   # guarded-by: _lock (tests/metrics probe)
+        self.commits = 0      # guarded-by: _lock
         self.submits = 0
         self._closed = False
 

@@ -131,7 +131,7 @@ class NativePageReader:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: allow(fault-taxonomy): interpreter-teardown finalizer; raising in __del__ aborts shutdown
             pass
 
 

@@ -100,9 +100,9 @@ class DynamicBatcher:
         # execute_requests; the default predict path stays synchronous
         self._exec = getattr(engine, 'execute_requests', None)
         self.stats = stats if stats is not None else StatSet()
-        self._q: Deque[ServeRequest] = collections.deque()
         self._cond = threading.Condition()
-        self._closed = False
+        self._q: Deque[ServeRequest] = collections.deque()  # guarded-by: _cond
+        self._closed = False       # guarded-by: _cond
         self._t0 = time.monotonic()
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name='serve-batcher')

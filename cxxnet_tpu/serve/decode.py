@@ -131,21 +131,25 @@ class DecodeEngine:
                       cfg.num_heads, hd)
         self._kpool = jax.device_put(np.zeros(pool_shape, cfg.dtype))
         self._vpool = jax.device_put(np.zeros(pool_shape, cfg.dtype))
-        # physical page 0 is scratch: idle slots write there, nobody reads
-        self._free_pages: List[int] = list(range(self.n_pages - 1, 0, -1))
-        self._table = np.zeros((self.slots, self.pages_per_slot), np.int32)
-        self._slots: List[Optional[_Slot]] = [None] * self.slots
-        self._joinq: collections.deque = collections.deque()
-        self._admitting = 0       # reservations between admit and join
-        self._join_seq = 0
         self._cond = threading.Condition()
-        self._closed = False
-        self._params = self.place_params(params)
+        # physical page 0 is scratch: idle slots write there, nobody reads
+        self._free_pages: List[int] = list(
+            range(self.n_pages - 1, 0, -1))       # guarded-by: _cond
+        self._table = np.zeros((self.slots, self.pages_per_slot),
+                               np.int32)           # guarded-by: _cond
+        self._slots: List[Optional[_Slot]] = (
+            [None] * self.slots)                  # guarded-by: _cond
+        self._joinq: collections.deque = (
+            collections.deque())                  # guarded-by: _cond
+        self._admitting = 0   # guarded-by: _cond (admit..join window)
+        self._join_seq = 0    # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
+        self._params = self.place_params(params)  # guarded-by: _cond
         self._params_treedef = jax.tree.structure(self._params)
         self._params_shapes = [(tuple(l.shape), l.dtype)
                                for l in jax.tree.leaves(self._params)]
-        self._pending_params = None
-        self._pending_version = None
+        self._pending_params = None   # guarded-by: _cond
+        self._pending_version = None  # guarded-by: _cond
         self.version: object = 0
         self.swap_count = 0
         self._prefill_fns: collections.OrderedDict = collections.OrderedDict()
@@ -243,7 +247,8 @@ class DecodeEngine:
     # -- parameters (PredictEngine-compatible surface) ---------------------
     @property
     def params(self):
-        return self._params
+        with self._cond:
+            return self._params
 
     def _check_tree(self, params) -> None:
         if jax.tree.structure(params) != self._params_treedef:
@@ -289,8 +294,10 @@ class DecodeEngine:
 
     def resident_bytes(self) -> int:
         """Device-memory ledger entry for the budgeter: params + pool."""
-        n = sum(l.nbytes for l in jax.tree.leaves(self._params))
-        return int(n + self._kpool.nbytes + self._vpool.nbytes)
+        with self._cond:
+            params = self._params
+            pool = self._kpool.nbytes + self._vpool.nbytes
+        return int(pool + sum(l.nbytes for l in jax.tree.leaves(params)))
 
     def busy(self) -> bool:
         with self._cond:
@@ -449,7 +456,7 @@ class DecodeEngine:
             self.stats.observe('stream_len', len(req.tokens))
         req.event.set()
 
-    def _free_slot(self, sid: int) -> None:
+    def _free_slot(self, sid: int) -> None:  # requires-lock: _cond
         """Return a slot's pages to the pool (caller holds the lock)."""
         row = self._table[sid]
         self._free_pages.extend(int(p) for p in row[row != 0])
@@ -457,7 +464,7 @@ class DecodeEngine:
         self._slots[sid] = None
         self._cond.notify_all()
 
-    def _integrate_joins(self) -> None:
+    def _integrate_joins(self) -> None:  # requires-lock: _cond
         """Token boundary: splice every admitted request into its slot
         (caller holds the lock; pool writes release it per join)."""
         while self._joinq:
@@ -472,7 +479,7 @@ class DecodeEngine:
                                      j['tok0'], j['keys'], j['temp'],
                                      j['max_new'], j['seq'])
 
-    def _expire_slots(self, now: float) -> None:
+    def _expire_slots(self, now: float) -> None:  # requires-lock: _cond
         for sid, slot in enumerate(self._slots):
             if not isinstance(slot, _Slot):
                 continue
@@ -487,7 +494,7 @@ class DecodeEngine:
                 self._free_slot(sid)
                 self._finish(req, err)
 
-    def _alloc_step_pages(self) -> None:
+    def _alloc_step_pages(self) -> None:  # requires-lock: _cond
         """On-demand page allocation for every slot about to write into
         an unmapped logical page; pool-dry sheds the youngest stream."""
         order = sorted((s.join_seq, sid) for sid, s in
@@ -585,6 +592,9 @@ class DecodeEngine:
                         temp[sid] = slot.temp
                         r[sid] = slot.keys[slot.kidx]
                         stepped.append(sid)
+            # the K/V pools are loop-thread-owned between token
+            # boundaries; resident_bytes snapshots them under _cond
+            # lint: allow(lock-discipline): single-writer pool handoff (loop thread)
             self._kpool, self._vpool, nxt = self._step(
                 params, self._kpool, self._vpool, table, pos, w, tok, r,
                 temp)

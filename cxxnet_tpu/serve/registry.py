@@ -135,14 +135,14 @@ class ModelRegistry:
         # same verify/blacklist machinery (serve/decode.py lm_loader).
         self._re = _MODEL_RE if pattern is None else re.compile(pattern)
         self._loader = load_model_params if loader is None else loader
-        self.transitions: List[Tuple[str, str]] = []
+        self.transitions: List[Tuple[str, str]] = []  # guarded-by: _lock
         # swap stamps: the step number of the last adopted checkpoint
         # (parsed from its %04d name — group 1 of ``pattern``) and when
         # it swapped in, the serving half of the freshness metric
         # (doc/online.md); surfaced via :meth:`report` / serve stats
-        self.swaps = 0
-        self.last_swap_step: int = -1        # -1: never swapped
-        self.last_swap_time: Optional[float] = None   # time.monotonic()
+        self.swaps = 0                       # guarded-by: _lock
+        self.last_swap_step: int = -1        # guarded-by: _lock (-1: never)
+        self.last_swap_time: Optional[float] = None   # guarded-by: _lock
         # counter -> failed poll cycles; a MultiModelRegistry passes a
         # shared dict so the blacklist survives evict/reload cycles
         self._attempts: dict = {} if attempts is None else attempts
@@ -379,7 +379,7 @@ class MultiModelRegistry:
         self.budgeter = MemoryBudgeter(mem_budget)
         self.poll_interval = float(poll_interval)
         self.log = faults.global_failure_log() if log is None else log
-        self._models: Dict[str, _ManagedModel] = {}
+        self._models: Dict[str, _ManagedModel] = {}  # guarded-by: _lock
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -410,7 +410,7 @@ class MultiModelRegistry:
             return sorted(m for m, e in self._models.items()
                           if e.engine is not None)
 
-    def _entry(self, model_id: str) -> _ManagedModel:
+    def _entry(self, model_id: str) -> _ManagedModel:  # requires-lock: _lock
         entry = self._models.get(model_id)
         if entry is None:
             raise KeyError(f'unknown model {model_id!r}')
@@ -454,7 +454,7 @@ class MultiModelRegistry:
                     entry.leases -= 1
         return _leased()
 
-    def _load(self, entry: _ManagedModel) -> None:
+    def _load(self, entry: _ManagedModel) -> None:  # requires-lock: _lock
         entry.engine = entry.factory()
         self.budgeter.account(entry.model_id,
                               int(entry.engine.resident_bytes()))
@@ -470,7 +470,7 @@ class MultiModelRegistry:
             self._evict(entry)      # roll back: the cold load loses
             raise
 
-    def _enforce_budget(self, protect: str) -> None:
+    def _enforce_budget(self, protect: str) -> None:  # requires-lock: _lock
         while self.budgeter.over_budget():
             victims = [e for e in self._models.values()
                        if e.engine is not None and e.model_id != protect
@@ -484,7 +484,7 @@ class MultiModelRegistry:
             coldest = min(victims, key=lambda e: e.last_used)
             self._evict(coldest)
 
-    def _evict(self, entry: _ManagedModel) -> None:
+    def _evict(self, entry: _ManagedModel) -> None:  # requires-lock: _lock
         freed = self.budgeter.release(entry.model_id)
         if entry.registry is not None:
             entry.registry.close(timeout=5.0)

@@ -64,7 +64,7 @@ class ThreadBuffer:
         self._lock = threading.Lock()
         # every live (thread, stop, queue) from __iter__, for close()
         self._runs: List[Tuple[threading.Thread, threading.Event,
-                               queue.Queue]] = []
+                               queue.Queue]] = []  # guarded-by: _lock
 
     def _run(self, q: queue.Queue, stop: threading.Event, box: list) -> None:
         try:

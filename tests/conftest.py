@@ -65,6 +65,12 @@ def pytest_configure(config):
         '(tier-1: runs under -m "not slow"; select with -m online)')
     config.addinivalue_line(
         'markers',
+        'lint: graftlint static-analysis suite — the five AST invariant '
+        'checkers over seeded fixtures AND the live codebase, plus the '
+        'shrink-only baseline ratchet; pure host code, no device '
+        '(tier-1: runs under -m "not slow"; select with -m lint)')
+    config.addinivalue_line(
+        'markers',
         'execution: ExecutionPlan / composable step-loop suite — '
         'scanned K-dispatch composed with update_period, train metrics, '
         'supervision and chaos recovery, bitwise twins + demotion-matrix '

@@ -1,0 +1,17 @@
+"""Clean twin of clock_wall.py: monotonic deadline arithmetic, plus
+one allowed wall-clock use for a calendar stamp."""
+
+import time
+
+
+def wait_until(flag, timeout):
+    end = time.monotonic() + timeout
+    while not flag.is_set():
+        if time.monotonic() > end:
+            return False
+    return True
+
+
+def receipt_stamp():
+    # a calendar timestamp on a receipt is the ONE lawful wall-clock use
+    return time.time()  # lint: allow(monotonic-clock): calendar stamp for the receipt ledger

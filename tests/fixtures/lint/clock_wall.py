@@ -1,0 +1,12 @@
+"""Seeded violation: a deadline computed from wall-clock time.
+Twin: clock_clean.py."""
+
+import time
+
+
+def wait_until(flag, timeout):
+    end = time.time() + timeout
+    while not flag.is_set():
+        if time.time() > end:
+            return False
+    return True

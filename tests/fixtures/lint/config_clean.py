@@ -1,0 +1,15 @@
+"""Clean twin of config_undocumented.py: every parsed key has a row or
+backtick mention in config_doc.md."""
+
+
+class Task:
+    def set_param(self, name, val):
+        simple = {
+            'num_round': ('num_round', int),
+            'model_dir': ('model_dir', str),
+        }
+        if name in simple:
+            attr, typ = simple[name]
+            setattr(self, attr, typ(val))
+        if name == 'data':
+            self.section = val

@@ -1,0 +1,17 @@
+"""Seeded violation: ``set_param`` parses a key (``io.mystery``) that
+the fixture doc table (config_doc.md) never mentions — config-key
+drift.  Twin: config_clean.py."""
+
+
+class Task:
+    def set_param(self, name, val):
+        simple = {
+            'num_round': ('num_round', int),
+            'model_dir': ('model_dir', str),
+            'io.mystery': ('mystery', int),
+        }
+        if name in simple:
+            attr, typ = simple[name]
+            setattr(self, attr, typ(val))
+        if name == 'data':
+            self.section = val

@@ -1,0 +1,26 @@
+"""Clean twin of faults_raw_raise.py: typed taxonomy raise, ValueError
+argument validation, a broad except that routes to the FailureLog, and
+one deliberate swallow carrying an explicit allow."""
+
+from cxxnet_tpu.runtime import faults
+
+log = faults.global_failure_log()
+
+
+def serve_one(req):
+    if req is None:
+        raise ValueError('req must not be None')
+    if req.expired:
+        raise faults.DeadlineExceededError(1.0, 2.0, 1)
+    try:
+        return req.run()
+    except Exception as e:           # watcher must outlive bad cycles
+        log.record('serve_error', f'{e!r}')
+        return None
+
+
+def probe(req):
+    try:
+        return req.run()
+    except Exception:  # lint: allow(fault-taxonomy): capability probe; absence is the signal
+        return None

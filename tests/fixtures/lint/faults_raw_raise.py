@@ -1,0 +1,12 @@
+"""Seeded violations: a raw RuntimeError raise in serve-scoped code and
+a broad ``except Exception`` that swallows without routing to the
+FailureLog.  Twin: faults_clean.py."""
+
+
+def serve_one(req):
+    if req is None:
+        raise RuntimeError('no request')     # untyped: invisible to policy
+    try:
+        return req.run()
+    except Exception:
+        return None                          # swallowed, unrouted

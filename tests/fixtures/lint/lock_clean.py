@@ -1,0 +1,25 @@
+"""Clean twin of lock_unguarded.py: the counter declares its guard and
+every access holds it; a caller-holds-the-lock helper carries the
+``# requires-lock:`` annotation."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.count = 0               # guarded-by: _lock
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _bump(self):                 # requires-lock: _lock
+        self.count += 1
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            with self._lock:
+                self._bump()
+
+    def progress(self):
+        with self._lock:
+            return self.count

@@ -1,0 +1,22 @@
+"""Clean twin of lock_order_inverted.py: both paths acquire the locks
+in the same global order, so the acquisition graph stays acyclic."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.a = 0                   # guarded-by: _alock
+        self.b = 0                   # guarded-by: _block
+
+    def a_to_b(self):
+        with self._alock:
+            with self._block:
+                self.b += self.a
+
+    def b_to_a(self):
+        with self._alock:
+            with self._block:
+                self.a -= self.b

@@ -1,0 +1,22 @@
+"""Seeded violation: two code paths acquire the same pair of locks in
+opposite orders — the classic ABBA deadlock.  Twin: lock_order_clean.py."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.a = 0                   # guarded-by: _alock
+        self.b = 0                   # guarded-by: _block
+
+    def a_to_b(self):
+        with self._alock:
+            with self._block:
+                self.b += self.a
+
+    def b_to_a(self):
+        with self._block:
+            with self._alock:
+                self.a += self.b

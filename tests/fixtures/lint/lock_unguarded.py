@@ -1,0 +1,21 @@
+"""Seeded violation: the worker thread writes a counter that public
+methods read, with no guard declared and no lock held — the exact
+'unguarded counter' regression class the lock-discipline checker
+exists to catch.  Twin: lock_clean.py."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            self.count += 1          # worker write, no lock
+
+    def progress(self):
+        return self.count            # public read, no lock

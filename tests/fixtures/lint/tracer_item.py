@@ -1,0 +1,23 @@
+"""Seeded violations: a ``.item()`` host sync inside a scanned body, a
+``float()`` sync plus wall-clock nondeterminism inside a jitted
+function, and a print of a traced value.  Twin: tracer_clean.py."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_step(x):
+    t = time.time()                  # trace-time constant
+    print(x)                         # host sync + retrace
+    return x * float(t)              # host sync
+
+
+def scan_loss(xs):
+    def body(carry, x):
+        carry = carry + x.item()     # host sync inside the scan
+        return carry, x
+
+    return jax.lax.scan(body, jnp.float32(0), xs)

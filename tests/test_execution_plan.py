@@ -15,7 +15,6 @@ plan"):
 """
 
 import os
-import re
 import subprocess
 import sys
 
@@ -337,13 +336,17 @@ def test_stepper_k1_keeps_one_batch_lookahead():
 def test_demotion_matrix_matches_documented_table():
     """doc/trainer.md's fallback matrix cannot silently rot: its reason
     keys — and their static/runtime split — must equal the programmatic
-    registry in nnet/execution.py."""
+    registry in nnet/execution.py.  Parsed through the shared doc-table
+    extractor (cxxnet_tpu.analysis.config_keys) rather than a private
+    regex: one extractor, every drift test a consumer."""
+    from cxxnet_tpu.analysis.config_keys import backtick_key, doc_table_rows
     doc = open(os.path.join(REPO, 'doc', 'trainer.md')).read()
     # everything after the matrix heading: the matrix is the last table
     # in the file, so backtick-keyed rows below the marker are its rows
-    section = doc.split('Fallback matrix', 1)[1]
-    rows = re.findall(r'^\| `(\w+)` \| (.+?) \|', section, re.M)
-    assert {r[0] for r in rows} == set(DEMOTION_REASONS)
+    rows = [(backtick_key(r[0]), r[1])
+            for r in doc_table_rows(doc, after='Fallback matrix')
+            if len(r) >= 2 and backtick_key(r[0])]
+    assert {k for k, _ in rows} == set(DEMOTION_REASONS)
     assert set(execution.STATIC_REASONS) | set(execution.RUNTIME_REASONS) \
         == set(DEMOTION_REASONS)
     for key, cond in rows:

@@ -1,0 +1,523 @@
+"""graftlint: the invariant checkers, checked (``-m lint``).
+
+Two layers, mirroring how the suite earns trust:
+
+* **fixture layer** — every checker must CATCH its seeded violation
+  fixture (tests/fixtures/lint/) and stay SILENT on the clean twin, so
+  the checkers themselves cannot silently rot;
+* **live layer** — every checker runs over the real package and the
+  result must be clean or exactly baselined (lint_baseline.json),
+  with the shrink-only ratchet pinning the baseline against growth.
+
+The config-key extractor is also the doc-table parser other suites
+consume (test_execution_plan.py's demotion-matrix drift test) — its
+table/backtick helpers are pinned here.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cxxnet_tpu.analysis import (config_keys, core, fault_taxonomy,
+                                 lock_discipline, monotonic_clock,
+                                 tracer_hygiene)
+from cxxnet_tpu.analysis.core import (Finding, Repo, apply_suppressions,
+                                      diff_against_baseline, load_baseline,
+                                      run_all)
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, 'tests', 'fixtures', 'lint')
+
+
+def fixture(name):
+    return core.Module(FIXDIR, name)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# --- lock-discipline: fixtures ---------------------------------------------
+
+def test_lock_unguarded_counter_caught():
+    findings = lock_discipline.check_module(fixture('lock_unguarded.py'))
+    assert rules_of(findings) == ['lock-discipline']
+    assert 'Pump.count' in findings[0].message
+    assert 'worker-thread' in findings[0].message
+
+
+def test_lock_clean_twin_silent():
+    assert lock_discipline.check_module(fixture('lock_clean.py')) == []
+
+
+def test_lock_declared_guard_violation_caught():
+    src = '''\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []            # guarded-by: _lock
+
+    def peek(self):
+        return self.items          # read without the lock
+'''
+    mod = core.parse_snippet(src)
+    findings = lock_discipline.check_module(mod)
+    assert rules_of(findings) == ['lock-discipline']
+    assert 'Box.items' in findings[0].message
+    assert 'peek' in findings[0].message
+
+
+def test_lock_guard_must_name_a_real_lock():
+    src = '''\
+class Box:
+    def __init__(self):
+        self.items = []            # guarded-by: _lock
+'''
+    findings = lock_discipline.check_module(core.parse_snippet(src))
+    assert ['lock-discipline'] == rules_of(findings)
+    assert 'no lock attribute' in findings[0].message
+
+
+def test_lock_closure_does_not_inherit_with_block():
+    """A closure defined inside `with self._lock:` runs LATER — its
+    body must not count as lock-held (deferred-execution bug class)."""
+    src = '''\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []            # guarded-by: _lock
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            def later():
+                return self.items
+            self.cb = later
+'''
+    findings = lock_discipline.check_module(core.parse_snippet(src))
+    assert ['lock-discipline'] == rules_of(findings)
+    assert 'later' in findings[0].message
+
+
+def test_lock_order_inverted_caught():
+    findings = lock_discipline.order_findings(
+        [fixture('lock_order_inverted.py')])
+    assert rules_of(findings) == ['lock-order']
+    assert 'Transfer._alock' in findings[0].message
+    assert 'Transfer._block' in findings[0].message
+
+
+def test_lock_order_clean_twin_silent():
+    assert lock_discipline.order_findings(
+        [fixture('lock_order_clean.py')]) == []
+
+
+def test_lock_order_cross_module_cycle():
+    """The graph is global: each module alone is consistent, together
+    they form an ABBA cycle — the cross-subsystem deadlock shape."""
+    a = core.parse_snippet('''\
+def publish():
+    with registry_lock:
+        with engine_lock:
+            pass
+''', 'a.py')
+    b = core.parse_snippet('''\
+def evict():
+    with engine_lock:
+        with registry_lock:
+            pass
+''', 'b.py')
+    assert lock_discipline.order_findings([a]) == []
+    assert lock_discipline.order_findings([b]) == []
+    cyc = lock_discipline.order_findings([a, b])
+    assert rules_of(cyc) == ['lock-order']
+    assert 'registry_lock' in cyc[0].message
+    assert 'engine_lock' in cyc[0].message
+
+
+# --- tracer-hygiene: fixtures -----------------------------------------------
+
+def test_tracer_violations_caught():
+    findings = tracer_hygiene.check_module(fixture('tracer_item.py'))
+    msgs = ' | '.join(f.message for f in findings)
+    assert '.item()' in msgs                 # sync inside the scan body
+    assert 'float()' in msgs                 # sync inside the jitted fn
+    assert 'time.time()' in msgs             # trace-time constant
+    assert 'print()' in msgs
+    assert all(f.rule == 'tracer-hygiene' for f in findings)
+
+
+def test_tracer_scan_body_attribution():
+    """The .item() is reported at the innermost fn (the scan body),
+    exactly once — not re-reported for every enclosing traced fn."""
+    findings = tracer_hygiene.check_module(fixture('tracer_item.py'))
+    items = [f for f in findings if '.item()' in f.message]
+    assert len(items) == 1
+    assert 'body' in items[0].message
+
+
+def test_tracer_clean_twin_silent():
+    assert tracer_hygiene.check_module(fixture('tracer_clean.py')) == []
+
+
+def test_tracer_tree_map_is_not_lax_map():
+    """`jax.tree.map(lambda ...)` is host code — the lambda must NOT be
+    treated as traced (live false positive this checker once had)."""
+    src = '''\
+import jax
+import numpy as np
+
+def place(tree):
+    return jax.tree.map(lambda h: jax.device_put(np.asarray(h)), tree)
+'''
+    assert tracer_hygiene.check_module(core.parse_snippet(src)) == []
+
+
+# --- fault-taxonomy: fixtures ------------------------------------------------
+
+@pytest.fixture(scope='module')
+def fault_names():
+    return fault_taxonomy.fault_class_names(Repo(REPO))
+
+
+def test_fault_names_resolved(fault_names):
+    assert {'TrainingFault', 'DivergenceError', 'ServeError',
+            'DeadlineExceededError', 'FreshnessSLOError',
+            'FaultInjected', 'RetryError'} <= fault_names
+    assert 'FailureLog' not in fault_names
+    assert 'RetryPolicy' not in fault_names
+
+
+def test_fault_raw_raise_and_swallow_caught(fault_names):
+    mod = fixture('faults_raw_raise.py')
+    findings = fault_taxonomy.check_module(mod, fault_names)
+    msgs = ' | '.join(f.message for f in findings)
+    assert 'raise RuntimeError' in msgs
+    assert 'broad "except Exception"' in msgs
+    assert len(findings) == 2
+
+
+def test_fault_clean_twin_silent(fault_names):
+    mod = fixture('faults_clean.py')
+    findings = apply_suppressions(
+        fault_taxonomy.check_module(mod, fault_names), mod)
+    assert findings == []
+
+
+def test_fault_tuple_form_broad_except_caught(fault_names):
+    """`except (Exception, X):` swallows everything `except Exception:`
+    does — the tuple spelling must not evade the rule."""
+    src = '''\
+def f(x):
+    try:
+        return x()
+    except (Exception, ValueError):
+        return None
+'''
+    findings = fault_taxonomy.check_module(core.parse_snippet(src),
+                                           fault_names)
+    assert rules_of(findings) == ['fault-taxonomy']
+
+
+def test_fault_base_exception_stays_out_of_scope(fault_names):
+    """`except BaseException` is the package's deliberate propagate-to-
+    consumer pattern (thread_buffer/pool) — not flagged."""
+    src = '''\
+def f(x):
+    try:
+        return x()
+    except BaseException:
+        raise
+'''
+    assert fault_taxonomy.check_module(core.parse_snippet(src),
+                                       fault_names) == []
+
+
+def test_fault_allow_requires_matching_rule(fault_names):
+    src = '''\
+def f(x):
+    try:
+        return x()
+    except Exception:  # lint: allow(monotonic-clock): wrong rule
+        return None
+'''
+    mod = core.parse_snippet(src)
+    findings = apply_suppressions(
+        fault_taxonomy.check_module(mod, fault_names), mod)
+    assert rules_of(findings) == ['fault-taxonomy']
+
+
+# --- config-key-drift: fixtures + the shared extractor -----------------------
+
+@pytest.fixture(scope='module')
+def fixture_doc_keys():
+    with open(os.path.join(FIXDIR, 'config_doc.md')) as f:
+        return config_keys.doc_keys(f.read())
+
+
+def test_config_undocumented_key_caught(fixture_doc_keys):
+    findings = config_keys.check_module(
+        fixture('config_undocumented.py'), fixture_doc_keys,
+        doc_files=('config_doc.md',))
+    assert rules_of(findings) == ['config-key-drift']
+    assert "'io.mystery'" in findings[0].message
+
+
+def test_config_clean_twin_silent(fixture_doc_keys):
+    assert config_keys.check_module(
+        fixture('config_clean.py'), fixture_doc_keys,
+        doc_files=('config_doc.md',)) == []
+
+
+def test_parsed_keys_sees_both_idioms():
+    keys = config_keys.parsed_keys(fixture('config_undocumented.py'))
+    assert {'num_round', 'model_dir', 'io.mystery', 'data'} == set(keys)
+
+
+def test_doc_table_rows_and_backtick_key():
+    text = ('## Keys\n\n| key | meaning |\n|---|---|\n'
+            '| `alpha` | first |\n| `beta = 2` | second (runtime) |\n')
+    rows = config_keys.doc_table_rows(text)
+    keyed = [(config_keys.backtick_key(r[0]), r[1]) for r in rows
+             if config_keys.backtick_key(r[0])]
+    assert keyed == [('alpha', 'first'), ('beta', 'second (runtime)')]
+    assert config_keys.doc_table_rows(text, after='nowhere') == []
+
+
+def test_live_extractor_sees_cli_keys():
+    repo = Repo(REPO)
+    keys = config_keys.parsed_keys(repo.module('cxxnet_tpu/main.py'))
+    assert {'task', 'num_round', 'continue', 'steps_per_dispatch',
+            'train.supervise', 'serve.mode', 'online.qps', 'data',
+            'pred'} <= set(keys)
+
+
+# --- monotonic-clock: fixtures ----------------------------------------------
+
+def test_clock_wall_deadline_caught():
+    findings = monotonic_clock.check_module(fixture('clock_wall.py'))
+    assert rules_of(findings) == ['monotonic-clock'] * 2
+
+
+def test_clock_clean_twin_and_allowed_stamp_silent():
+    mod = fixture('clock_clean.py')
+    raw = monotonic_clock.check_module(mod)
+    assert len(raw) == 1              # the calendar stamp IS detected...
+    assert apply_suppressions(raw, mod) == []   # ...and explicitly allowed
+
+
+def test_clock_from_import_spelling_caught():
+    src = 'from time import time\n\ndef f():\n    return time()\n'
+    findings = monotonic_clock.check_module(core.parse_snippet(src))
+    assert rules_of(findings) == ['monotonic-clock']
+
+
+def test_clock_aliased_imports_caught():
+    """`import time as t` / `from time import time as wall` must not
+    evade the rule — an aliased wall-clock deadline is just as wrong."""
+    src = ('import time as _t\nfrom time import time as wall\n\n'
+           'def f():\n    return wall() + _t.time()\n')
+    findings = monotonic_clock.check_module(core.parse_snippet(src))
+    assert rules_of(findings) == ['monotonic-clock'] * 2
+    # monotonic through an alias stays clean
+    src2 = ('import time as _t\n\ndef f():\n    return _t.monotonic()\n')
+    assert monotonic_clock.check_module(core.parse_snippet(src2)) == []
+
+
+# --- live repo: clean or exactly baselined -----------------------------------
+
+def test_live_repo_clean_or_baselined():
+    findings = run_all(root=REPO)
+    new, stale, matched = diff_against_baseline(findings,
+                                                load_baseline())
+    assert new == [], '\n'.join(f.format() for f in new)
+    assert stale == [], stale
+    assert matched == len(findings)
+
+
+def test_live_lock_order_acyclic():
+    assert run_all(root=REPO, rules=['lock-order']) == []
+
+
+def test_live_tracer_hygiene_clean():
+    assert run_all(root=REPO, rules=['tracer-hygiene']) == []
+
+
+def test_live_monotonic_clean():
+    assert run_all(root=REPO, rules=['monotonic-clock']) == []
+
+
+def test_live_config_keys_documented():
+    assert run_all(root=REPO, rules=['config-key-drift']) == []
+
+
+def test_live_threaded_classes_declare_guards():
+    """The annotation convention is actually deployed: the flagship
+    threaded classes each declare at least one guarded attribute."""
+    import ast as _ast
+    repo = Repo(REPO)
+    expect = {
+        'cxxnet_tpu/utils/thread_buffer.py': 'ThreadBuffer',
+        'cxxnet_tpu/serve/batcher.py': 'DynamicBatcher',
+        'cxxnet_tpu/serve/decode.py': 'DecodeEngine',
+        'cxxnet_tpu/serve/registry.py': 'ModelRegistry',
+        'cxxnet_tpu/online/pipeline.py': 'OnlinePipeline',
+        'cxxnet_tpu/runtime/async_ckpt.py': 'AsyncCheckpointer',
+    }
+    for rel, cls in expect.items():
+        mod = repo.module(rel)
+        node = next(n for n in _ast.walk(mod.tree)
+                    if isinstance(n, _ast.ClassDef) and n.name == cls)
+        info = lock_discipline._ClassInfo(mod, node)
+        assert info.guarded, f'{cls} declares no # guarded-by attributes'
+        assert info.spawns, f'{cls} expected to spawn worker threads'
+
+
+# --- baseline: the shrink-only ratchet ---------------------------------------
+
+# Lower this cap when you fix a baselined finding; NEVER raise it.  A
+# new finding belongs in the code (fixed) or at its site (# lint:
+# allow(rule): reason), not in the baseline.
+MAX_BASELINE_ENTRIES = 7
+
+
+def test_baseline_never_grows():
+    entries = load_baseline()
+    assert len(entries) <= MAX_BASELINE_ENTRIES, (
+        f'lint_baseline.json grew to {len(entries)} entries '
+        f'(cap {MAX_BASELINE_ENTRIES}) — the baseline is shrink-only')
+    for e in entries:
+        assert e['reason'].strip(), e
+
+
+def test_baseline_policy_field():
+    with open(core.baseline_path(REPO)) as f:
+        data = json.load(f)
+    assert data.get('policy') == 'shrink-only'
+
+
+def test_stale_baseline_entry_fails():
+    entries = load_baseline() + [{
+        'rule': 'monotonic-clock', 'path': 'cxxnet_tpu/ghost.py',
+        'message': 'long gone', 'reason': 'stale on purpose'}]
+    findings = run_all(root=REPO)
+    _new, stale, _m = diff_against_baseline(findings, entries)
+    assert [e['path'] for e in stale] == ['cxxnet_tpu/ghost.py']
+
+
+def test_baseline_matching_is_line_independent():
+    f = Finding('r', 'p.py', 999, 'msg')
+    new, stale, matched = diff_against_baseline(
+        [f], [{'rule': 'r', 'path': 'p.py', 'message': 'msg',
+               'reason': 'x'}])
+    assert (new, stale, matched) == ([], [], 1)
+
+
+def test_baseline_multiset_matching():
+    """Two identical findings need two baseline entries."""
+    f = Finding('r', 'p.py', 1, 'msg')
+    e = {'rule': 'r', 'path': 'p.py', 'message': 'msg', 'reason': 'x'}
+    new, _s, matched = diff_against_baseline([f, f], [e])
+    assert matched == 1 and len(new) == 1
+
+
+# --- tools/lint.py CLI --------------------------------------------------------
+
+LINT = os.path.join(REPO, 'tools', 'lint.py')
+
+
+def _lint(*args, cwd=None):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS='cpu')
+    return subprocess.run([sys.executable, LINT, *args],
+                          capture_output=True, text=True, env=env,
+                          cwd=cwd or REPO, timeout=300)
+
+
+def _seed_violation_tree(tmp_path):
+    pkg = tmp_path / 'cxxnet_tpu'
+    pkg.mkdir()
+    (pkg / '__init__.py').write_text('')
+    (pkg / 'bad.py').write_text(
+        'import time\n\n\ndef deadline(t):\n    return time.time() + t\n')
+    return tmp_path
+
+
+def test_cli_exit0_on_repo():
+    r = _lint()
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert '0 new' in r.stderr
+
+
+def test_cli_exit1_on_new_finding(tmp_path):
+    root = _seed_violation_tree(tmp_path)
+    r = _lint(str(root))
+    assert r.returncode == 1
+    assert 'monotonic-clock' in r.stdout
+
+
+def test_cli_exit1_on_stale_baseline_and_update_shrinks(tmp_path):
+    root = _seed_violation_tree(tmp_path)
+    (root / 'cxxnet_tpu' / 'bad.py').write_text('X = 1\n')
+    bl = root / 'lint_baseline.json'
+    bl.write_text(json.dumps({'policy': 'shrink-only', 'entries': [{
+        'rule': 'monotonic-clock', 'path': 'cxxnet_tpu/bad.py',
+        'message': 'gone', 'reason': 'stale'}]}))
+    r = _lint(str(root))
+    assert r.returncode == 1
+    assert 'stale baseline entry' in r.stdout
+    # --update-baseline drops the stale entry (shrink) and exits clean
+    r2 = _lint(str(root), '--update-baseline')
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert json.loads(bl.read_text())['entries'] == []
+    # but it NEVER adds: a live finding still fails after update
+    (root / 'cxxnet_tpu' / 'bad.py').write_text(
+        'import time\n\n\ndef f():\n    return time.time()\n')
+    r3 = _lint(str(root), '--update-baseline')
+    assert r3.returncode == 1
+    assert json.loads(bl.read_text())['entries'] == []
+
+
+def test_cli_update_baseline_keeps_matched_duplicate(tmp_path):
+    """Duplicate identical entries are legitimate (multiset matching):
+    when one of two copies goes stale, --update-baseline removes ONE
+    occurrence, keeping the copy that still matches a live finding."""
+    root = _seed_violation_tree(tmp_path)
+    entry = {'rule': 'monotonic-clock', 'path': 'cxxnet_tpu/bad.py',
+             'message': 'time.time() is wall-clock — durations and '
+                        'deadlines must use time.monotonic() (allow '
+                        'with a reason for genuine calendar timestamps)',
+             'reason': 'dup'}
+    bl = root / 'lint_baseline.json'
+    bl.write_text(json.dumps({'policy': 'shrink-only',
+                              'entries': [entry, entry]}))
+    r = _lint(str(root), '--update-baseline')   # 1 live, 1 stale
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert len(json.loads(bl.read_text())['entries']) == 1
+    assert _lint(str(root)).returncode == 0     # still exactly baselined
+
+
+def test_cli_exit2_on_unreadable_baseline(tmp_path):
+    root = tmp_path
+    (root / 'cxxnet_tpu').mkdir()
+    (root / 'cxxnet_tpu' / '__init__.py').write_text('')
+    (root / 'lint_baseline.json').write_text('{not json')
+    r = _lint(str(root))
+    assert r.returncode == 2
+    assert 'internal error' in r.stderr
+
+
+def test_cli_rule_filter_and_listing():
+    r = _lint('--list-rules')
+    assert r.returncode == 0
+    assert set(r.stdout.split()) == set(core.ALL_RULES)
+    r = _lint('--rule', 'no-such-rule')
+    assert r.returncode == 2

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""graftlint CLI — drive the project's static invariant checkers.
+
+Usage::
+
+    python tools/lint.py [--rule RULE ...] [--baseline PATH | --no-baseline]
+                         [--list-rules] [--update-baseline] [root]
+
+Exit codes (doc/static_analysis.md):
+
+* ``0`` — clean: no findings, or every finding matches a baseline
+  entry exactly.
+* ``1`` — the lint contract is violated: NEW findings (fix, allow with
+  a reason, or — exceptionally — baseline with a reason), or STALE
+  baseline entries (a fixed finding must also delete its entry: the
+  baseline only shrinks).
+* ``2`` — internal error (checker crash, unreadable baseline): the
+  lint could not render a verdict, treat as infrastructure failure.
+
+``--update-baseline`` enforces the shrink-only policy mechanically: it
+rewrites the baseline keeping only still-live entries (reasons
+preserved) and refuses to add anything — new findings still exit 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cxxnet_tpu.analysis import core  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument('root', nargs='?', default=None,
+                   help='repository root (default: this checkout)')
+    p.add_argument('--rule', action='append', default=None,
+                   help='run only this rule (repeatable)')
+    p.add_argument('--baseline', default=None,
+                   help='baseline json (default: <root>/lint_baseline.json)')
+    p.add_argument('--no-baseline', action='store_true',
+                   help='ignore the baseline: every finding is new')
+    p.add_argument('--update-baseline', action='store_true',
+                   help='drop stale entries from the baseline (shrink-only; '
+                        'never adds)')
+    p.add_argument('--list-rules', action='store_true')
+    p.add_argument('-q', '--quiet', action='store_true')
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in core.ALL_RULES:
+            print(r)
+        return 0
+
+    try:
+        root = os.path.abspath(args.root) if args.root else core.default_root()
+        findings = core.run_all(root=root, rules=args.rule)
+        if args.no_baseline:
+            entries = []
+            bl_path = None
+        else:
+            bl_path = args.baseline or core.baseline_path(root)
+            entries = core.load_baseline(bl_path)
+        new, stale, matched = core.diff_against_baseline(findings, entries)
+    except Exception:
+        traceback.print_exc()
+        print('lint: internal error (no verdict)', file=sys.stderr)
+        return 2
+
+    for f in new:
+        print(f.format())
+    for e in stale:
+        print(f'stale baseline entry (finding fixed — delete it): '
+              f'[{e["rule"]}] {e["path"]}: {e["message"]}')
+
+    if args.update_baseline and stale and bl_path:
+        # remove ONE occurrence per stale entry: identical duplicate
+        # entries are legitimate (multiset matching), and only the
+        # unmatched copies are stale
+        live = list(entries)
+        for e in stale:
+            live.remove(e)
+        with open(bl_path, 'w', encoding='utf-8') as f:
+            json.dump({'policy': 'shrink-only', 'entries': live}, f,
+                      indent=2, sort_keys=True)
+            f.write('\n')
+        print(f'lint: baseline shrunk {len(entries)} -> {len(live)} '
+              f'({bl_path})')
+        stale = []
+
+    if not args.quiet:
+        print(f'lint: {len(findings)} finding(s), {matched} baselined, '
+              f'{len(new)} new, {len(stale)} stale', file=sys.stderr)
+    return 1 if (new or stale) else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
