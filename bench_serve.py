@@ -18,6 +18,19 @@ next to the training BENCH_*.json ledger.  Two modes:
    "shed": {"expired": E, "pages": P, "rejected": R},
    "gen_cache": {"hit": H, "miss": M}, "slots": S, "pages": PG, ...}
 
+``decode_matrix`` — the serve.flash_decode x serve.dtype A/B grid over
+ONE fixed seeded workload (doc/serving.md "Flash paged decode" /
+"Quantized inference").  Every leg's streams are twin-asserted in-bench
+against offline ``generate`` over that leg's own stored tree (the
+BENCH_SCAN_r01 discipline: a receipt is only emitted for outputs proven
+correct)::
+
+  {"metric": "decode_int8_resident_reduction", "value": X, "unit": "x",
+   "legs": [{"attention": "gather|flash", "dtype": "f32|bf16|int8",
+             "tokens_per_sec": T, "token_p50_ms": P50,
+             "token_p99_ms": P99, "resident_bytes": B,
+             "twin_checked": N}, ...], "model": {...}}
+
 Method: a tiny model (random init — serving cost is shape-bound, not
 value-bound) behind the real engine + DynamicBatcher stack;
 ``--clients`` in-process threads submit mixed-size requests (seeded)
@@ -255,10 +268,102 @@ def bench_decode(args) -> dict:
     }
 
 
+def bench_decode_matrix(args) -> dict:
+    """A/B grid: gather-vs-flash attention x f32/bf16/int8 serving tier,
+    ONE fixed seeded workload per leg so tokens/sec, per-token quantiles
+    and resident_bytes compare like for like.  Twin-asserted in-bench."""
+    import jax
+    from cxxnet_tpu.models import transformer as T
+    from cxxnet_tpu.serve.decode import DecodeService
+
+    # params-heavy model (vocab dominates): the int8 tier's >=3x
+    # resident claim is about real serving models, not toy trees whose
+    # KV pool drowns the weights
+    cfg = T.TransformerConfig(vocab_size=8192, d_model=256, num_heads=8,
+                              d_ff=512, num_stages=2, seq_len=64,
+                              attn='local')
+    params = T.init_params(np.random.RandomState(0), cfg)
+    rng = np.random.RandomState(args.seed)
+    n_req = args.requests
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           (1, int(rng.randint(1, args.max_prompt))))
+               .astype(np.int32) for _ in range(n_req)]
+
+    def run_leg(attention: str, dtype: str) -> dict:
+        svc = DecodeService(
+            params, cfg, slots=args.slots, pages=args.pages,
+            page_size=args.page_size, max_prompt=args.max_prompt,
+            max_new_bound=args.max_new, max_queue=2 * n_req,
+            deadline=600.0, dtype=dtype,
+            flash_decode=1 if attention == 'flash' else 0)
+        try:
+            warm = svc.submit_async(prompts[0], args.max_new)
+            svc.batcher.wait(warm)            # compile outside the clock
+            t0 = time.monotonic()
+            reqs = [svc.submit_async(p, args.max_new) for p in prompts]
+            toks, gaps = 0, []
+            for r in reqs:
+                svc.batcher.wait(r)
+                toks += len(r.tokens)
+                tt = r.token_times
+                gaps.extend((b - a) * 1e3 for a, b in zip(tt, tt[1:]))
+            wall = time.monotonic() - t0
+            # twin gate (BENCH_SCAN_r01 discipline): every tier's oracle
+            # is generate() over the ENGINE's stored tree + compute cfg
+            checked = 0
+            for i in range(min(args.twin_checks, n_req)):
+                off = np.asarray(T.generate(
+                    svc.engine.params, prompts[i], args.max_new,
+                    svc.engine.cfg))[0]
+                got = np.asarray(reqs[i].result)
+                assert (got == off[:len(got)]).all(), (
+                    f'{attention}/{dtype} stream {i} diverged from its '
+                    f'offline twin')
+                checked += 1
+            def q(p):
+                # null, not NaN, when a leg produced no inter-token gaps
+                # (e.g. --max-new 1): the receipt is strict JSON
+                if not gaps:
+                    return None
+                return round(float(np.quantile(np.asarray(gaps), p)), 4)
+
+            return {
+                'attention': attention, 'dtype': dtype,
+                'tokens_per_sec': round(toks / wall, 2),
+                'token_p50_ms': q(0.5),
+                'token_p99_ms': q(0.99),
+                'resident_bytes': int(svc.engine.resident_bytes()),
+                'streams': n_req, 'twin_checked': checked,
+                'wall_sec': round(wall, 3),
+            }
+        finally:
+            svc.close(60)
+
+    legs = [run_leg(attention, dtype)
+            for attention in ('gather', 'flash')
+            for dtype in ('f32', 'bf16', 'int8')]
+    by = {(l['attention'], l['dtype']): l for l in legs}
+    reduction = (by[('gather', 'f32')]['resident_bytes']
+                 / by[('gather', 'int8')]['resident_bytes'])
+    return {
+        'metric': 'decode_int8_resident_reduction',
+        'value': round(reduction, 2),
+        'unit': 'x',
+        'legs': legs,
+        'model': {'vocab': cfg.vocab_size, 'd_model': cfg.d_model,
+                  'heads': cfg.num_heads, 'd_ff': cfg.d_ff,
+                  'stages': cfg.num_stages},
+        'slots': args.slots, 'pages': args.pages,
+        'page_size': args.page_size, 'max_new': args.max_new,
+        'requests': n_req,
+        'platform': jax.default_backend(),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('mode', nargs='?', default='predict',
-                    choices=('predict', 'decode'))
+                    choices=('predict', 'decode', 'decode_matrix'))
     ap.add_argument('--clients', type=int, default=int(
         os.environ.get('CXXNET_SERVE_BENCH_CLIENTS', 8)))
     ap.add_argument('--duration', type=float, default=float(
@@ -273,18 +378,27 @@ def main(argv=None) -> int:
     ap.add_argument('--page-size', type=int, default=16)
     ap.add_argument('--max-new', type=int, default=int(
         os.environ.get('CXXNET_SERVE_BENCH_MAX_NEW', 32)))
+    ap.add_argument('--max-prompt', type=int, default=int(
+        os.environ.get('CXXNET_SERVE_BENCH_MAX_PROMPT', 24)))
+    ap.add_argument('--requests', type=int, default=int(
+        os.environ.get('CXXNET_SERVE_BENCH_REQUESTS', 12)))
+    ap.add_argument('--twin-checks', type=int, default=2)
+    ap.add_argument('--seed', type=int, default=7)
     args = ap.parse_args(argv)
 
     budget = float(os.environ.get('CXXNET_BENCH_BACKEND_WAIT', '60'))
     if not _backend_ok(budget):
         return _cpu_fallback(argv, f'TPU backend unavailable within '
                                    f'{budget:.0f}s')
+    modes = {'predict': bench_predict, 'decode': bench_decode,
+             'decode_matrix': bench_decode_matrix}
+    metrics = {'predict': 'serve_p99_latency_ms',
+               'decode': 'decode_tokens_per_sec',
+               'decode_matrix': 'decode_int8_resident_reduction'}
     try:
-        out = (bench_decode if args.mode == 'decode'
-               else bench_predict)(args)
+        out = modes[args.mode](args)
     except Exception as e:  # structured failure, never a bare traceback
-        out = {'metric': ('decode_tokens_per_sec' if args.mode == 'decode'
-                          else 'serve_p99_latency_ms'),
+        out = {'metric': metrics[args.mode],
                'value': None, 'unit': None, 'error': repr(e)}
     print(json.dumps(out))
     return 0 if 'error' not in out else 1

@@ -18,11 +18,19 @@ Traced scope is resolved statically per module:
   count),
 * functions handed to ``lax.scan`` / ``lax.cond`` / ``lax.while_loop``
   / ``lax.fori_loop`` / ``lax.map`` / ``jax.vmap`` / ``shard_map``,
+* **Pallas kernel bodies** — the function operand of ``pl.pallas_call``
+  (a direct name, or a local ``kernel = functools.partial(fn, ...)``
+  assignment, which the kernel modules' idiom uses).  A kernel body is
+  the most traced scope there is: a host sync inside one doesn't just
+  slow a dispatch, it breaks compilation on real hardware while
+  silently "working" under ``interpret=True`` on CPU.  Known soundness
+  limit: a kernel that reaches ``pallas_call`` through a helper's
+  *parameter* (``_lrn_call(kernel, ...)``) is not resolved statically,
 * anything lexically nested inside a traced function.
 
 Only the hot-loop modules are scanned (``TARGET_FILES``): the contract
-is about the trainer/decode dispatch path, not utility code that
-lawfully mixes host and device work.
+is about the trainer/decode dispatch path — and the Pallas kernel tier
+— not utility code that lawfully mixes host and device work.
 """
 
 from __future__ import annotations
@@ -38,7 +46,8 @@ RULES = ('tracer-hygiene',)
 #: no-host-sync contract (doc/static_analysis.md)
 TARGET_FILES = ('cxxnet_tpu/nnet/trainer.py',
                 'cxxnet_tpu/nnet/execution.py',
-                'cxxnet_tpu/serve/decode.py')
+                'cxxnet_tpu/serve/decode.py',
+                'cxxnet_tpu/ops/pallas_kernels.py')
 
 #: function-argument positions per wrapper.  lax combinators demand a
 #: `lax` qualifier (``jax.tree.map`` is NOT ``lax.map``); jit/pmap/vmap
@@ -56,6 +65,10 @@ def _hof_positions(fname: str):
     if leaf in _JAX_WRAP and (len(parts) == 1 or parts[0] == 'jax'
                               or leaf == 'shard_map'):
         return True, _JAX_WRAP[leaf]
+    # pl.pallas_call(kernel, ...) — the kernel operand runs fully traced
+    # (Mosaic on TPU, the pallas interpreter on CPU)
+    if leaf == 'pallas_call':
+        return True, (0,)
     return False, None
 
 _SYNC_BUILTINS = {'float', 'bool', 'int'}
@@ -88,6 +101,7 @@ class _Scope:
         self.traced: Set[ast.AST] = set()          # FunctionDef / Lambda
         self._local_defs: dict = {}                # (parent, name) -> def
         self._methods: dict = {}                   # (class, name) -> def
+        self._assigns: dict = {}            # (parent, name) -> value expr
         self._index(mod.tree, None, None)
         self._mark(mod.tree)
 
@@ -104,12 +118,28 @@ class _Scope:
                         self._methods[(child.name, sub.name)] = sub
                 self._index(child, parent, child.name)
             else:
+                if isinstance(child, ast.Assign) \
+                        and len(child.targets) == 1 \
+                        and isinstance(child.targets[0], ast.Name):
+                    # kernel = functools.partial(_fn, ...) — the kernel
+                    # modules' pallas_call idiom; last assignment wins
+                    self._assigns[(parent, child.targets[0].id)] = \
+                        child.value
                 self._index(child, parent, cls)
 
     def _resolve(self, arg: ast.AST, fn_parent: Optional[ast.AST],
-                 cls: Optional[str]) -> Optional[ast.AST]:
+                 cls: Optional[str], _depth: int = 0) -> Optional[ast.AST]:
+        if _depth > 8:                   # assignment-chain cycle guard
+            return None
         if isinstance(arg, ast.Lambda):
             return arg
+        if isinstance(arg, ast.Call):
+            # functools.partial(fn, ...): the wrapped fn is the operand
+            fname = dotted_name(arg.func) or ''
+            if fname.split('.')[-1] == 'partial' and arg.args:
+                return self._resolve(arg.args[0], fn_parent, cls,
+                                     _depth + 1)
+            return None
         if isinstance(arg, ast.Name):
             # walk outward through enclosing function scopes
             parent = fn_parent
@@ -117,6 +147,9 @@ class _Scope:
                 d = self._local_defs.get((parent, arg.id))
                 if d is not None:
                     return d
+                a = self._assigns.get((parent, arg.id))
+                if a is not None:
+                    return self._resolve(a, parent, cls, _depth + 1)
                 if parent is None:
                     return None
                 parent = next((p for (p, n), v in self._local_defs.items()

@@ -155,7 +155,8 @@ def net_serve_start(net: Net, cfg: str) -> None:
     list (utils.config.parse_kv_list): ``buckets`` (``:``-separated, e.g.
     ``1:8:32``), ``max_queue``, ``max_wait`` (seconds), ``deadline``
     (seconds), ``warm`` (0/1), ``models`` (``|``-separated ``id:dir``
-    fleet siblings), ``mem_budget`` (bytes).  Empty string = all
+    fleet siblings), ``mem_budget`` (bytes), ``dtype`` (``f32``/
+    ``bf16``/``int8`` quantized-inference tier).  Empty string = all
     defaults."""
     from .utils.config import parse_kv_list
     kw = {}
@@ -175,6 +176,8 @@ def net_serve_start(net: Net, cfg: str) -> None:
                                 for seg in val.split('|') if seg)
         elif key == 'mem_budget':
             kw['mem_budget'] = int(val)
+        elif key == 'dtype':
+            kw['dtype'] = val
         else:
             raise ValueError(f'unknown serve option: {key!r}')
     net.serve_start(**kw)
@@ -208,7 +211,8 @@ def net_online_start(net: Net, it: DataIter, cfg: str) -> None:
     list: ``model_dir`` (required), ``rounds``, ``save_every``,
     ``freshness_slo``/``freshness_strict``, ``reload``, ``buckets``
     (``:``-separated), ``max_queue``, ``max_wait``, ``deadline``,
-    ``steps_per_dispatch``, ``watchdog_deadline``."""
+    ``steps_per_dispatch``, ``watchdog_deadline``, ``dtype`` (the
+    serving engine's quantized tier, ``f32``/``bf16``/``int8``)."""
     from .utils.config import parse_kv_list
     kw = {}
     ints = ('rounds', 'save_every', 'max_queue', 'steps_per_dispatch')
@@ -219,6 +223,8 @@ def net_online_start(net: Net, it: DataIter, cfg: str) -> None:
             kw['model_dir'] = val
         elif key == 'buckets':
             kw['buckets'] = val.replace(':', ',')
+        elif key == 'dtype':
+            kw['dtype'] = val
         elif key == 'freshness_strict':
             kw['freshness_strict'] = bool(int(val))
         elif key in ints:
@@ -263,8 +269,10 @@ def lm_serve_start(cfg: str):
     ``d_ff``/``stages``/``experts``, params from ``model_in`` (a
     ``%04d.lm`` tree) or ``seed`` init, engine shape ``slots``/``pages``/
     ``page_size``/``max_prompt``/``max_new``/``eos``, batcher knobs
-    ``max_queue``/``max_wait``/``deadline``.  Returns the service handle
-    the other ``lm_serve_*`` calls take."""
+    ``max_queue``/``max_wait``/``deadline``, serving tier ``dtype``
+    (``f32``/``bf16``/``int8``) and attention leg ``flash_decode``
+    (``auto``/``0``/``1``).  Returns the service handle the other
+    ``lm_serve_*`` calls take."""
     import numpy as np
 
     from .models import transformer as T
@@ -292,6 +300,10 @@ def lm_serve_start(cfg: str):
             model_in = val
         elif key == 'eos':
             eos = None if int(val) < 0 else int(val)
+        elif key == 'dtype':
+            svc_kw['dtype'] = val
+        elif key == 'flash_decode':
+            svc_kw['flash_decode'] = val
         else:
             raise ValueError(f'unknown lm_serve option: {key!r}')
     tcfg = T.TransformerConfig(**cfg_kw)

@@ -90,6 +90,8 @@ class LearnTask:
         self.serve_seed = 0            # serve.seed drive prompt/rng seed
         self.serve_models = ''         # serve.models fleet: id=dir;id=dir
         self.serve_mem_budget = 0      # serve.mem_budget bytes (0 = off)
+        self.serve_dtype = 'f32'       # serve.dtype: f32 | bf16 | int8
+        self.serve_flash = 'auto'      # serve.flash_decode: auto | 0 | 1
         # train-while-serve (task=online, doc/online.md); batcher shape
         # comes from the serve.* keys above
         self.online_save_every = 8     # online.save_every steps/checkpoint
@@ -149,6 +151,8 @@ class LearnTask:
             'serve.seed': ('serve_seed', int),
             'serve.models': ('serve_models', str),
             'serve.mem_budget': ('serve_mem_budget', int),
+            'serve.dtype': ('serve_dtype', str),
+            'serve.flash_decode': ('serve_flash', str),
             'online.save_every': ('online_save_every', int),
             'online.freshness_slo': ('online_freshness_slo', float),
             'online.freshness_strict': ('online_freshness_strict', int),
@@ -176,13 +180,17 @@ class LearnTask:
         return os.path.join(self.name_model_dir, f'{counter:04d}.model')
 
     def _sync_latest_model(self) -> bool:
-        s = self.start_counter
-        last = None
-        while os.path.exists(self._model_path(s)):
-            last = self._model_path(s)
-            s += 1
-        if last is None:
+        """Adopt the newest ``%04d.model`` at or past ``start_counter``.
+        Gap-tolerant by design: ``task=online`` publishes checkpoints
+        named by STEP on the supervisor's save cadence (0008, 0016, ...),
+        so the reference's consecutive-counter walk would stop at the
+        first hole and miss every online checkpoint — the newest-file
+        scan is the one the serving registry already trusts."""
+        from .serve.registry import newest_model_file
+        best = newest_model_file(self.name_model_dir)
+        if best is None or best[0] < self.start_counter:
             return False
+        counter, last = best
 
         def _read(f):
             self.net_type = int.from_bytes(f.read(4), 'little', signed=True)
@@ -190,20 +198,20 @@ class LearnTask:
             self.net_trainer.load_model(f)
 
         model_io.read_model_file(last, _read)
-        self.start_counter = s
+        self.start_counter = counter + 1
         if self.exact_ckpt:
             from .nnet.sharded_ckpt import step_dir
             # ask for EXACTLY the loaded model's step: newer leftover
             # sidecars (e.g. after rolling back by deleting model files)
             # must not block restoring the matching one
-            if os.path.isdir(step_dir(self._exact_dir(), s - 1)):
+            if os.path.isdir(step_dir(self._exact_dir(), counter)):
                 self.net_trainer.load_training_state(self._exact_dir(),
-                                                     s - 1)
+                                                     counter)
                 if not self.silent:
                     print(f'Init: exact optimizer state restored from '
-                          f'{self._exact_dir()} step {s - 1}', flush=True)
+                          f'{self._exact_dir()} step {counter}', flush=True)
             elif not self.silent:
-                print(f'Init: no exact state for step {s - 1} — resuming '
+                print(f'Init: no exact state for step {counter} — resuming '
                       f'with reset momentum (reference behavior)',
                       flush=True)
         return True
@@ -362,6 +370,21 @@ class LearnTask:
         if self.task == 'serve' and self.serve_mode == 'decode':
             # the decode stack serves a transformer LM tree (serve.lm /
             # serve.lm_model_in), not a netconfig model: no NetTrainer
+            self._create_iterators()
+            return
+        if self.task == 'online' and self.continue_training:
+            # resume a train-while-serve run: online model files are
+            # named by STEP (the supervisor's save cadence), not round —
+            # adopt the newest and re-arm the publish counter so new
+            # checkpoints continue strictly past it instead of
+            # re-publishing (and re-serving) stale counter names
+            if not self._sync_latest_model():
+                raise RuntimeError(
+                    'Init: cannot find models to continue the online run; '
+                    'start fresh or specify model_in')
+            self.net_trainer.sample_counter = self.start_counter - 1
+            print(f'Init: continue online run from step '
+                  f'{self.net_trainer.sample_counter}')
             self._create_iterators()
             return
         if self.task == 'train' and self.continue_training:
@@ -616,11 +639,13 @@ class LearnTask:
         from .utils.bucketing import parse_buckets
 
         engine = PredictEngine(self.net_trainer,
-                               parse_buckets(self.serve_buckets))
+                               parse_buckets(self.serve_buckets),
+                               dtype=self.serve_dtype)
         engine.warm()
         if not self.silent:
             print(f'serve: warmed {len(engine.buckets)} bucket programs '
-                  f'{engine.buckets}', flush=True)
+                  f'{engine.buckets} (dtype={engine.serve_dtype}, '
+                  f'{engine.resident_bytes()} resident bytes)', flush=True)
         batcher = DynamicBatcher(engine, max_queue=self.serve_max_queue,
                                  max_wait=self.serve_max_wait,
                                  deadline=self.serve_deadline)
@@ -761,6 +786,7 @@ class LearnTask:
             max_queue=self.serve_max_queue,
             max_wait=self.serve_max_wait,
             deadline=self.serve_deadline,
+            dtype=self.serve_dtype,
             qps=self.online_qps,
             watchdog_deadline=self.watchdog_deadline or None,
             max_restarts=self.max_restarts,
@@ -843,11 +869,15 @@ class LearnTask:
             max_queue=self.serve_max_queue, max_wait=self.serve_max_wait,
             # bulk drive: throughput-bound, not latency-bound (the same
             # reasoning as the predict drive's bulk_deadline)
-            deadline=max(self.serve_deadline, 60.0))
+            deadline=max(self.serve_deadline, 60.0),
+            dtype=self.serve_dtype, flash_decode=self.serve_flash)
         if not self.silent:
             print(f'serve: decode engine up — {self.serve_slots} slots, '
                   f'{self.serve_pages}x{self.serve_page_size}-token KV '
-                  f'pages (slot cache {svc.engine.cache_len})', flush=True)
+                  f'pages (slot cache {svc.engine.cache_len}, '
+                  f'dtype={svc.engine.serve_dtype}, '
+                  f'attention={"flash" if svc.engine.use_flash else "gather"}'
+                  f')', flush=True)
         print('start serving (decode)...')
         rng = np.random.RandomState(self.serve_seed)
         n_req = max(1, self.serve_requests)
@@ -871,11 +901,15 @@ class LearnTask:
                     fo.write(' '.join(str(int(t)) for t in toks) + '\n')
                     served += 1
             # bitwise-twin spot check: the stream each request got must
-            # equal its offline generate call (same seed/schedule)
+            # equal its offline generate call (same seed/schedule) —
+            # over the ENGINE's stored tree and compute config, so the
+            # twin holds on every serve.dtype tier (a quantized model's
+            # oracle is generate() over the same quantized tree)
             checked = 0
             for i in range(min(3, n_req)):
                 off = np.asarray(TT.generate(
-                    params, prompts[i], self.serve_max_new, cfg,
+                    svc.engine.params, prompts[i], self.serve_max_new,
+                    svc.engine.cfg,
                     temperature=temp, rng=keys[i],
                     eos_id=None if self.serve_eos < 0
                     else self.serve_eos))[0]
@@ -917,7 +951,8 @@ class LearnTask:
                     raise FileNotFoundError(f'no model files in {mdir}')
                 tr = load_into_trainer(self._create_net(), best[1])
                 return PredictEngine(tr,
-                                     parse_buckets(self.serve_buckets))
+                                     parse_buckets(self.serve_buckets),
+                                     dtype=self.serve_dtype)
             return factory
 
         for mid, mdir in parse_kv_list(self.serve_models):

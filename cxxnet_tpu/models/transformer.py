@@ -39,6 +39,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..nnet.quantize import qdot, qtake
 from ..parallel.moe import moe_ffn_local
 from ..parallel.pipeline import pipeline_stage_loop, split_microbatches
 from ..parallel.sequence import _local_attention, _ring_attention_local
@@ -370,11 +371,15 @@ def _stage_attn(p, h, cfg: TransformerConfig, mask):
     mb, s, d = h.shape
     hd = d // cfg.num_heads
     y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
-    q = (y @ p['wq']).reshape(mb, s, cfg.num_heads, hd)
-    k = (y @ p['wk']).reshape(mb, s, cfg.num_heads, hd)
-    v = (y @ p['wv']).reshape(mb, s, cfg.num_heads, hd)
+    # matmuls route through the quantized-leaf dispatcher: a plain
+    # array takes the native ``x @ w`` (bitwise-identical — training and
+    # reference paths are untouched); an int8 QuantLeaf (serve.dtype,
+    # nnet/quantize.py) runs the W8A8 leg
+    q = qdot(y, p['wq']).reshape(mb, s, cfg.num_heads, hd)
+    k = qdot(y, p['wk']).reshape(mb, s, cfg.num_heads, hd)
+    v = qdot(y, p['wv']).reshape(mb, s, cfg.num_heads, hd)
     attn = _local_attention(q, k, v, 1.0 / math.sqrt(hd), mask)
-    h = h + attn.reshape(mb, s, d) @ p['wo']
+    h = h + qdot(attn.reshape(mb, s, d), p['wo'])
     y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
     return h, y2, k, v
 
@@ -392,7 +397,8 @@ def _nodrop_moe_ffn(y2, p, gather: bool):
     token.  ``gather=False`` uses a one-hot dispatch einsum (no weight
     duplication, E-way activation buffer like ``moe_ffn_local``) —
     right for the prefill's b*s0 tokens."""
-    probs = jax.nn.softmax((y2 @ p['gate']).astype(jnp.float32), axis=-1)
+    probs = jax.nn.softmax(qdot(y2, p['gate']).astype(jnp.float32),
+                           axis=-1)
     ex = jnp.argmax(probs, axis=-1)                        # (n,)
     pg = jnp.take_along_axis(probs, ex[:, None], axis=-1)  # (n, 1)
     if gather:
@@ -532,7 +538,7 @@ def _gen_ffn(cfg: TransformerConfig, p, y2, gather: bool):
     if cfg.num_experts:
         return _nodrop_moe_ffn(y2.reshape(mb * s, d), p,
                                gather).reshape(mb, s, d)
-    return jax.nn.relu(y2 @ p['w1']) @ p['w2']
+    return qdot(jax.nn.relu(qdot(y2, p['w1'])), p['w2'])
 
 
 def prefill_kv(params, prompt, w, cfg: TransformerConfig):
@@ -548,7 +554,7 @@ def prefill_kv(params, prompt, w, cfg: TransformerConfig):
     cache rows for positions [0, s0), logits0 (b, vocab) float32 for the
     last position (the first generated token's distribution)."""
     b, s0 = prompt.shape
-    h = jnp.take(params['embed'], prompt, axis=0)
+    h = qtake(params['embed'], prompt)
     # causal over the real tokens only: the first ``w`` slots are
     # bucket padding (generate() left-pads), excluded from every
     # real query.  Each PAD query attends just its own slot — an
@@ -566,8 +572,32 @@ def prefill_kv(params, prompt, w, cfg: TransformerConfig):
         ks.append(k)
         vs.append(v)
         h = h + _gen_ffn(cfg, p, y2, gather=False)
-    logits0 = (h[:, -1] @ params['head']).astype(jnp.float32)
+    logits0 = qdot(h[:, -1], params['head']).astype(jnp.float32)
     return jnp.stack(ks), jnp.stack(vs), logits0
+
+
+def _decode_token(params, cfg: TransformerConfig, tok, attend):
+    """THE per-token block walk — embed -> [ln1 -> qkv -> attend -> out
+    proj -> ln2 -> ffn] per stage -> head.  ``attend(i, p, q, k, v)``
+    supplies stage ``i``'s cache write + attention ((b, 1, heads, hd) in
+    and out); :func:`decode_step` (dense cache) and
+    :func:`decode_step_paged` (page pool + flash kernel) are both thin
+    attend-closures over this one body, so the cache layouts cannot
+    drift from each other or from the shared projection math."""
+    b = tok.shape[0]
+    hd = cfg.d_model // cfg.num_heads
+    h = qtake(params['embed'], tok[:, None])
+    for i in range(cfg.num_stages):
+        p = jax.tree.map(lambda a, i=i: a[i], params['stages'])
+        y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
+        q = qdot(y, p['wq']).reshape(b, 1, cfg.num_heads, hd)
+        k = qdot(y, p['wk']).reshape(b, 1, cfg.num_heads, hd)
+        v = qdot(y, p['wv']).reshape(b, 1, cfg.num_heads, hd)
+        attn = attend(i, p, q, k, v)
+        h = h + qdot(attn.reshape(b, 1, cfg.d_model), p['wo'])
+        y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
+        h = h + _gen_ffn(cfg, p, y2, gather=True)
+    return qdot(h[:, -1], params['head']).astype(jnp.float32)
 
 
 def decode_step(params, cfg: TransformerConfig, tok, kc, vc, t, w):
@@ -603,14 +633,11 @@ def decode_step(params, cfg: TransformerConfig, tok, kc, vc, t, w):
     else:
         # cache slots [0, w) hold bucket-pad K/V: never attended
         live = ((ar <= t) & (ar >= w))[None, None, None, :]
-    h = jnp.take(params['embed'], tok[:, None], axis=0)
+    state = {'kc': kc, 'vc': vc}
     knews, vnews = [], []
-    for i in range(cfg.num_stages):
-        p = jax.tree.map(lambda a, i=i: a[i], params['stages'])
-        y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
-        q = (y @ p['wq']).reshape(b, 1, cfg.num_heads, hd)
-        k = (y @ p['wk']).reshape(b, 1, cfg.num_heads, hd)
-        v = (y @ p['wv']).reshape(b, 1, cfg.num_heads, hd)
+
+    def attend(i, p, q, k, v):
+        kc, vc = state['kc'], state['vc']
         if per_row:
             kc = kc.at[i, jnp.arange(b), t].set(k[:, 0])
             vc = vc.at[i, jnp.arange(b), t].set(v[:, 0])
@@ -619,21 +646,54 @@ def decode_step(params, cfg: TransformerConfig, tok, kc, vc, t, w):
                 kc, k[None], (i, 0, t, 0, 0))
             vc = jax.lax.dynamic_update_slice(
                 vc, v[None], (i, 0, t, 0, 0))
+        state['kc'], state['vc'] = kc, vc
         ki, vi = kc[i], vc[i]
         # (b, heads, 1, total) scores over the cache
         s_ = jnp.einsum('bqhd,bkhd->bhqk', q, ki) * scale
         s_ = jnp.where(live, s_, -jnp.inf)
-        attn = jnp.einsum(
+        knews.append(k[:, 0])
+        vnews.append(v[:, 0])
+        return jnp.einsum(
             'bhqk,bkhd->bqhd',
             jax.nn.softmax(s_.astype(jnp.float32),
                            axis=-1).astype(ki.dtype), vi)
-        h = h + attn.reshape(b, 1, cfg.d_model) @ p['wo']
-        y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
-        h = h + _gen_ffn(cfg, p, y2, gather=True)
-        knews.append(k[:, 0])
-        vnews.append(v[:, 0])
-    logits = (h[:, -1] @ params['head']).astype(jnp.float32)
-    return logits, kc, vc, jnp.stack(knews), jnp.stack(vnews)
+
+    logits = _decode_token(params, cfg, tok, attend)
+    return (logits, state['kc'], state['vc'], jnp.stack(knews),
+            jnp.stack(vnews))
+
+
+def decode_step_paged(params, cfg: TransformerConfig, tok, kpool, vpool,
+                      table, t, w):
+    """One decode step straight over the PAGED pool — the flash twin of
+    :func:`decode_step` (``serve.flash_decode``, doc/serving.md "Flash
+    paged decode").  Instead of gathering every slot's pages into a
+    dense cache, each stage scatters the new K/V row into its physical
+    page and hands attention to ``ops.pallas_kernels.paged_flash_decode``,
+    which reads the pages in place via the page table.  ``t``/``w`` are
+    (b,) per-slot vectors (this is an engine-only entry; ``generate``
+    keeps the dense scan).  Returns ``(logits, kpool, vpool)`` — the new
+    rows are already in the pool, so there is no knew/vnew leg.
+    Bitwise-equal to gather + :func:`decode_step` by construction of the
+    kernel's final softmax (pinned in tests/test_serve_decode.py)."""
+    from ..ops.pallas_kernels import paged_flash_decode
+    b = tok.shape[0]
+    ps = kpool.shape[2]
+    hd = cfg.d_model // cfg.num_heads
+    scale = 1.0 / math.sqrt(hd)
+    page = table[jnp.arange(b), t // ps]
+    off = t % ps
+    state = {'k': kpool, 'v': vpool}
+
+    def attend(i, p, q, k, v):
+        kp = state['k'].at[i, page, off].set(k[:, 0])
+        vp = state['v'].at[i, page, off].set(v[:, 0])
+        state['k'], state['v'] = kp, vp
+        return paged_flash_decode(q[:, 0], kp[i], vp[i], table, t, w,
+                                  scale)[:, None]
+
+    logits = _decode_token(params, cfg, tok, attend)
+    return logits, state['k'], state['v']
 
 
 def _build_generate(cfg: TransformerConfig, b: int, s0: int,

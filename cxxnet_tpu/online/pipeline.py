@@ -73,6 +73,7 @@ class OnlineConfig:
     max_queue: int = 64
     max_wait: float = 0.002
     deadline: float = 1.0
+    dtype: str = 'f32'             # serve.dtype quantized-inference tier
     qps: float = 50.0              # built-in traffic driver rate
     # supervisor knobs (same semantics as train.* keys)
     watchdog_deadline: Optional[float] = 60.0
@@ -216,7 +217,8 @@ class OnlinePipeline:
         boot = self._publish_model(counter, sync=True)
         serve_tr = load_into_trainer(self.serve_factory(), boot,
                                      retry=cfg.retry)
-        self.engine = PredictEngine(serve_tr, cfg.buckets)
+        self.engine = PredictEngine(serve_tr, cfg.buckets,
+                                    dtype=cfg.dtype)
         self.engine.version = counter
         self.engine.on_serve = self.tracker.note_served
         self.engine.warm()

@@ -162,6 +162,34 @@ def lrn_auto_mode(c: int, spmd_devices: int = 1) -> str:
     return 'xla'
 
 
+def decode_use_flash(explicit=None) -> bool:
+    """Whether the serve decode step should take the paged flash-decode
+    kernel (:func:`paged_flash_decode`) instead of the gather-then-dense
+    path.  ``explicit`` is the ``serve.flash_decode`` key: ``1``/``0``
+    force it on/off, ``'auto'``/None defer to the tri-state
+    ``pallas_mode()`` gate — ``'on'`` forces the kernel everywhere
+    (interpret mode included: that is the CPU validation path), ``'off'``
+    disables it, ``'auto'`` engages only on a real TPU, where reading
+    pages in place actually saves the per-step dense-cache
+    materialization HBM round-trip.  Always False when the TPU memory
+    spaces are unimportable (the kernel needs VMEM scratch)."""
+    if pltpu is None:
+        return False
+    if explicit is not None:
+        text = str(explicit).strip().lower()
+        if text in ('1', 'true', 'yes', 'on'):
+            return True
+        if text in ('0', 'false', 'no', 'off'):
+            return False
+        # anything else ('auto', '') falls through to the global gate
+    mode = pallas_mode()
+    if mode == 'on':
+        return True
+    if mode == 'off':
+        return False
+    return not _interpret()
+
+
 def _interpret() -> bool:
     return jax.default_backend() != 'tpu'
 
@@ -862,3 +890,153 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     out = _flash_bhsd(to_bhsd(q, sq), to_bhsd(k, sk), to_bhsd(v, sk),
                       causal, block_q, block_k)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+# --- paged flash-decode attention (serve/decode.py) ------------------------
+#
+# The decode engine's step used to GATHER every slot's KV pages into a
+# dense (S, T, H, hd) cache in HBM on every token (kpool[:, table] — a
+# full-pool materialization per step per stage).  This kernel reads each
+# slot's pages IN PLACE: the page table is a scalar-prefetch operand, so
+# the (slot, logical-page) grid cell's BlockSpec index map resolves the
+# PHYSICAL page to DMA — HBM traffic per step is exactly the slot's live
+# pages, once.  Per-slot positions (``pos``) and left-pad widths (``w``)
+# drive the same live mask as ``transformer.decode_step``; the final
+# masked softmax + weighted sum mirror the dense ops EXACTLY (same
+# einsum shapes, same f32 cast points), which is what makes the kernel
+# bitwise-equal to the gather-then-dense twin — pinned by
+# tests/test_serve_decode.py on the CPU ``interpret=True`` path.
+
+def _paged_decode_kernel(table_ref, pos_ref, w_ref, q_ref, k_ref, v_ref,
+                         o_ref, s_scr, v_scr, *, scale, ps, pp):
+    """Grid (slots, pages_per_slot): page j of slot s is DMA'd from the
+    physical page ``table[s, j]``; its scores land in the score scratch
+    (an exact per-page slice write — no cross-page reduction), its V rows
+    in the V scratch.  The last page step applies the live mask and runs
+    the one full-width softmax + value contraction."""
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    q = q_ref[0]                                   # (H, hd)
+    # per-page scores: same per-element hd-length dots as the dense
+    # einsum 'bqhd,bkhd->bhqk' — slice writes are exact, so assembling
+    # the (H, T) score row page-by-page loses nothing
+    s_scr[:, pl.ds(j * ps, ps)] = jnp.einsum('hd,khd->hk', q, k_ref[0])
+    v_scr[pl.ds(j * ps, ps)] = v_ref[0]
+
+    @pl.when(j == pp - 1)
+    def _finalize():
+        t = pos_ref[s]
+        wv = w_ref[s]
+        ar = jax.lax.broadcasted_iota(jnp.int32, (1, pp * ps), 1)
+        live = (ar <= t) & (ar >= wv)              # (1, T)
+        sc = s_scr[:] * scale
+        sc = jnp.where(live, sc, -jnp.inf)
+        p = jax.nn.softmax(sc.astype(jnp.float32), axis=-1
+                           ).astype(v_scr.dtype)
+        # keep the singleton q axis: 'hqk,khd->qhd' lowers to the same
+        # contraction as the dense 'bhqk,bkhd->bqhd' (dropping it pads
+        # the result a ulp apart on CPU — measured, not assumed)
+        o_ref[0] = jnp.einsum('hqk,khd->qhd', p[:, None], v_scr[:])[0]
+
+
+def paged_flash_decode(q, kpool, vpool, table, pos, w, scale):
+    """One decode step's attention for every slot, over the paged pool.
+
+    ``q``: (S, H, hd) — each slot's single-token query.  ``kpool`` /
+    ``vpool``: (P, ps, H, hd) — ONE stage's physical page pool (the
+    current token's K/V must already be scattered in at ``pos``).
+    ``table``: (S, pp) int32 page table (physical page 0 = scratch: its
+    rows are masked dead by ``pos``/``w``).  ``pos``/``w``: (S,) int32
+    per-slot write position and left-pad width.  Returns (S, H, hd)
+    attention outputs, bitwise-equal to gathering ``kpool[table]`` into
+    a dense cache and running ``transformer.decode_step``'s attention.
+    """
+    S, H, hd = q.shape
+    P, ps = kpool.shape[0], kpool.shape[1]
+    pp = table.shape[1]
+    if pltpu is None:          # pragma: no cover - exotic installs only
+        raise RuntimeError(
+            'paged_flash_decode needs TPU memory spaces '
+            '(jax.experimental.pallas.tpu unavailable); gate callers on '
+            'decode_use_flash()')
+    kernel = functools.partial(_paged_decode_kernel, scale=scale, ps=ps,
+                               pp=pp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, pp),
+        in_specs=[
+            pl.BlockSpec((1, H, hd), lambda s, j, tr, pr, wr: (s, 0, 0)),
+            pl.BlockSpec((1, ps, H, hd),
+                         lambda s, j, tr, pr, wr: (tr[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, ps, H, hd),
+                         lambda s, j, tr, pr, wr: (tr[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, hd),
+                               lambda s, j, tr, pr, wr: (s, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((H, pp * ps), q.dtype),
+                        pltpu.VMEM((pp * ps, H, hd), vpool.dtype)],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((S, H, hd), vpool.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+        **_compiler_params('parallel', 'arbitrary'),
+    )(table, pos, w, q, kpool, vpool)
+
+
+# --- int8 matmul (quantized inference tier, nnet/quantize.py) --------------
+
+def _int8_matmul_kernel(a_ref, b_ref, o_ref, acc_ref):
+    """``pallas_matmul``'s K-innermost tiling with int8 MXU inputs and an
+    exact int32 accumulator (integer adds reassociate freely, so the
+    K-split accumulation is bitwise-equal to the XLA fallback's one-shot
+    dot — the scale application to f32 happens outside)."""
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(a_ref[:], b_ref[:],
+                          preferred_element_type=jnp.int32)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[:] = acc_ref[:]
+
+
+def pallas_int8_matmul(a, b, tile_m: int = 256, tile_n: int = 256,
+                       tile_k: int = 512):
+    """(m, k) int8 @ (k, n) int8 -> (m, n) int32, MXU-tiled.
+
+    The quantized-inference matmul leg (doc/serving.md "Quantized
+    inference"): int8 operand tiles feed the MXU, the accumulator is
+    exact int32, and the caller applies the (row-scale x col-scale) f32
+    rescale.  Bitwise-equal to ``lax.dot_general`` on the same int8
+    operands (integer accumulation has no rounding), so the
+    Pallas-vs-XLA twin is exact, not a tolerance."""
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2
+    if pltpu is None:                    # exotic CPU-only installs
+        return jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    tile_m = _clamp_tile(tile_m, m)
+    tile_n = _clamp_tile(tile_n, n)
+    tile_k = _clamp_tile(tile_k, k)
+    pm, pn, pk = (-m) % tile_m, (-n) % tile_n, (-k) % tile_k
+    ap = jnp.pad(a, ((0, pm), (0, pk))) if pm or pk else a
+    bp = jnp.pad(b, ((0, pk), (0, pn))) if pk or pn else b
+    mm, nn, kk = ap.shape[0], bp.shape[1], ap.shape[1]
+    out = pl.pallas_call(
+        _int8_matmul_kernel,
+        out_shape=jax.ShapeDtypeStruct((mm, nn), jnp.int32),
+        grid=(mm // tile_m, nn // tile_n, kk // tile_k),
+        in_specs=[_block_spec((tile_m, tile_k), lambda i, j, t: (i, t)),
+                  _block_spec((tile_k, tile_n), lambda i, j, t: (t, j))],
+        out_specs=_block_spec((tile_m, tile_n), lambda i, j, t: (i, j)),
+        scratch_shapes=[_scratch((tile_m, tile_n), jnp.int32)],
+        interpret=_interpret(),
+        **_compiler_params('parallel', 'parallel', 'arbitrary'),
+    )(ap, bp)
+    return out[:m, :n]

@@ -30,15 +30,24 @@ program that requests join and leave at token boundaries:
   ``transformer.generate`` call with the same seed, no matter when it
   joined the running loop or who shared its steps.
 
-The attention itself gathers each slot's pages into a dense (T, heads,
-hd) view per step — the page pool is the memory *ledger*; a fused
-flash-decode kernel that reads pages in place is the planned Pallas
-tier (ROADMAP item 4).
+The attention itself has two legs behind ``serve.flash_decode``
+(doc/serving.md "Flash paged decode"): the gather path materializes each
+slot's pages into a dense (T, heads, hd) view per step, while the Pallas
+**paged flash-decode kernel** (``ops.pallas_kernels.paged_flash_decode``)
+reads the pages in place via the page table — bitwise-equal outputs,
+pinned by twin tests on the CPU ``interpret=True`` path.  ``dtype``
+selects the quantized-inference tier (``serve.dtype``, doc/serving.md
+"Quantized inference"): ``bf16`` casts params/pool/compute to bfloat16,
+``int8`` additionally stores matmul weights as per-channel int8
+(``nnet/quantize.py``) consumed through the W8A8 ``qdot`` leg — either
+way the stream still has an EXACT offline twin (``transformer.generate``
+over the engine's own stored tree + compute config).
 """
 
 from __future__ import annotations
 
 import collections
+import dataclasses
 import os
 import threading
 import time
@@ -49,6 +58,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import transformer as T
+from ..nnet import quantize
+from ..ops import pallas_kernels as PK
 from ..runtime.faults import (DeadlineExceededError, DecodePagesExhaustedError,
                               DecodeSlotsExhaustedError, ServeError,
                               TokenDeadlineExceededError)
@@ -94,6 +105,17 @@ class DecodeEngine:
     (page-aligned).  ``eos_id`` is engine-wide (it is baked into the
     compiled step, exactly as ``generate`` bakes it per program).
 
+    ``dtype`` (``serve.dtype``) selects the quantized serving tier:
+    ``bf16``/``int8`` replace the compute config's dtype with bfloat16
+    (params, KV pool and block math follow), int8 additionally storing
+    matmul weights per-channel quantized (``nnet/quantize.py``) —
+    either way :attr:`params`/:attr:`cfg` remain the stream oracle:
+    ``transformer.generate(engine.params, ..., engine.cfg)`` is
+    bitwise-equal to the engine's streams on every tier.
+    ``flash_decode`` (``serve.flash_decode``) picks the attention leg:
+    ``1``/``0`` force the Pallas paged flash-decode kernel / the dense
+    gather; ``'auto'``/None defer to ``pallas_mode()``.
+
     Requests arrive through :meth:`execute_requests` (the
     ``DynamicBatcher`` hands over each coalesced batch — the engine owns
     completion) or :meth:`submit_direct`.  Per request ``meta``:
@@ -108,12 +130,22 @@ class DecodeEngine:
     def __init__(self, params, cfg, *, slots: int = 4, pages: int = 64,
                  page_size: int = 16, max_prompt: int = 64,
                  max_new_bound: int = 64, eos_id: Optional[int] = None,
-                 stats: Optional[StatSet] = None, name: str = 'lm'):
+                 stats: Optional[StatSet] = None, name: str = 'lm',
+                 dtype: str = 'f32', flash_decode=None):
         if not cfg.causal:
             raise ValueError('DecodeEngine requires a causal config')
         if slots < 1 or pages < 2 or page_size < 1:
             raise ValueError('need slots >= 1, pages >= 2 (page 0 is '
                              'scratch), page_size >= 1')
+        # quantized tier (serve.dtype): bf16/int8 serve with a bfloat16
+        # compute config — params, KV pool and block math all follow
+        # cfg.dtype, so the offline twin is generate(engine.params,
+        # engine.cfg) for EVERY tier
+        self.serve_dtype = quantize.parse_serve_dtype(dtype)
+        if self.serve_dtype != 'f32':
+            cfg = dataclasses.replace(cfg, dtype=jnp.bfloat16)
+        # serve.flash_decode tri-state over the global pallas_mode() gate
+        self.use_flash = PK.decode_use_flash(flash_decode)
         self.cfg = cfg
         self.name = name
         self.slots = int(slots)
@@ -144,10 +176,14 @@ class DecodeEngine:
         self._admitting = 0   # guarded-by: _cond (admit..join window)
         self._join_seq = 0    # guarded-by: _cond
         self._closed = False  # guarded-by: _cond
+        # the ORIGINAL (pre-quantization) structure is the hot-swap
+        # contract: .lm files always carry the f32 tree, place_params
+        # validates against it and re-quantizes into the serving tier
+        self._ref_treedef = jax.tree.structure(params)
+        self._ref_shapes = [(tuple(l.shape), l.dtype)
+                            for l in jax.tree.leaves(params)]
         self._params = self.place_params(params)  # guarded-by: _cond
         self._params_treedef = jax.tree.structure(self._params)
-        self._params_shapes = [(tuple(l.shape), l.dtype)
-                               for l in jax.tree.leaves(self._params)]
         self._pending_params = None   # guarded-by: _cond
         self._pending_version = None  # guarded-by: _cond
         self.version: object = 0
@@ -172,11 +208,36 @@ class DecodeEngine:
         return jnp.where(temp > 0, sampled,
                          jnp.argmax(logits, axis=-1)).astype(jnp.int32)
 
+    @staticmethod
+    def _pick_slots(logits, r, temp):
+        """Per-slot pick: per-slot keys, per-slot draws — bitwise the
+        same stream the offline b=1 generate pulls from the same key
+        schedule."""
+        greedy = jnp.argmax(logits, axis=-1)
+        safe = jnp.where(temp > 0, temp, jnp.float32(1.0))
+        sampled = jax.vmap(
+            lambda k_, lg, t_: jax.random.categorical(
+                k_, lg / t_, axis=-1))(r, logits, safe)
+        return jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
+
     def _build_step(self):
         cfg = self.cfg
         S, ps, pp = self.slots, self.page_size, self.pages_per_slot
         Tlen = self.cache_len
         hd = cfg.d_model // cfg.num_heads
+
+        if self.use_flash:
+            def step(params, kpool, vpool, table, pos, w, tok, r, temp):
+                # flash leg: K/V rows scatter into their physical pages
+                # and the Pallas kernel reads them in place — no dense
+                # cache is ever materialized (bitwise-equal to the
+                # gather leg below; twin test pins it)
+                logits, kpool, vpool = T.decode_step_paged(
+                    params, cfg, tok, kpool, vpool, table, pos, w)
+                nxt = self._pick_slots(logits, r, temp)
+                return kpool, vpool, nxt
+
+            return jax.jit(step, donate_argnums=(1, 2))
 
         def step(params, kpool, vpool, table, pos, w, tok, r, temp):
             # gather each slot's pages into the dense cache layout the
@@ -193,14 +254,7 @@ class DecodeEngine:
             si = jnp.arange(st)[:, None]
             kpool = kpool.at[si, page[None, :], off[None, :]].set(knew)
             vpool = vpool.at[si, page[None, :], off[None, :]].set(vnew)
-            greedy = jnp.argmax(logits, axis=-1)
-            safe = jnp.where(temp > 0, temp, jnp.float32(1.0))
-            # per-slot keys, per-slot draws: bitwise the same stream the
-            # offline b=1 generate pulls from the same key schedule
-            sampled = jax.vmap(
-                lambda k_, lg, t_: jax.random.categorical(
-                    k_, lg / t_, axis=-1))(r, logits, safe)
-            nxt = jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
+            nxt = self._pick_slots(logits, r, temp)
             return kpool, vpool, nxt
 
         return jax.jit(step, donate_argnums=(1, 2))
@@ -251,20 +305,42 @@ class DecodeEngine:
             return self._params
 
     def _check_tree(self, params) -> None:
-        if jax.tree.structure(params) != self._params_treedef:
+        if jax.tree.structure(params) != self._ref_treedef:
             raise ValueError('swap_params: param tree structure differs '
                              'from the serving model')
+        # dtype is part of the contract only on the f32 tier — the
+        # quantized tiers normalize every incoming float dtype anyway
+        strict = self.serve_dtype == 'f32'
         for leaf, (shape, dtype) in zip(jax.tree.leaves(params),
-                                        self._params_shapes):
-            if tuple(leaf.shape) != shape or leaf.dtype != dtype:
+                                        self._ref_shapes):
+            if tuple(leaf.shape) != shape or \
+                    (strict and leaf.dtype != dtype):
                 raise ValueError(
                     f'swap_params: leaf {tuple(leaf.shape)}/{leaf.dtype} '
                     f'!= serving {shape}/{dtype} — a shape change needs '
                     'a new engine, not a hot swap')
 
+    def _quantize(self, host_tree):
+        """Load/swap-time quantization into the serving tier — the hot
+        path never re-quantizes weights (doc/serving.md)."""
+        if self.serve_dtype == 'f32':
+            return host_tree
+        return quantize.quantize_tree(host_tree, self.serve_dtype,
+                                      out_dtype=self.cfg.dtype,
+                                      quant_key=quantize.lm_quant_key)
+
     def place_params(self, host_params):
-        if getattr(self, '_params_treedef', None) is not None:
-            self._check_tree(host_params)
+        # this method's own output (the registry's warm->swap sequence
+        # re-passes it) short-circuits the validate+quantize: an int8
+        # tree is structurally distinct, a bf16 one re-casts to itself
+        already = (getattr(self, '_params_treedef', None) is not None
+                   and self._params_treedef != self._ref_treedef
+                   and jax.tree.structure(host_params)
+                   == self._params_treedef)
+        if not already:
+            if getattr(self, '_ref_treedef', None) is not None:
+                self._check_tree(host_params)
+            host_params = self._quantize(host_params)
         return jax.tree.map(
             lambda h: h if isinstance(h, jax.Array)
             else jax.device_put(np.asarray(h)), host_params)
@@ -709,13 +785,15 @@ class DecodeService:
                  page_size: int = 16, max_prompt: int = 64,
                  max_new_bound: int = 64, eos_id: Optional[int] = None,
                  max_queue: int = 64, max_wait: float = 0.002,
-                 deadline: float = 30.0):
+                 deadline: float = 30.0, dtype: str = 'f32',
+                 flash_decode=None):
         from .batcher import DynamicBatcher
         stats = StatSet()
         self.engine = DecodeEngine(
             params, cfg, slots=slots, pages=pages, page_size=page_size,
             max_prompt=max_prompt, max_new_bound=max_new_bound,
-            eos_id=eos_id, stats=stats)
+            eos_id=eos_id, stats=stats, dtype=dtype,
+            flash_decode=flash_decode)
         self.batcher = DynamicBatcher(self.engine, max_queue=max_queue,
                                       max_wait=max_wait, deadline=deadline,
                                       stats=stats)

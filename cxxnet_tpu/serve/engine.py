@@ -30,7 +30,10 @@ from typing import List, Sequence, Tuple
 import jax
 import numpy as np
 
+import jax.numpy as jnp
+
 from ..layers import ForwardContext
+from ..nnet import quantize
 from ..nnet.trainer import NetTrainer
 from ..parallel.mesh import batch_sharding
 from ..utils.bucketing import DEFAULT_BUCKETS, chunk_plan, pad_rows
@@ -61,10 +64,17 @@ class PredictEngine:
     """
 
     def __init__(self, trainer: NetTrainer,
-                 buckets: Sequence[int] = DEFAULT_BUCKETS):
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 dtype: str = 'f32'):
         if trainer.net is None or trainer.params is None:
             raise ValueError('PredictEngine needs an initialized trainer '
                              '(init_model()/load_model() first)')
+        # quantized-inference storage tier (serve.dtype, doc/serving.md
+        # "Quantized inference"): bf16 halves / int8 roughly quarters the
+        # RESIDENT param bytes; the compiled forward expands weights to
+        # f32 per call (weight-only — transient copies are freed between
+        # requests, so the budgeter's ledger stays the quantized size)
+        self.serve_dtype = quantize.parse_serve_dtype(dtype)
         self.trainer = trainer
         self.buckets: Tuple[int, ...] = tuple(sorted(set(int(b)
                                                          for b in buckets)))
@@ -86,10 +96,19 @@ class PredictEngine:
         # (online/freshness.py)
         self.on_serve = None
         self._inflight = 0      # forwards mid-execution (budgeter: busy())
-        self._params = trainer.params
+        # the ORIGINAL f32 structure is the hot-swap contract (model
+        # files carry f32 trees); _params holds the serving-tier storage
+        self._ref_treedef = jax.tree.structure(trainer.params)
+        self._ref_shapes = [(l.shape, l.dtype)
+                            for l in jax.tree.leaves(trainer.params)]
+        if self.serve_dtype == 'f32':
+            self._params = trainer.params
+        else:
+            self._params = jax.tree.map(
+                lambda h: h if isinstance(h, jax.Array)
+                else jax.device_put(np.asarray(h)),
+                quantize.quantize_tree(trainer.params, self.serve_dtype))
         self._params_treedef = jax.tree.structure(self._params)
-        self._params_shapes = [(l.shape, l.dtype)
-                               for l in jax.tree.leaves(self._params)]
         self._lock = threading.Lock()
         self._fwd = self._build_forward()
 
@@ -101,6 +120,7 @@ class PredictEngine:
         compute_dtype = tr.compute_dtype
         max_round = tr.max_round
         spmd = tr._mesh.devices.size
+        quantized = self.serve_dtype != 'f32'
         engine = self
 
         @jax.jit
@@ -109,6 +129,11 @@ class PredictEngine:
             # compilation (per distinct data shape) and never inside the
             # compiled program — the compile-cache bound is asserted on it
             engine.compile_count += 1
+            if quantized:
+                # weight-only expansion: int8/bf16 storage -> f32 math;
+                # XLA frees the expanded copies after the forward, so
+                # only the quantized tree stays resident
+                params = quantize.dequantize_tree(params, jnp.float32)
             ctx = ForwardContext(is_train=False, rng=None, round=0,
                                  max_round=max_round,
                                  compute_dtype=compute_dtype,
@@ -124,20 +149,36 @@ class PredictEngine:
         return self._params
 
     def _check_tree(self, params) -> None:
-        if jax.tree.structure(params) != self._params_treedef:
+        if jax.tree.structure(params) != self._ref_treedef:
             raise ValueError('swap_params: param tree structure differs '
                              'from the serving model')
+        # dtype is part of the contract only on the f32 tier — the
+        # quantized tiers normalize every incoming float dtype anyway
+        strict = self.serve_dtype == 'f32'
         for leaf, (shape, dtype) in zip(jax.tree.leaves(params),
-                                        self._params_shapes):
-            if tuple(leaf.shape) != tuple(shape) or leaf.dtype != dtype:
+                                        self._ref_shapes):
+            if tuple(leaf.shape) != tuple(shape) or \
+                    (strict and leaf.dtype != dtype):
                 raise ValueError(
                     f'swap_params: leaf {tuple(leaf.shape)}/{leaf.dtype} '
                     f'!= serving {tuple(shape)}/{dtype} — a shape change '
                     'needs a new engine, not a hot swap')
 
     def place_params(self, host_params):
-        """Device-put a host param tree with the serving params'
-        shardings (structure/shape/dtype validated first)."""
+        """Quantize (serve.dtype tier) + device-put a host param tree
+        with the serving params' shardings (structure/shape validated
+        against the ORIGINAL f32 contract first).  This method's own
+        output (the registry re-passes it through warm->swap)
+        short-circuits the validate+quantize."""
+        if self.serve_dtype != 'f32':
+            if jax.tree.structure(host_params) != self._params_treedef \
+                    or self._params_treedef == self._ref_treedef:
+                self._check_tree(host_params)
+                host_params = quantize.quantize_tree(host_params,
+                                                     self.serve_dtype)
+            return jax.tree.map(
+                lambda h: h if isinstance(h, jax.Array)
+                else jax.device_put(np.asarray(h)), host_params)
         self._check_tree(host_params)
         if self._is_placed(host_params):
             return host_params   # already ours: skip the device round

@@ -200,7 +200,7 @@ class Net:
     def serve_start(self, buckets='1,8,32', max_queue: int = 64,
                     max_wait: float = 0.002, deadline: float = 1.0,
                     warm: bool = True, models=None,
-                    mem_budget: int = 0) -> None:
+                    mem_budget: int = 0, dtype: str = 'f32') -> None:
         """Stand up the serving stack over this net's loaded params: a
         bucketed ``PredictEngine`` plus a ``DynamicBatcher``.  Call once;
         ``serve_stop()`` tears down (and must precede a restart).
@@ -209,7 +209,11 @@ class Net:
         sibling checkpoints (same architecture as this net) served
         through a ``MultiModelRegistry`` under ``mem_budget`` bytes —
         route to one with ``serve_scores(..., model=id)``; cold models
-        load on demand and evict coldest-first under pressure."""
+        load on demand and evict coldest-first under pressure.
+        ``dtype`` selects the quantized-inference storage tier
+        (``f32``/``bf16``/``int8`` — doc/serving.md "Quantized
+        inference"); it applies to this engine AND every fleet sibling,
+        so the ``mem_budget`` ledger fits ~4x more int8 models."""
         from .serve import DynamicBatcher, PredictEngine
         from .utils.bucketing import parse_buckets
         if self._batcher is not None:
@@ -217,7 +221,7 @@ class Net:
         tr = self._require()
         bks = parse_buckets(buckets) if isinstance(buckets, str) \
             else tuple(buckets)
-        self._engine = PredictEngine(tr, bks)
+        self._engine = PredictEngine(tr, bks, dtype=dtype)
         if warm:
             self._engine.warm()
         self._batcher = DynamicBatcher(self._engine, max_queue=max_queue,
@@ -228,9 +232,10 @@ class Net:
             self._fleet = MultiModelRegistry(mem_budget=mem_budget)
             for mid, mdir in dict(models).items():
                 self._fleet.add_model(
-                    mid, self._fleet_factory(mdir, bks), model_dir=mdir)
+                    mid, self._fleet_factory(mdir, bks, dtype),
+                    model_dir=mdir)
 
-    def _fleet_factory(self, model_dir: str, buckets):
+    def _fleet_factory(self, model_dir: str, buckets, dtype: str = 'f32'):
         """Factory closure for one fleet sibling: builds an isolated
         inference-only trainer from this net's config pairs and loads the
         newest checkpoint in ``model_dir`` through the retried reader
@@ -246,7 +251,7 @@ class Net:
             tr = load_into_trainer(
                 NetTrainer(self._pairs + [('inference_only', '1')]),
                 best[1])
-            return PredictEngine(tr, buckets)
+            return PredictEngine(tr, buckets, dtype=dtype)
         return factory
 
     def _require_serving(self):
@@ -325,7 +330,8 @@ class Net:
                      max_wait: float = 0.002, deadline: float = 1.0,
                      qps: float = 50.0, request_source=None,
                      steps_per_dispatch: int = 1,
-                     watchdog_deadline: float = 60.0) -> None:
+                     watchdog_deadline: float = 60.0,
+                     dtype: str = 'f32') -> None:
         """Run the train-while-serve loop over this net: training starts
         on a background thread while the colocated serving stack answers
         :meth:`online_scores` / :meth:`online_predict` requests, hot-
@@ -351,7 +357,7 @@ class Net:
             model_dir=model_dir, save_every=save_every,
             freshness_slo=freshness_slo, freshness_strict=freshness_strict,
             reload_poll=reload, buckets=bks, max_queue=max_queue,
-            max_wait=max_wait, deadline=deadline,
+            max_wait=max_wait, deadline=deadline, dtype=dtype,
             qps=qps, watchdog_deadline=watchdog_deadline or None,
             steps_per_dispatch=steps_per_dispatch, silent=True)
         # a request_source arms the built-in driver at `qps`; without

@@ -76,6 +76,12 @@ def pytest_configure(config):
         'supervision and chaos recovery, bitwise twins + demotion-matrix '
         'drift; CPU-only '
         '(tier-1: runs under -m "not slow"; select with -m execution)')
+    config.addinivalue_line(
+        'markers',
+        'quant: quantized-inference tier suite — int8/bf16 storage, '
+        'W8A8 qdot Pallas-vs-XLA bitwise twin, PredictEngine/DecodeEngine '
+        'exact + pinned-tolerance twins vs f32; CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m quant)')
 
 
 # every pipeline thread the framework starts carries a cxxnet- name

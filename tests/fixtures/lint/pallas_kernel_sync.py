@@ -1,0 +1,33 @@
+"""Seeded violation twin: host syncs inside Pallas kernel bodies.
+
+Two kernels, one per resolution path the checker must handle — a kernel
+handed to ``pl.pallas_call`` by NAME, and one wrapped in a local
+``functools.partial`` assignment first (the kernel modules' idiom).
+A host sync in a kernel body "works" under ``interpret=True`` on CPU and
+breaks Mosaic compilation on real hardware, which is exactly why the
+rule exists.
+"""
+import functools
+import time
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _scale_kernel(x_ref, o_ref):
+    peak = float(x_ref[0, 0])          # BAD: device->host sync
+    o_ref[:] = x_ref[:] * peak
+
+
+def _stamp_kernel(x_ref, o_ref, *, gain):
+    # BAD: wall clock baked in at trace time
+    o_ref[:] = x_ref[:] * gain * time.monotonic()
+
+
+def scale(x):
+    return pl.pallas_call(_scale_kernel, out_shape=x)(x)
+
+
+def stamp(x, gain):
+    kernel = functools.partial(_stamp_kernel, gain=gain)
+    return pl.pallas_call(kernel, out_shape=x)(x)

@@ -687,3 +687,65 @@ serve.buckets = 8,16
     models = sorted(f for f in os.listdir(tmp_path / 'models')
                     if f.endswith('.model'))
     assert len(models) >= 4
+
+
+def test_cli_task_online_continue_resumes_from_newest_step(tmp_path):
+    """continue=1 on task=online: the round-counter scan is gap-tolerant
+    (step-named publishes leave holes — 0005, 0010, ...), the newest
+    step-named file is adopted, and the publish counter re-arms so the
+    resumed run's checkpoints continue STRICTLY past it instead of
+    overwriting stale counters."""
+    write_mnist(str(tmp_path), n=128, rows=8, cols=8, seed=6)
+    conf = tmp_path / 'online.conf'
+    conf.write_text(f"""
+data = train
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+  shuffle = 0
+iter = end
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.05
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 3
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,64
+batch_size = 16
+dev = cpu
+eta = 0.05
+metric[label] = error
+task = online
+num_round = 1
+online.save_every = 5
+online.reload = 0.02
+""")
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               PYTHONPATH=REPO + os.pathsep + os.environ.get('PYTHONPATH',
+                                                             ''))
+
+    def run(*overrides):
+        r = subprocess.run(
+            [sys.executable, '-m', 'cxxnet_tpu.main', str(conf),
+             *overrides],
+            cwd=str(tmp_path), env=env, capture_output=True, text=True,
+            timeout=420)
+        assert r.returncode == 0, r.stdout + r.stderr
+        return r
+
+    run()
+    first = sorted(int(f.split('.')[0]) for f in
+                   os.listdir(tmp_path / 'models') if f.endswith('.model'))
+    assert len(first) >= 2 and first[-1] >= 5   # step-named, with gaps
+    r2 = run('continue=1')
+    assert f'Init: continue online run from step {first[-1]}' in r2.stdout
+    after = sorted(int(f.split('.')[0]) for f in
+                   os.listdir(tmp_path / 'models') if f.endswith('.model'))
+    new = [c for c in after if c > first[-1]]
+    assert new, 'resumed run must publish past the adopted step'
+    # nothing regressed or was overwritten: the old set is a prefix
+    assert after[:len(first)] == first

@@ -182,6 +182,26 @@ def place(tree):
     assert tracer_hygiene.check_module(core.parse_snippet(src)) == []
 
 
+def test_tracer_pallas_kernel_sync_caught():
+    """Pallas kernel bodies are traced scope: both resolution paths —
+    pallas_call(<name>, ...) and the local kernel = functools.partial(fn)
+    assignment idiom — must surface their seeded host syncs."""
+    findings = tracer_hygiene.check_module(
+        fixture('pallas_kernel_sync.py'))
+    assert len(findings) == 2
+    assert all(f.rule == 'tracer-hygiene' for f in findings)
+    msgs = ' | '.join(f.message for f in findings)
+    assert '_scale_kernel' in msgs and 'float()' in msgs   # direct name
+    assert '_stamp_kernel' in msgs and 'time.monotonic()' in msgs  # partial
+
+
+def test_tracer_pallas_kernel_clean_twin_silent():
+    """...and the clean twin — same kernel shapes, host work on the host
+    side (incl. a float() in the UNtraced builder fn) — stays silent."""
+    assert tracer_hygiene.check_module(
+        fixture('pallas_kernel_clean.py')) == []
+
+
 # --- fault-taxonomy: fixtures ------------------------------------------------
 
 @pytest.fixture(scope='module')
