@@ -60,6 +60,7 @@ import os
 import struct
 import subprocess
 import sys
+from typing import Optional
 
 # Persistent XLA compilation cache: the AlexNet train-step scan takes
 # many minutes to compile over the dev-harness tunnel, and every bench
@@ -1270,6 +1271,120 @@ _MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
           'decode': ('decode_tokens_per_sec_per_chip', bench_decode)}
 
 
+#: ledger metrics whose ``cpu-fallback`` receipts a real-TPU run can
+#: heal, and the (script, mode) that re-measures each — the flash/int8
+#: serving legs, whose interpret-mode Pallas numbers prove nothing about
+#: on-chip speed (doc/benchmarks.md)
+_HEALABLE = {
+    'decode_int8_resident_reduction': ('bench_serve.py', 'decode_matrix'),
+    'decode_tokens_per_sec': ('bench_serve.py', 'decode'),
+}
+
+
+def heal_candidates(root: str):
+    """Newest cpu-fallback ledger entry per healable metric: scan the
+    committed ``BENCH*.json`` trajectory files (and any prior healed
+    receipts) for payloads stamped ``"platform": "cpu-fallback"`` whose
+    metric is in ``_HEALABLE``; a later real-platform receipt for the
+    same metric supersedes the stale one."""
+    import glob
+    state: dict = {}
+    paths = (glob.glob(os.path.join(root, 'BENCH*.json'))
+             + glob.glob(os.path.join(root, 'receipts',
+                                      'bench_serve_*.json')))
+    # newest file wins by mtime (ties broken by name): a cpu-fallback
+    # trajectory entry committed AFTER an old heal receipt must read as
+    # stale again, not stay masked by it
+    def _stamp(p):
+        try:
+            return (os.path.getmtime(p), p)
+        except OSError:
+            return (0.0, p)
+
+    for path in sorted(paths, key=_stamp):
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            continue
+        metric = payload.get('metric')
+        if metric not in _HEALABLE:
+            continue
+        state[metric] = (path, payload.get('platform') == 'cpu-fallback')
+    return [(path, metric, _HEALABLE[metric])
+            for metric, (path, stale) in sorted(state.items()) if stale]
+
+
+def _run_heal(script: str, mode: str) -> Optional[dict]:
+    """Re-measure one healable mode on the (now confirmed up) backend;
+    returns its JSON payload or None."""
+    env = dict(os.environ)
+    env['CXXNET_BENCH_NO_HEAL'] = '1'    # no recursion from the child
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)), script),
+         mode],
+        env=env, capture_output=True, text=True, timeout=3000)
+    for line in reversed((r.stdout or '').strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
+def self_heal_receipts(root: Optional[str] = None, runner=None) -> list:
+    """The trajectory's self-healing pass (ROADMAP item 4 tail): when a
+    bench run finds the real TPU up, any flash/int8 ledger entry still
+    stamped ``cpu-fallback`` is re-measured automatically and the healed
+    receipt lands in ``receipts/bench_serve_<mode>.json`` — the
+    trajectory repairs itself the first time the tunnel cooperates,
+    instead of waiting for someone to remember a manual rerun.  Returns
+    the healed (metric, receipt_path) pairs; never raises — a failed
+    heal is a note, not a bench failure."""
+    if os.environ.get('CXXNET_BENCH_NO_HEAL') == '1':
+        return []
+    plats = [p.strip() for p in
+             os.environ.get('JAX_PLATFORMS', '').split(',') if p.strip()]
+    if plats and all(p == 'cpu' for p in plats):
+        return []            # explicit CPU-only run: nothing to heal with
+    root = root or os.path.dirname(os.path.abspath(__file__))
+    runner = runner or _run_heal
+    healed = []
+    for stale_path, metric, (script, mode) in heal_candidates(root):
+        try:
+            payload = runner(script, mode)
+        except Exception as e:      # healing must not break the
+            #                         requested bench mode — but a
+            #                         Ctrl-C/SystemExit still aborts
+            _emit({'metric': 'receipt_self_heal', 'value': None,
+                   'heals': metric, 'error': f'{type(e).__name__}: {e}'})
+            continue
+        if payload is None or payload.get('value') is None:
+            _emit({'metric': 'receipt_self_heal', 'value': None,
+                   'heals': metric,
+                   'error': 'heal rerun produced no measurement'})
+            continue
+        if payload.get('platform') in (None, 'cpu', 'cpu-fallback'):
+            # the backend went away between the probe and the rerun: a
+            # fallback receipt must not overwrite the healing intent
+            _emit({'metric': 'receipt_self_heal', 'value': None,
+                   'heals': metric,
+                   'error': f'rerun landed on platform='
+                            f'{payload.get("platform")!r}, not a chip'})
+            continue
+        payload['heals'] = stale_path
+        out = os.path.join(root, 'receipts', f'bench_serve_{mode}.json')
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, 'w') as f:
+            json.dump(payload, f, indent=1)
+        healed.append((metric, out))
+        _emit({'metric': 'receipt_self_heal', 'value': payload['value'],
+               'heals': metric, 'receipt': out,
+               'platform': payload.get('platform')})
+    return healed
+
+
 def _cpu_fallback(mode: str, err: BaseException) -> int:
     """The ledger must ALWAYS record a number: rerun this mode in a child
     process pinned to ``JAX_PLATFORMS=cpu`` and re-emit its receipt
@@ -1321,6 +1436,9 @@ def main() -> int:
                 _ensure_backend()
             except BackendUnavailable as e:
                 return _cpu_fallback(mode, e)
+            # the chip is UP: heal any flash/int8 ledger entry still
+            # stamped cpu-fallback before (not instead of) this run
+            self_heal_receipts()
         return fn()
     except BaseException as e:           # noqa: BLE001 — one JSON line, always
         payload = {'metric': metric, 'value': None, 'unit': None,

@@ -27,9 +27,12 @@ from .core import Finding, Module, Repo, dotted_name
 
 RULES = ('fault-taxonomy',)
 
-#: directories whose raises must use the taxonomy (repo-relative)
+#: directories whose raises must use the taxonomy (repo-relative).
+#: parallel/ joined with the elastic multi-host runtime: a raw error in
+#: the coordinator/client/supervisor stack would fall outside the
+#: RECOVERABLE set and turn a drillable host loss into a dead run.
 TARGET_DIRS = ('cxxnet_tpu/runtime/', 'cxxnet_tpu/serve/',
-               'cxxnet_tpu/online/')
+               'cxxnet_tpu/online/', 'cxxnet_tpu/parallel/')
 
 FAULTS_MODULE = 'cxxnet_tpu/runtime/faults.py'
 

@@ -29,6 +29,13 @@ directed graph over the package; any cycle (two code paths acquiring
 the same pair of locks in opposite orders) is a potential deadlock and
 fails — the fleet-scale lesson of PAPERS.md's distributed-training
 line: concurrency order bugs, not kernels, are what break at scale.
+
+Both rules run over the whole shipped package, which includes the
+elastic multi-host runtime (``parallel/elastic.py``): its coordinator
+connection/monitor threads and client heartbeat thread declare their
+shared state ``# guarded-by: _cond``/``_lock`` like every other
+threaded subsystem — membership races are exactly the bug class the
+chaos drills cannot afford.
 """
 
 from __future__ import annotations

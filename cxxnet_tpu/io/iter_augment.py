@@ -144,11 +144,24 @@ class AugmentIterator(IIterator):
     and a pooled run is reproducible against another pooled run of any
     width.  (The pooled stream therefore differs from the legacy serial
     stream: pick one mode per experiment.)  Per-stage timings land on
-    ``pipeline_stats()``."""
+    ``pipeline_stats()``.
+
+    ``elastic_hosts = H`` / ``elastic_rank = h`` promote the same
+    invariant from threads to hosts (doc/fault_tolerance.md "Multi-host
+    recovery"): this stage keeps the GLOBAL epoch-absolute enumeration
+    of the source's thunk stream but materializes only instances with
+    ``index % H == h`` — skipped thunks never decode (the work is
+    deferred into the thunk), and the per-instance RNG still keys on
+    the global index.  Interleaving the H hosts' streams round-robin
+    therefore reconstructs the 1-host stream bitwise, at any host
+    count.  Requires the pooled path (``nworker >= 1``): the serial
+    path's shared sequential RNG cannot shard."""
 
     def __init__(self, base: IIterator):
         self.base = base
         self.nworker = 0            # 0 = legacy serial path
+        self.elastic_hosts = 1      # per-host stream sharding (elastic)
+        self.elastic_rank = 0
         self._stats = None
         self.shape = (0, 0, 0)      # (c, y, x)
         self.rand_crop = 0
@@ -176,6 +189,10 @@ class AugmentIterator(IIterator):
             if self.nworker and self._stats is None:
                 from ..utils.metric import StatSet
                 self._stats = StatSet()
+        if name == 'elastic_hosts':
+            self.elastic_hosts = max(1, int(val))
+        if name == 'elastic_rank':
+            self.elastic_rank = int(val)
         if name == 'input_shape':
             self.shape = tuple(int(t) for t in val.split(','))
         if name == 'seed_data':
@@ -323,6 +340,20 @@ class AugmentIterator(IIterator):
         return np.random.RandomState(
             (self.seed_data + salt + (i + 1) * 2654435761) % (2 ** 31))
 
+    def _sharded_thunks(self):
+        """The pooled submission stream: ``(global_index, thunk)`` pairs,
+        elastic-sharded to this host.  The enumeration stays GLOBAL so
+        the per-instance RNG — and hence the emitted bytes — for
+        instance i are identical no matter how many hosts split the
+        stream; a skipped thunk costs nothing (decode rides inside)."""
+        hosts, rank = self.elastic_hosts, self.elastic_rank
+        if hosts <= 1:
+            yield from enumerate(self.base.iter_thunks())
+            return
+        for i, thunk in enumerate(self.base.iter_thunks()):
+            if i % hosts == rank:
+                yield i, thunk
+
     def _iter_pooled(self):
         """nworker path: decode thunks from the source fan across an
         order-preserving pool together with this stage's augmentation;
@@ -349,12 +380,18 @@ class AugmentIterator(IIterator):
                 stats.observe('augment_ms', (t2 - t1) * 1e3)
             return out
 
-        yield from pool.imap(job, enumerate(self.base.iter_thunks()))
+        yield from pool.imap(job, self._sharded_thunks())
 
     def __iter__(self):
         if self.nworker:
             yield from self._iter_pooled()
             return
+        if self.elastic_hosts > 1:
+            raise ValueError(
+                'elastic_hosts > 1 requires the pooled path (nworker >= '
+                '1): the serial stream draws from one shared sequential '
+                'RNG, which cannot shard per host and stay bitwise '
+                'reconstructable')
         if self._device_norm_active():
             # raw crops go to the device untouched; normalization happens
             # inside the jitted step (trainer._apply_input_norm)

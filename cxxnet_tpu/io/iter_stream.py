@@ -85,13 +85,21 @@ class ImageBinStreamIterator(ImageBinIterator):
     * ``stream_poll``  — seconds between growth checks once caught up
       (default 0.05),
     * ``stream_idle``  — end the pass after this many seconds with no
-      growth; 0 (default) reads the current snapshot and stops at EOF.
+      growth; 0 (default) reads the current snapshot and stops at EOF,
+    * ``stream_fence`` — end the pass after EXACTLY this many instances,
+      waiting out growth as needed (0 = off).  Time-based pass endings
+      (`stream_idle`) are per-observer: two hosts tailing the same
+      growing file can disagree about where a pass ends.  The fence
+      pins the pass length to a number every host shares, which is what
+      elastic multi-host training requires of its global sample stream
+      (doc/fault_tolerance.md "Multi-host recovery").
     """
 
     def __init__(self):
         super().__init__()
         self.stream_poll = 0.05
         self.stream_idle = 0.0
+        self.stream_fence = 0
 
     def set_param(self, name, val):
         super().set_param(name, val)
@@ -99,6 +107,8 @@ class ImageBinStreamIterator(ImageBinIterator):
             self.stream_poll = float(val)
         if name == 'stream_idle':
             self.stream_idle = float(val)
+        if name == 'stream_fence':
+            self.stream_fence = int(val)
 
     def init(self):
         if self.conf_prefix:
@@ -171,10 +181,14 @@ class ImageBinStreamIterator(ImageBinIterator):
     def _epoch_pages(self, rng_page):
         """One streaming pass at page granularity: drain every complete
         page on disk, then poll for growth until ``stream_idle`` elapses
-        with none.  Only the APPENDED pages are header-scanned on growth
+        with none — or, with ``stream_fence``, until exactly that many
+        instances have been yielded (the last page truncates at the
+        fence).  Only the APPENDED pages are header-scanned on growth
         (:meth:`_refresh_page_table`); consumed pages are never re-read."""
         part = 0
         pidx = 0
+        yielded = 0
+        fence = self.stream_fence
         idle_since = None
         while True:
             try:
@@ -192,8 +206,20 @@ class ImageBinStreamIterator(ImageBinIterator):
                         raise RuntimeError(
                             f'imgbin_stream: page {p} holds {len(blobs)} '
                             f'objects but its header said {counts[p]}')
+                    if fence:
+                        blobs = blobs[:fence - yielded]
+                        if not blobs:
+                            return
                     lines = self._await_lines(part, starts[p] + len(blobs))
                     yield blobs, lines[starts[p]:starts[p] + len(blobs)]
+                    yielded += len(blobs)
+                    if fence and yielded >= fence:
+                        return
+                continue
+            if fence:
+                # fenced pass: the remaining instances are owed; wait
+                # out the writer rather than ending early
+                time.sleep(self.stream_poll)
                 continue
             if self.stream_idle <= 0:
                 return

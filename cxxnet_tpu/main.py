@@ -99,6 +99,16 @@ class LearnTask:
         self.online_freshness_strict = 0  # online.freshness_strict 1=raise
         self.online_reload = 0.05      # online.reload registry poll (s)
         self.online_qps = 50.0         # online.qps traffic driver rate
+        # elastic multi-host training (doc/fault_tolerance.md
+        # "Multi-host recovery"); hosts>0 turns the elastic runtime on
+        self.dist_hosts = 0            # dist.hosts worker-host count
+        self.dist_rank = -1            # dist.rank (-1 = launcher role)
+        self.dist_coordinator = ''     # dist.coordinator host:port
+        self.dist_heartbeat = 2.0      # dist.heartbeat seconds
+        self.dist_rejoin = 2           # dist.rejoin respawn budget
+        self.dist_shards = 0           # dist.shards micro-shards (0=hosts)
+        self.dist_sync_timeout = 60.0  # dist.sync_timeout seconds
+        self.dist_launch = 0           # dist.launch=1 forces launcher role
         self.cfg: List[ConfigEntry] = []
         self.net_trainer: Optional[NetTrainer] = None
         self.itr_train = None
@@ -153,6 +163,14 @@ class LearnTask:
             'serve.mem_budget': ('serve_mem_budget', int),
             'serve.dtype': ('serve_dtype', str),
             'serve.flash_decode': ('serve_flash', str),
+            'dist.hosts': ('dist_hosts', int),
+            'dist.rank': ('dist_rank', int),
+            'dist.coordinator': ('dist_coordinator', str),
+            'dist.heartbeat': ('dist_heartbeat', float),
+            'dist.rejoin': ('dist_rejoin', int),
+            'dist.shards': ('dist_shards', int),
+            'dist.sync_timeout': ('dist_sync_timeout', float),
+            'dist.launch': ('dist_launch', int),
             'online.save_every': ('online_save_every', int),
             'online.freshness_slo': ('online_freshness_slo', float),
             'online.freshness_strict': ('online_freshness_strict', int),
@@ -409,6 +427,20 @@ class LearnTask:
 
     # --- tasks ------------------------------------------------------------
     def task_train(self) -> None:
+        if self.dist_hosts > 0:
+            if self.task != 'train':
+                # never silently train single-host when the config asked
+                # for a fleet (the same contract as maybe_init_distributed)
+                raise ValueError(
+                    f'dist.hosts={self.dist_hosts} supports task=train '
+                    f'only (got task={self.task}); drop the dist.* keys '
+                    'or switch the task')
+            # elastic multi-host worker (or the in-process single-host
+            # twin); the launcher role never reaches here — run()
+            # dispatches it before init()
+            from .parallel.elastic import elastic_train
+            elastic_train(self)
+            return
         start = time.monotonic()
         if self.continue_training == 0 and self.name_model_in == 'NULL':
             self._save_model()
@@ -984,6 +1016,23 @@ class LearnTask:
         cfg = apply_cli_overrides(cfg, argv[1:])
         for name, val in cfg:
             self.set_param(name, val)
+        if self.task == 'train' and self.dist_rank < 0 \
+                and (self.dist_hosts > 1
+                     or (self.dist_hosts == 1 and self.dist_launch)):
+            # elastic launcher role: own the coordinator, spawn one
+            # worker per host, respawn preempted ranks.  Dispatched
+            # BEFORE init() — the launcher never builds a net or touches
+            # a device; workers replay this same argv with their rank
+            # appended (doc/fault_tolerance.md "Multi-host recovery")
+            from .parallel.elastic import ElasticLauncher
+            return ElasticLauncher(
+                argv=list(argv), hosts=self.dist_hosts,
+                rejoin=self.dist_rejoin, heartbeat=self.dist_heartbeat,
+                silent=bool(self.silent)).run()
+        # classic jax.distributed world (param_server=dist / cluster
+        # env): one global mesh over every host's devices
+        from .parallel.distributed import maybe_init_distributed
+        maybe_init_distributed(self.cfg)
         plan = None
         if self.fault_plan:
             # deterministic fault injection (tests/chaos drills): the plan

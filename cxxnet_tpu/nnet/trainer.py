@@ -190,7 +190,15 @@ class NetTrainer:
 
     # --- construction -----------------------------------------------------
     def _build_mesh(self) -> Mesh:
-        all_devs = jax.devices()
+        # in a multi-process jax.distributed world, jax.devices() spans
+        # every host, but this trainer must pick devices THIS process
+        # can feed (host data is device_put from here) — so both the
+        # default and an explicit dev= list index the LOCAL device set
+        # there (the per-worker view, matching the reference's
+        # one-worker-per-host deployment); gradients cross hosts at the
+        # elastic/ps layer, not through the mesh
+        all_devs = (jax.local_devices() if jax.process_count() > 1
+                    else jax.devices())
         if self.devices:
             picked = [all_devs[i % len(all_devs)] for i in self.devices]
             # de-dup while preserving order (e.g. dev=tpu:0-3 on 1 chip)
@@ -517,6 +525,44 @@ class NetTrainer:
 
         fwd_fn.n_steps = n_steps
         return fwd_fn
+
+    def compile_grad_step(self):
+        """Jitted ``(params, data, label, extra, mask, rng, rnd, norm)
+        -> (loss, grads)``: the forward/backward of ``train_step``
+        WITHOUT the optimizer apply or accumulator — the elastic
+        multi-host runtime (``parallel/elastic.py``) computes one
+        gradient contribution per micro-shard of the global batch,
+        exchanges them across hosts, and applies the fixed-order
+        combination through :meth:`compile_apply_grad`.  Nothing is
+        donated: params are reused across every shard of a step."""
+        loss_fn = self._make_loss_fn()
+
+        @jax.jit
+        def grad_step(params, data, label, extra, mask, rng, rnd,
+                      norm=()):
+            (loss, _evals), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, data, label, extra, mask,
+                                       rng, rnd, norm)
+            return loss, grads
+
+        return grad_step
+
+    def compile_apply_grad(self):
+        """Jitted ``(params, opt_state, grads, epoch) -> (params,
+        opt_state)``: ONE optimizer step over an already-combined
+        gradient tree.  The elastic runtime feeds it the cross-host
+        shard sum — every host applies the identical bytes, so the
+        replicated params stay bitwise equal with no broadcast."""
+        updater_type = self.net_cfg.updater_type
+        hypers = self.hypers
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def apply_grad(params, opt_state, grads, epoch):
+            params, opt_state = apply_updates(
+                updater_type, hypers, params, grads, opt_state, epoch)
+            return params, opt_state
+
+        return apply_grad
 
     def shard_batch_stack(self, stack: np.ndarray, cast: bool = True):
         """Stage a (nstack, batch, ...) stack of batches on device with the

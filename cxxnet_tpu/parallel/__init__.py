@@ -1,7 +1,9 @@
-"""Parallelism: device meshes, shardings, and distributed init."""
+"""Parallelism: device meshes, shardings, distributed init, and the
+elastic multi-host runtime (``elastic`` is imported lazily by its
+consumers — it pulls in the runtime/supervisor stack)."""
 
 from .mesh import (batch_sharding, build_mesh, param_shardings,
                    replicated_sharding)
-from .distributed import maybe_init_distributed
+from .distributed import init_distributed, maybe_init_distributed
 from .sequence import (attention_reference, ring_attention,
                        ulysses_attention)

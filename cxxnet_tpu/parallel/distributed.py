@@ -8,11 +8,87 @@ hosts through the same XLA collectives.  The reference's env contract is
 kept: ``PS_RANK`` (worker rank) and ``dist_num_worker`` map onto
 process_id/num_processes, and the data pipeline shards input per worker
 exactly as ``iter_thread_imbin-inl.hpp:189-220`` did.
+
+Hardened surface (doc/fault_tolerance.md "Multi-host recovery"):
+
+* misconfiguration is a typed ``faults.DistInitError`` (rank out of
+  range, bad worker count) instead of a silently wrong world,
+* a coordinator that is slow to come up is a **retry**, not a hang:
+  ``initialize`` runs under a ``faults.RetryPolicy`` with a bounded
+  per-attempt ``initialization_timeout``,
+* :func:`init_distributed` may be called again with ``fresh=True`` to
+  tear down and rebuild the world — the per-generation re-init the
+  elastic runtime (``parallel/elastic.py``) performs after a membership
+  change on a real fleet.
 """
 
 from __future__ import annotations
 
 import os
+import sys
+from typing import Optional
+
+from ..runtime import faults
+from ..utils.config import cfg_get, cfg_get_int
+
+#: initialize() can stall indefinitely on a half-up coordinator; each
+#: attempt gets this bound and the retry policy owns the patience
+DEFAULT_INIT_TIMEOUT = 60.0
+
+#: jax.distributed.initialize raises RuntimeError flavors on connect
+#: trouble, not OSError — the init policy retries both
+DIST_INIT_RETRY = faults.RetryPolicy(
+    retry_on=(OSError, TimeoutError, RuntimeError))
+
+
+def init_distributed(coordinator: str, nproc: int, rank: int,
+                     timeout: float = DEFAULT_INIT_TIMEOUT,
+                     retry: Optional[faults.RetryPolicy] = None,
+                     fresh: bool = False) -> None:
+    """Join (or, with ``fresh=True``, re-join) a ``jax.distributed``
+    world, with typed validation and a retried, time-bounded connect.
+
+    ``fresh=True`` shuts down any live world first — the elastic
+    runtime's rejoin path: after a membership change every survivor
+    rebuilds the world for the new generation instead of wedging on the
+    dead one."""
+    if nproc < 1:
+        raise faults.DistInitError(
+            f'distributed world needs at least 1 process, got {nproc}')
+    if not 0 <= rank < nproc:
+        raise faults.DistInitError(
+            f'worker rank {rank} out of range for a {nproc}-process '
+            'world (check PS_RANK / dist_worker_rank vs '
+            'CXXNET_NUM_WORKER / dist_num_worker)')
+    import jax
+    retry = DIST_INIT_RETRY if retry is None else retry
+
+    def attempt():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator, num_processes=nproc,
+                process_id=rank, initialization_timeout=int(timeout))
+        except RuntimeError:
+            # a failed/stale half-initialized client must be torn down
+            # before the retry, or every later attempt fails on
+            # "already initialized"
+            try:
+                jax.distributed.shutdown()
+            except RuntimeError:
+                pass
+            raise
+
+    if fresh:
+        try:
+            jax.distributed.shutdown()
+        except RuntimeError:
+            pass                     # no live world: nothing to tear down
+    try:
+        retry.call(attempt, op_name='jax_distributed_init')
+    except faults.RetryError as e:
+        raise faults.DistInitError(
+            f'jax.distributed world ({coordinator}, rank {rank}/'
+            f'{nproc}) failed to initialize: {e}') from e
 
 
 def maybe_init_distributed(cfg_pairs) -> bool:
@@ -22,26 +98,32 @@ def maybe_init_distributed(cfg_pairs) -> bool:
     presence of standard cluster env vars.  Returns True if distributed
     mode was initialized.
     """
-    want = any(k == 'param_server' and v == 'dist' for k, v in cfg_pairs)
+    want = cfg_get(cfg_pairs, 'param_server') == 'dist'
     coord = os.environ.get('CXXNET_COORDINATOR',
                            os.environ.get('COORDINATOR_ADDRESS'))
     if not want and coord is None:
         return False
-    import jax
-    nproc = int(os.environ.get('CXXNET_NUM_WORKER',
-                               _cfg_get(cfg_pairs, 'dist_num_worker', '1')))
-    rank = int(os.environ.get('PS_RANK',
-                              _cfg_get(cfg_pairs, 'dist_worker_rank', '0')))
+    env_nproc = os.environ.get('CXXNET_NUM_WORKER')
+    nproc = (int(env_nproc) if env_nproc
+             else cfg_get_int(cfg_pairs, 'dist_num_worker', 1))
+    env_rank = os.environ.get('PS_RANK')
+    rank = (int(env_rank) if env_rank
+            else cfg_get_int(cfg_pairs, 'dist_worker_rank', 0))
     if nproc <= 1:
+        if coord is not None:
+            # a coordinator address with a 1-process world is almost
+            # always a mis-set CXXNET_NUM_WORKER — say so instead of
+            # silently training solo
+            print('distributed: coordinator address set but '
+                  f'num_workers={nproc} — running single-process '
+                  '(set CXXNET_NUM_WORKER / dist_num_worker)',
+                  file=sys.stderr, flush=True)
         return False
-    jax.distributed.initialize(coordinator_address=coord,
-                               num_processes=nproc, process_id=rank)
+    if coord is None:
+        raise faults.DistInitError(
+            'param_server=dist needs a coordinator address '
+            '(CXXNET_COORDINATOR / COORDINATOR_ADDRESS)')
+    timeout = float(os.environ.get('CXXNET_DIST_INIT_TIMEOUT',
+                                   str(DEFAULT_INIT_TIMEOUT)))
+    init_distributed(coord, nproc, rank, timeout=timeout)
     return True
-
-
-def _cfg_get(cfg_pairs, name, default):
-    val = default
-    for k, v in cfg_pairs:
-        if k == name:
-            val = v
-    return val

@@ -69,6 +69,54 @@ class CheckpointCorruptError(TrainingFault):
     """A checkpoint failed integrity verification on restore."""
 
 
+class HostLossError(TrainingFault):
+    """A peer host left the elastic training world mid-run (preemption,
+    crash, or a network partition that outlived the heartbeat timeout) —
+    or the coordinator rolled this host's generation back because a peer
+    faulted.  Recoverable by design (doc/fault_tolerance.md "Multi-host
+    recovery"): every survivor restores the last good checkpoint,
+    rendezvouses into the next membership generation, and resumes."""
+
+    def __init__(self, reason: str, rank: Optional[int] = None,
+                 generation: int = 0):
+        self.rank = rank
+        self.generation = int(generation)
+        who = f'rank {rank}' if rank is not None else 'a peer'
+        super().__init__(
+            f'elastic membership change (generation {generation}): '
+            f'{who} — {reason}')
+
+
+class CoordinatorUnreachableError(TrainingFault):
+    """The elastic coordinator did not answer within the sync timeout.
+    From one host's view this is indistinguishable from being the minor
+    side of a partition: recoverable — drop out, rendezvous afresh,
+    restore-last-good."""
+
+    def __init__(self, op: str, waited: float):
+        self.op = op
+        self.waited = float(waited)
+        super().__init__(
+            f'elastic coordinator unreachable: {op} got no reply '
+            f'within {waited:g}s')
+
+
+class ElasticSyncError(RuntimeError):
+    """Cross-host state verification failed: after a coordinated restore
+    the hosts' parameter digests disagree, or hosts arrived at the same
+    barrier with different steps.  Deliberately NOT a
+    :class:`TrainingFault`: the bitwise-replication invariant is broken,
+    so restoring and retrying would diverge again — fail the run loudly
+    (doc/fault_tolerance.md)."""
+
+
+class DistInitError(RuntimeError):
+    """``jax.distributed`` world initialization was misconfigured (rank
+    out of range, bad worker count) or exhausted its retry budget.  A
+    launch-time outcome, not a :class:`TrainingFault` — there is no
+    checkpoint to restore before a world exists."""
+
+
 class ScanStrictError(RuntimeError):
     """``scan_strict=1`` asserted the scanned K-dispatch path and an
     ExecutionPlan demotion would have silently fallen back to per-step.
@@ -363,6 +411,18 @@ class FaultPlan:
       truncated so a hot-reloading server's digest verification must
       reject it (the serving half of the chaos contract,
       doc/online.md).
+    * ``host_loss=N[:rank]`` — at global step N the elastic worker whose
+      rank matches (default: the highest rank) dies abruptly
+      (``os._exit``), simulating a preempted host; survivors must
+      restore-last-good and the launcher respawns the rank
+      (doc/fault_tolerance.md "Multi-host recovery").  Fires only on a
+      worker's FIRST incarnation — a respawned replacement replays the
+      step it died at, and re-firing would be a death loop.
+    * ``partition=N:secs`` — at global step N this elastic worker stops
+      heartbeating and delays its collective traffic for ``secs``
+      (default 30): a deterministic network partition.  Outliving the
+      coordinator's heartbeat timeout makes the worker a declared host
+      loss; a short blip just stalls the step.
 
     Any event kind also accepts the RECURRING form ``kind@every=K``
     (e.g. ``raise_on_write@every=3``, ``stall_batch@every=50:0.2``):
@@ -382,6 +442,8 @@ class FaultPlan:
                  nan_at_step: Tuple[int, ...] = (),
                  stall_write: Tuple[Tuple[int, Optional[float]], ...] = (),
                  corrupt_model: Tuple[int, ...] = (),
+                 host_loss: Tuple[Tuple[int, Optional[float]], ...] = (),
+                 partition: Tuple[Tuple[int, Optional[float]], ...] = (),
                  raise_on_write_every: Tuple[int, ...] = (),
                  stall_batch_every: Tuple[Tuple[int, Optional[float]],
                                           ...] = (),
@@ -389,7 +451,11 @@ class FaultPlan:
                  nan_at_step_every: Tuple[int, ...] = (),
                  stall_write_every: Tuple[Tuple[int, Optional[float]],
                                           ...] = (),
-                 corrupt_model_every: Tuple[int, ...] = ()):
+                 corrupt_model_every: Tuple[int, ...] = (),
+                 host_loss_every: Tuple[Tuple[int, Optional[float]],
+                                        ...] = (),
+                 partition_every: Tuple[Tuple[int, Optional[float]],
+                                        ...] = ()):
         def _periods(vals):
             out = set()
             for k in vals:
@@ -406,6 +472,12 @@ class FaultPlan:
         self._corrupt = set(corrupt_shard)
         self._nan = set(nan_at_step)
         self._corrupt_model = set(corrupt_model)
+        # host_loss: step -> target rank (None = highest rank; the rank
+        # rides the event's ':' argument slot); partition: step -> secs
+        self._host_loss = {n: (None if r is None else int(r))
+                           for n, r in host_loss}
+        self._partition = {n: (30.0 if s is None else s)
+                           for n, s in partition}
         # recurring (@every=K) variants: period -> fire on every K-th
         # occurrence; deterministic, never consumed
         self._raise_every = _periods(raise_on_write_every)
@@ -416,6 +488,12 @@ class FaultPlan:
         self._corrupt_every = _periods(corrupt_shard_every)
         self._nan_every = _periods(nan_at_step_every)
         self._corrupt_model_every = _periods(corrupt_model_every)
+        self._host_loss_every = {int(k): (None if r is None else int(r))
+                                 for k, r in host_loss_every}
+        self._partition_every = {int(k): (30.0 if s is None else s)
+                                 for k, s in partition_every}
+        if 0 in self._host_loss_every or 0 in self._partition_every:
+            raise ValueError('@every period must be > 0')
         if 0 in self._stall_every or 0 in self._stall_write_every:
             raise ValueError('@every period must be > 0')
         # step-keyed recurring events fire once per DISTINCT step: a
@@ -424,6 +502,7 @@ class FaultPlan:
         # count-based hooks are monotone and need no such guard)
         self._nan_fired_steps: set = set()
         self._corrupt_fired_steps: set = set()
+        self._partition_fired_steps: set = set()
         self._write_count = 0
         self._model_count = 0
         self._fired: List[str] = []
@@ -436,11 +515,14 @@ class FaultPlan:
         kw: Dict[str, list] = {
             'raise_on_write': [], 'stall_batch': [], 'stall_write': [],
             'corrupt_shard': [], 'nan_at_step': [], 'corrupt_model': [],
+            'host_loss': [], 'partition': [],
             'raise_on_write_every': [], 'stall_batch_every': [],
             'stall_write_every': [], 'corrupt_shard_every': [],
-            'nan_at_step_every': [], 'corrupt_model_every': []}
-        timed = ('stall_batch', 'stall_write',
-                 'stall_batch_every', 'stall_write_every')
+            'nan_at_step_every': [], 'corrupt_model_every': [],
+            'host_loss_every': [], 'partition_every': []}
+        timed = ('stall_batch', 'stall_write', 'host_loss', 'partition',
+                 'stall_batch_every', 'stall_write_every',
+                 'host_loss_every', 'partition_every')
         for key, val in parse_kv_list(text):
             if key == 'seed':
                 seed = int(val)
@@ -486,6 +568,14 @@ class FaultPlan:
                   for s in sorted(self._corrupt_model_every)]
         parts += [f'nan_at_step={s}' for s in sorted(self._nan)]
         parts += [f'nan_at_step@every={s}' for s in sorted(self._nan_every)]
+        parts += [f'host_loss={n}' + ('' if r is None else f':{r}')
+                  for n, r in sorted(self._host_loss.items())]
+        parts += [f'host_loss@every={k}' + ('' if r is None else f':{r}')
+                  for k, r in sorted(self._host_loss_every.items())]
+        parts += [f'partition={n}:{s:g}'
+                  for n, s in sorted(self._partition.items())]
+        parts += [f'partition@every={k}:{s:g}'
+                  for k, s in sorted(self._partition_every.items())]
         return ';'.join(parts)
 
     @staticmethod
@@ -563,6 +653,75 @@ class FaultPlan:
                 self._fired.append(f'nan_at_step@every={k}#{step}')
                 return float('nan')
         return loss
+
+    #: exit status of a host_loss-killed elastic worker — the launcher
+    #: treats it exactly like a preemption (respawn, never fail the run)
+    HOST_LOSS_EXIT = 117
+
+    def on_elastic_step(self, step: int, rank: int, nhosts: int,
+                        allow_kill: bool = True) -> Optional[float]:
+        """Per-global-step hook on every elastic worker (the plan is
+        replicated per process, so firing decisions are deterministic
+        and identical on all hosts).  ``host_loss`` whose target rank
+        matches kills THIS process abruptly (``os._exit``) — only when
+        ``allow_kill`` (the worker's first incarnation: a respawned
+        replacement replays the fatal step and must not re-die).
+        ``partition`` returns the seconds this worker should drop off
+        the network; the elastic client implements the silence."""
+        kill = False
+        secs = None
+        with self._lock:
+            tgt = self._host_loss.get(step, '-')
+            if tgt != '-':
+                want = (nhosts - 1) if tgt is None else tgt
+                if want == rank:
+                    if allow_kill:
+                        del self._host_loss[step]
+                        self._fired.append(f'host_loss={step}:{rank}')
+                        kill = True
+                    else:
+                        self._fired.append(
+                            f'host_loss={step}:{rank}#disarmed')
+            if not kill:
+                k = self._periodic_hit(step, self._host_loss_every)
+                if k is not None:
+                    want = self._host_loss_every[k]
+                    want = (nhosts - 1) if want is None else want
+                    if want == rank:
+                        if allow_kill:
+                            self._fired.append(
+                                f'host_loss@every={k}#{step}:{rank}')
+                            kill = True
+                        else:
+                            # a respawned replacement keeps the plan
+                            # disarmed for its whole lifetime (it cannot
+                            # tell replayed steps from fresh ones) —
+                            # recorded so drills can see the suppression
+                            self._fired.append(
+                                f'host_loss@every={k}#{step}:{rank}'
+                                '#disarmed')
+            if not kill:
+                secs = self._partition.get(step)
+                if secs is not None \
+                        and step not in self._partition_fired_steps:
+                    self._partition_fired_steps.add(step)
+                    self._fired.append(f'partition={step}:{secs:g}')
+                elif step in self._partition_fired_steps:
+                    secs = None         # replayed step: fire once
+                else:
+                    k = self._periodic_hit(step, self._partition_every)
+                    if k is not None:
+                        self._partition_fired_steps.add(step)
+                        secs = self._partition_every[k]
+                        self._fired.append(
+                            f'partition@every={k}#{step}:{secs:g}')
+        if kill:
+            import os
+            import sys
+            print(f'fault plan: host_loss fired — rank {rank} dies at '
+                  f'step {step}', file=sys.stderr, flush=True)
+            os._exit(self.HOST_LOSS_EXIT)
+        return secs
 
     def on_model_committed(self, path: str) -> None:
         """After the N-th model-file commit (file + digest sidecar both
@@ -665,6 +824,17 @@ def shard_committed(step: int, path: str) -> None:
     plan = _ACTIVE
     if plan is not None:
         plan.on_shard_committed(step, path)
+
+
+def elastic_step(step: int, rank: int, nhosts: int,
+                 allow_kill: bool = True) -> Optional[float]:
+    """Call at the top of every elastic worker's global step; returns
+    partition seconds to enforce, or None (see
+    :meth:`FaultPlan.on_elastic_step`)."""
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    return plan.on_elastic_step(step, rank, nhosts, allow_kill=allow_kill)
 
 
 def model_committed(path: str, staged: Optional[str] = None) -> None:

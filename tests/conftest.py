@@ -82,13 +82,23 @@ def pytest_configure(config):
         'W8A8 qdot Pallas-vs-XLA bitwise twin, PredictEngine/DecodeEngine '
         'exact + pinned-tolerance twins vs f32; CPU-only '
         '(tier-1: runs under -m "not slow"; select with -m quant)')
+    config.addinivalue_line(
+        'markers',
+        'dist: elastic multi-host training suite — coordinator/client '
+        'membership, host-sharded stream bitwise twins, and the '
+        'multi-process chaos drills (real worker subprocesses over '
+        'localhost; host_loss/partition recovery bitwise-equal to '
+        'fault-free twins); CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m dist)')
 
 
 # every pipeline thread the framework starts carries a cxxnet- name
 # prefix (utils/thread_buffer.py producers, utils/parallel_pool.py
-# workers, serve/decode.py loop threads) precisely so this fixture can
-# hold the line on lifecycle
-_PIPELINE_THREAD_PREFIXES = ('cxxnet-tb-', 'cxxnet-pool-', 'cxxnet-decode-')
+# workers, serve/decode.py loop threads, parallel/elastic.py
+# coordinator/heartbeat threads) precisely so this fixture can hold the
+# line on lifecycle
+_PIPELINE_THREAD_PREFIXES = ('cxxnet-tb-', 'cxxnet-pool-', 'cxxnet-decode-',
+                             'cxxnet-elastic-')
 
 
 def _pipeline_threads():
