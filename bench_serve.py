@@ -31,6 +31,17 @@ correct)::
              "token_p99_ms": P99, "resident_bytes": B,
              "twin_checked": N}, ...], "model": {...}}
 
+``prefix`` — prefix-share ON vs OFF at 90% shared-prefix traffic
+(doc/serving.md "Prefix sharing"): prefill-amortized tokens/sec (wall
+includes every prefill) + time-to-first-token per leg, every stream
+twin-asserted.  ``spec`` — greedy speculative decoding legs (draft off /
+cold small draft / self-speculation twin): tokens/sec + acceptance rate,
+every stream twin-asserted token-equal.  ``prefix_spec`` — both in one
+receipt (the BENCH_SERVE_r04 shape)::
+
+  {"metric": "prefix_share_speedup", "value": X, "unit": "x",
+   "prefix": {"on": {...}, "off": {...}}, "spec": {"legs": [...]}}
+
 Method: a tiny model (random init — serving cost is shape-bound, not
 value-bound) behind the real engine + DynamicBatcher stack;
 ``--clients`` in-process threads submit mixed-size requests (seeded)
@@ -360,10 +371,217 @@ def bench_decode_matrix(args) -> dict:
     }
 
 
+def _decode_model():
+    """The shared decode-bench model (random init — serving cost is
+    shape-bound, not value-bound)."""
+    from cxxnet_tpu.models import transformer as T
+    cfg = T.TransformerConfig(vocab_size=256, d_model=64, num_heads=4,
+                              d_ff=128, num_stages=2, seq_len=64,
+                              attn='local')
+    return T.init_params(np.random.RandomState(0), cfg), cfg
+
+
+def _drive_leg(svc, prompts, max_new, twin_all=True):
+    """Submit every prompt, wait, twin-assert EVERY stream against its
+    offline generate (BENCH_SCAN_r01 discipline: a receipt is only
+    emitted for outputs proven correct).  Returns (tokens, wall_sec,
+    ttft_ms list)."""
+    from cxxnet_tpu.models import transformer as T
+    t0 = time.monotonic()
+    reqs = [svc.submit_async(p, max_new) for p in prompts]
+    toks, ttft = 0, []
+    for r in reqs:
+        svc.batcher.wait(r)
+        toks += len(r.tokens)
+        ttft.append((r.token_times[0] - r.t_submit) * 1e3)
+    wall = time.monotonic() - t0
+    checked = 0
+    for p, r in zip(prompts, reqs):
+        off = np.asarray(T.generate(svc.engine.params, p, max_new,
+                                    svc.engine.cfg))[0]
+        got = np.asarray(r.result)
+        assert (got == off[:len(got)]).all(), (
+            f'stream {checked} diverged from its offline twin')
+        checked += 1
+        if not twin_all and checked >= 3:
+            break
+    return toks, wall, ttft, checked
+
+
+def bench_prefix(args) -> dict:
+    """Prefix-share ON vs OFF over identical 90%-shared traffic:
+    prefill-amortized tokens/sec (the wall clock includes every
+    prefill) and time-to-first-token, every stream twin-asserted.
+
+    The workload is the shape the amortization thesis targets: a long
+    PAGE-ALIGNED system prefix (31 of 32 pages) + a one-page unique
+    tail per request, short generations — sharing requires the same
+    prompt bucket and pad width (doc/serving.md "Prefix sharing"), so
+    90% of requests splice 31 pages and prefill one."""
+    import jax
+    from cxxnet_tpu.models import transformer as T
+    from cxxnet_tpu.serve.decode import DecodeService
+
+    cfg = T.TransformerConfig(vocab_size=512, d_model=128, num_heads=8,
+                              d_ff=512, num_stages=2, seq_len=512,
+                              attn='local')
+    params = T.init_params(np.random.RandomState(0), cfg)
+    ps = args.page_size
+    plen = 31 * ps
+    total = plen + ps
+    max_new = int(os.environ.get('CXXNET_SERVE_BENCH_PREFIX_MAX_NEW', 2))
+    pages = max(args.pages, 384)
+    rng = np.random.RandomState(args.seed)
+    prefix = rng.randint(0, cfg.vocab_size, (1, plen)).astype(np.int32)
+    prompts = []
+    for i in range(args.requests):
+        if i % 10 == 9:                            # the 10% cold minority
+            prompts.append(rng.randint(0, cfg.vocab_size,
+                                       (1, total)).astype(np.int32))
+        else:
+            tail = rng.randint(0, cfg.vocab_size, (1, ps)).astype(np.int32)
+            prompts.append(np.concatenate([prefix, tail], axis=1))
+
+    def leg(share: bool) -> dict:
+        svc = DecodeService(
+            params, cfg, slots=args.slots, pages=pages,
+            page_size=ps, max_prompt=total,
+            max_new_bound=max_new, max_queue=2 * args.requests,
+            deadline=600.0, prefix_share=pages // 2 if share else 0)
+        try:
+            # warmup outside the clock: compiles prefill + tail-prefill
+            # + the step (and, with sharing on, publishes the prefix —
+            # the pay-once half of the amortization thesis)
+            for p in prompts[:2]:
+                svc.batcher.wait(svc.submit_async(p, max_new))
+            toks, wall, ttft, checked = _drive_leg(svc, prompts, max_new)
+            st = svc.engine.stats
+            return {
+                'prefix_share': bool(share),
+                'tokens_per_sec': round(toks / wall, 2),
+                'ttft_p50_ms': round(float(np.quantile(ttft, 0.5)), 3),
+                'ttft_p99_ms': round(float(np.quantile(ttft, 0.99)), 3),
+                'wall_sec': round(wall, 3),
+                'streams': len(prompts), 'twin_checked': checked,
+                'prefix_hits': int(st.get('prefix_hits')),
+                'prefix_misses': int(st.get('prefix_misses')),
+                'cow_copies': int(st.get('cow_copies')),
+                'shared_page_splices': int(st.get('prefix_hit_pages')),
+                'free_pages_min': int(svc.engine._free_min),
+            }
+        finally:
+            svc.close(60)
+
+    on, off = leg(True), leg(False)
+    return {
+        'metric': 'prefix_share_speedup',
+        'value': round(on['tokens_per_sec'] / off['tokens_per_sec'], 2),
+        'unit': 'x',
+        'on': on, 'off': off,
+        'shared_fraction': 0.9, 'prefix_pages': 31,
+        'prompt_tokens': total,
+        'model': {'vocab': cfg.vocab_size, 'd_model': cfg.d_model,
+                  'heads': cfg.num_heads, 'd_ff': cfg.d_ff,
+                  'stages': cfg.num_stages},
+        'requests': args.requests, 'max_new': max_new,
+        'page_size': ps, 'slots': args.slots,
+        'platform': jax.default_backend(),
+    }
+
+
+def bench_spec(args) -> dict:
+    """Greedy speculative decoding: draft-off baseline vs a cold small
+    draft vs the self-speculation twin draft (acceptance upper bound),
+    one seeded workload, every stream twin-asserted token-equal."""
+    import jax
+    from cxxnet_tpu.models import transformer as T
+    from cxxnet_tpu.serve.decode import DecodeService
+
+    params, cfg = _decode_model()
+    dcfg = T.TransformerConfig(vocab_size=cfg.vocab_size, d_model=16,
+                               num_heads=2, d_ff=32, num_stages=1,
+                               seq_len=cfg.seq_len, attn='local')
+    dparams = T.init_params(np.random.RandomState(1), dcfg)
+    rng = np.random.RandomState(args.seed)
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           (1, int(rng.randint(2, args.max_prompt))))
+               .astype(np.int32) for _ in range(args.requests)]
+
+    def leg(name: str, draft, spec_k: int) -> dict:
+        svc = DecodeService(
+            params, cfg, slots=args.slots, pages=args.pages,
+            page_size=args.page_size, max_prompt=args.max_prompt,
+            max_new_bound=args.max_new, max_queue=2 * args.requests,
+            deadline=600.0, spec_k=spec_k, draft=draft)
+        try:
+            svc.batcher.wait(svc.submit_async(prompts[0], args.max_new))
+            toks, wall, _, checked = _drive_leg(svc, prompts,
+                                                args.max_new)
+            st = svc.engine.stats
+            proposed = st.get('spec_proposed')
+            return {
+                'draft': name,
+                'tokens_per_sec': round(toks / wall, 2),
+                'wall_sec': round(wall, 3),
+                'streams': len(prompts), 'twin_checked': checked,
+                'spec_k': spec_k,
+                'spec_proposed': int(proposed),
+                'spec_accepted': int(st.get('spec_accepted')),
+                'acceptance_rate': round(
+                    st.get('spec_accepted') / proposed, 3)
+                if proposed else None,
+                'decode_steps': int(st.get('decode_steps')),
+            }
+        finally:
+            svc.close(60)
+
+    legs = [leg('off', None, 0),
+            leg('small', (dparams, dcfg), args.spec_k),
+            leg('twin', (params, cfg), args.spec_k)]
+    base = legs[0]['tokens_per_sec']
+    best = max(legs[1:], key=lambda leg_: leg_['tokens_per_sec'])
+    out = {
+        'metric': 'spec_decode_speedup',
+        'value': round(best['tokens_per_sec'] / base, 2),
+        'unit': 'x',
+        'best_draft': best['draft'],
+        'legs': legs,
+        'requests': args.requests, 'max_new': args.max_new,
+        'spec_k': args.spec_k, 'slots': args.slots,
+        'platform': jax.default_backend(),
+    }
+    if out['platform'] == 'cpu':
+        # random-init models make any CHEAPER draft disagree with the
+        # target (acceptance ~0), and on compute-bound CPU the verify
+        # window saves no arithmetic — the same receipt-reading rule as
+        # BENCH_SERVE_r03's flash rows: cpu legs prove token-equality
+        # and report acceptance; the speed claim is the on-chip one
+        # (one K-window pass costs ~one step of HBM weight traffic)
+        out['note'] = ('cpu legs prove correctness + acceptance '
+                       'accounting, not speed; see doc/benchmarks.md')
+    return out
+
+
+def bench_prefix_spec(args) -> dict:
+    """The BENCH_SERVE_r04 receipt: both multipliers over one config —
+    the prefix-share A/B (headline) plus the spec-decode legs."""
+    prefix = bench_prefix(args)
+    spec = bench_spec(args)
+    return {
+        'metric': 'prefix_share_speedup',
+        'value': prefix['value'],
+        'unit': 'x',
+        'prefix': prefix,
+        'spec': spec,
+        'platform': prefix['platform'],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('mode', nargs='?', default='predict',
-                    choices=('predict', 'decode', 'decode_matrix'))
+                    choices=('predict', 'decode', 'decode_matrix',
+                             'prefix', 'spec', 'prefix_spec'))
     ap.add_argument('--clients', type=int, default=int(
         os.environ.get('CXXNET_SERVE_BENCH_CLIENTS', 8)))
     ap.add_argument('--duration', type=float, default=float(
@@ -383,6 +601,8 @@ def main(argv=None) -> int:
     ap.add_argument('--requests', type=int, default=int(
         os.environ.get('CXXNET_SERVE_BENCH_REQUESTS', 12)))
     ap.add_argument('--twin-checks', type=int, default=2)
+    ap.add_argument('--spec-k', type=int, default=int(
+        os.environ.get('CXXNET_SERVE_BENCH_SPEC_K', 4)))
     ap.add_argument('--seed', type=int, default=7)
     args = ap.parse_args(argv)
 
@@ -391,10 +611,15 @@ def main(argv=None) -> int:
         return _cpu_fallback(argv, f'TPU backend unavailable within '
                                    f'{budget:.0f}s')
     modes = {'predict': bench_predict, 'decode': bench_decode,
-             'decode_matrix': bench_decode_matrix}
+             'decode_matrix': bench_decode_matrix,
+             'prefix': bench_prefix, 'spec': bench_spec,
+             'prefix_spec': bench_prefix_spec}
     metrics = {'predict': 'serve_p99_latency_ms',
                'decode': 'decode_tokens_per_sec',
-               'decode_matrix': 'decode_int8_resident_reduction'}
+               'decode_matrix': 'decode_int8_resident_reduction',
+               'prefix': 'prefix_share_speedup',
+               'spec': 'spec_decode_speedup',
+               'prefix_spec': 'prefix_share_speedup'}
     try:
         out = modes[args.mode](args)
     except Exception as e:  # structured failure, never a bare traceback

@@ -264,52 +264,19 @@ def net_online_stop(net: Net) -> None:
 
 def lm_serve_start(cfg: str):
     """Stand up the continuous-batching decode stack (doc/serving.md
-    "Continuous decode") for a transformer LM.  ``cfg`` is a compact
-    ``k=v[;k=v...]`` list: model spec ``vocab``/``d_model``/``heads``/
-    ``d_ff``/``stages``/``experts``, params from ``model_in`` (a
-    ``%04d.lm`` tree) or ``seed`` init, engine shape ``slots``/``pages``/
-    ``page_size``/``max_prompt``/``max_new``/``eos``, batcher knobs
-    ``max_queue``/``max_wait``/``deadline``, serving tier ``dtype``
-    (``f32``/``bf16``/``int8``) and attention leg ``flash_decode``
-    (``auto``/``0``/``1``).  Returns the service handle the other
-    ``lm_serve_*`` calls take."""
-    import numpy as np
-
-    from .models import transformer as T
-    from .serve.decode import DecodeService, load_lm_params
-    from .utils.config import parse_kv_list
-    cfg_kw = {'attn': 'local'}
-    svc_kw = {}
-    seed, model_in, eos = 0, None, None
-    names = {'vocab': 'vocab_size', 'd_model': 'd_model',
-             'heads': 'num_heads', 'd_ff': 'd_ff', 'stages': 'num_stages',
-             'experts': 'num_experts', 'seq': 'seq_len'}
-    ints = ('slots', 'pages', 'page_size', 'max_prompt', 'max_queue')
-    for key, val in parse_kv_list(cfg or ''):
-        if key in names:
-            cfg_kw[names[key]] = int(val)
-        elif key in ints:
-            svc_kw[key] = int(val)
-        elif key == 'max_new':
-            svc_kw['max_new_bound'] = int(val)
-        elif key in ('max_wait', 'deadline'):
-            svc_kw[key] = float(val)
-        elif key == 'seed':
-            seed = int(val)
-        elif key == 'model_in':
-            model_in = val
-        elif key == 'eos':
-            eos = None if int(val) < 0 else int(val)
-        elif key == 'dtype':
-            svc_kw['dtype'] = val
-        elif key == 'flash_decode':
-            svc_kw['flash_decode'] = val
-        else:
-            raise ValueError(f'unknown lm_serve option: {key!r}')
-    tcfg = T.TransformerConfig(**cfg_kw)
-    params = (load_lm_params(model_in) if model_in
-              else T.init_params(np.random.RandomState(seed), tcfg))
-    return DecodeService(params, tcfg, eos_id=eos, **svc_kw)
+    "Continuous decode") for a transformer LM.  ``cfg`` is the compact
+    ``k=v[;k=v...]`` spec :class:`wrapper.LMServe` parses: model spec
+    ``vocab``/``d_model``/``heads``/``d_ff``/``stages``/``experts``,
+    params from ``model_in`` (a ``%04d.lm`` tree) or ``seed`` init,
+    engine shape ``slots``/``pages``/``page_size``/``max_prompt``/
+    ``max_new``/``eos``, batcher knobs ``max_queue``/``max_wait``/
+    ``deadline``, serving tier ``dtype`` (``f32``/``bf16``/``int8``),
+    attention leg ``flash_decode`` (``auto``/``0``/``1``), prefix
+    sharing ``prefix_share`` (index page cap, 0 = off), and greedy
+    speculative decoding ``spec_k`` + ``draft.*`` draft-model keys.
+    Returns the service handle the other ``lm_serve_*`` calls take."""
+    from .wrapper import LMServe
+    return LMServe.from_spec(cfg)
 
 
 def lm_serve_generate(svc, prompt_mv, n: int, max_new: int,

@@ -92,6 +92,9 @@ class LearnTask:
         self.serve_mem_budget = 0      # serve.mem_budget bytes (0 = off)
         self.serve_dtype = 'f32'       # serve.dtype: f32 | bf16 | int8
         self.serve_flash = 'auto'      # serve.flash_decode: auto | 0 | 1
+        self.serve_prefix_share = 0    # serve.prefix_share index pages (0=off)
+        self.serve_spec_k = 0          # serve.spec_k window width (0/1=off)
+        self.serve_draft = ''          # serve.draft spec (k=v;... like serve.lm)
         # train-while-serve (task=online, doc/online.md); batcher shape
         # comes from the serve.* keys above
         self.online_save_every = 8     # online.save_every steps/checkpoint
@@ -163,6 +166,9 @@ class LearnTask:
             'serve.mem_budget': ('serve_mem_budget', int),
             'serve.dtype': ('serve_dtype', str),
             'serve.flash_decode': ('serve_flash', str),
+            'serve.prefix_share': ('serve_prefix_share', int),
+            'serve.spec_k': ('serve_spec_k', int),
+            'serve.draft': ('serve_draft', str),
             'dist.hosts': ('dist_hosts', int),
             'dist.rank': ('dist_rank', int),
             'dist.coordinator': ('dist_coordinator', str),
@@ -848,34 +854,49 @@ class LearnTask:
             pipe.close(timeout=30.0)
         print(f'finished online run, {int(time.monotonic() - start)} sec in all')
 
-    def _lm_spec(self):
-        """Build the decode model: ``serve.lm`` is a compact
-        ``k=v[;k=v...]`` TransformerConfig spec (vocab, d_model, heads,
-        d_ff, stages, experts, seq); params come from
-        ``serve.lm_model_in`` (a ``%04d.lm`` tree written by
-        ``serve.save_lm_params``) or a seeded init."""
+    def _parse_lm_spec(self, spec: str, model_in: str = 'NULL',
+                       seed: int = 0, default_vocab=None):
+        """Build a transformer (params, cfg) from a compact
+        ``k=v[;k=v...]`` spec (vocab, d_model, heads, d_ff, stages,
+        experts, seq, plus inline ``model_in=``/``seed=`` overrides);
+        params come from a ``%04d.lm`` tree or a seeded init.  Shared by
+        ``serve.lm`` (the target) and ``serve.draft`` (the speculative-
+        decode draft, whose vocab defaults to the target's)."""
         import numpy as np
 
         from .models import transformer as TT
         from .utils.config import parse_kv_list
         kw = {'attn': 'local'}
+        if default_vocab is not None:
+            kw['vocab_size'] = int(default_vocab)
         names = {'vocab': ('vocab_size', int), 'd_model': ('d_model', int),
                  'heads': ('num_heads', int), 'd_ff': ('d_ff', int),
                  'stages': ('num_stages', int), 'seq': ('seq_len', int),
                  'experts': ('num_experts', int)}
-        for key, val in parse_kv_list(self.serve_lm or ''):
-            if key not in names:
-                raise ValueError(f'unknown serve.lm key: {key!r}')
-            attr, typ = names[key]
-            kw[attr] = typ(val)
+        for key, val in parse_kv_list(spec or ''):
+            if key == 'model_in':
+                model_in = val
+            elif key == 'seed':
+                seed = int(val)
+            elif key in names:
+                attr, typ = names[key]
+                kw[attr] = typ(val)
+            else:
+                raise ValueError(f'unknown lm spec key: {key!r}')
         cfg = TT.TransformerConfig(**kw)
-        if self.serve_lm_model_in != 'NULL':
+        if model_in != 'NULL':
             from .serve.decode import load_lm_params
-            params = load_lm_params(self.serve_lm_model_in)
+            params = load_lm_params(model_in)
         else:
-            params = TT.init_params(
-                np.random.RandomState(self.serve_lm_seed), cfg)
+            params = TT.init_params(np.random.RandomState(seed), cfg)
         return params, cfg
+
+    def _lm_spec(self):
+        """The decode target model from ``serve.lm`` /
+        ``serve.lm_model_in`` / ``serve.lm_seed``."""
+        return self._parse_lm_spec(self.serve_lm,
+                                   model_in=self.serve_lm_model_in,
+                                   seed=self.serve_lm_seed)
 
     def task_serve_decode(self) -> None:
         """``task=serve serve.mode=decode``: the continuous-batching
@@ -892,6 +913,12 @@ class LearnTask:
         from .serve.decode import DecodeService
 
         params, cfg = self._lm_spec()
+        draft = None
+        if self.serve_draft:
+            # the draft rides the same spec grammar; its vocab defaults
+            # to the target's (the verify window compares token ids)
+            draft = self._parse_lm_spec(self.serve_draft,
+                                        default_vocab=cfg.vocab_size)
         svc = DecodeService(
             params, cfg, slots=self.serve_slots, pages=self.serve_pages,
             page_size=self.serve_page_size,
@@ -902,13 +929,17 @@ class LearnTask:
             # bulk drive: throughput-bound, not latency-bound (the same
             # reasoning as the predict drive's bulk_deadline)
             deadline=max(self.serve_deadline, 60.0),
-            dtype=self.serve_dtype, flash_decode=self.serve_flash)
+            dtype=self.serve_dtype, flash_decode=self.serve_flash,
+            prefix_share=self.serve_prefix_share,
+            spec_k=self.serve_spec_k, draft=draft)
         if not self.silent:
             print(f'serve: decode engine up — {self.serve_slots} slots, '
                   f'{self.serve_pages}x{self.serve_page_size}-token KV '
                   f'pages (slot cache {svc.engine.cache_len}, '
                   f'dtype={svc.engine.serve_dtype}, '
                   f'attention={"flash" if svc.engine.use_flash else "gather"}'
+                  f', prefix_share={self.serve_prefix_share}'
+                  f', spec_k={svc.engine._spec_k}'
                   f')', flush=True)
         print('start serving (decode)...')
         rng = np.random.RandomState(self.serve_seed)

@@ -576,6 +576,162 @@ def prefill_kv(params, prompt, w, cfg: TransformerConfig):
     return jnp.stack(ks), jnp.stack(vs), logits0
 
 
+def prefill_tail_kv(params, prefix_ks, prefix_vs, tail, w,
+                    cfg: TransformerConfig):
+    """Prefix-shared prompt prefill: run ONLY the prompt's tail through
+    the block walk, attending over the already-cached prefix K/V
+    (serve/decode.py "Prefix sharing" — the prefix rows came out of an
+    earlier request's :func:`prefill_kv` over the identical token span,
+    so recomputing them would be pure waste).
+
+    ``prefix_ks``/``prefix_vs``: (num_stages, b, t0, heads, hd) cache
+    rows for positions ``[0, t0)``.  ``tail``: (b, tt) int32 tokens at
+    positions ``[t0, t0 + tt)`` — every tail position must be a REAL
+    token (the caller only shares prefixes that cover all bucket-pad
+    slots, so ``t0 >= w``).  ``w`` is the traced left-pad width.
+
+    Deliberately mirrors :func:`prefill_kv`'s math — ``_local_attention``
+    in the operand dtype, ``_gen_ffn(gather=False)``, the same mask rule
+    for real queries — so the tail rows and last-position logits are the
+    ones the full prefill would have produced (row-for-row: each tail
+    query's softmax sees exactly the positions ``[w, pos]``).  Returns
+    ``(ks_tail, vs_tail, logits0)``: the (num_stages, b, tt, heads, hd)
+    cache rows for the tail positions and the (b, vocab) f32 logits of
+    the last position."""
+    b, tt = tail.shape
+    t0 = prefix_ks.shape[2]
+    hd = cfg.d_model // cfg.num_heads
+    h = qtake(params['embed'], tail)
+    # query i sits at global position t0 + i; it attends cache positions
+    # [w, t0 + i] — the same set full prefill's mask grants a real query
+    gq = t0 + jnp.arange(tt)
+    ar = jnp.arange(t0 + tt)
+    mask = ((ar[None, :] <= gq[:, None])
+            & (ar[None, :] >= w))[None, None]
+    ks, vs = [], []
+    for i in range(cfg.num_stages):
+        p = jax.tree.map(lambda a, i=i: a[i], params['stages'])
+        y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
+        q = qdot(y, p['wq']).reshape(b, tt, cfg.num_heads, hd)
+        k = qdot(y, p['wk']).reshape(b, tt, cfg.num_heads, hd)
+        v = qdot(y, p['wv']).reshape(b, tt, cfg.num_heads, hd)
+        kf = jnp.concatenate([prefix_ks[i], k], axis=1)
+        vf = jnp.concatenate([prefix_vs[i], v], axis=1)
+        attn = _local_attention(q, kf, vf, 1.0 / math.sqrt(hd), mask)
+        h = h + qdot(attn.reshape(b, tt, cfg.d_model), p['wo'])
+        y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
+        ks.append(k)
+        vs.append(v)
+        h = h + _gen_ffn(cfg, p, y2, gather=False)
+    logits0 = qdot(h[:, -1], params['head']).astype(jnp.float32)
+    return jnp.stack(ks), jnp.stack(vs), logits0
+
+
+def verify_step(params, cfg: TransformerConfig, toks, kc, vc, t, w):
+    """A (b, K)-token WINDOW through the decode block walk in one pass —
+    the speculative-decoding verify entry (serve/decode.py "Speculative
+    decoding") and the multi-token generalization of :func:`decode_step`
+    (K=1 reduces to the same shapes and cast points).
+
+    ``toks``: (b, K) int32, the tokens consumed at positions
+    ``[t, t + K)`` per row (window slot k consumes ``toks[:, k]`` at
+    position ``t + k``).  ``kc``/``vc``: dense (num_stages, b, total,
+    heads, hd) caches; all K rows are written before attending, and
+    window query ``k`` masks the cache to ``[w, t + k]`` — its own row
+    and earlier, never a later draft's — so each window position
+    computes exactly what a sequential :func:`decode_step` at that
+    position would (the greedy spec-decode token-equality hinges on
+    this; the masking rule is the same ``(ar <= t) & (ar >= w)`` with
+    ``t`` per query).  ``t``/``w`` are (b,) int32 per-row vectors.
+
+    Returns ``(logits, kc, vc, knew, vnew)``: logits (b, K, vocab) f32 —
+    row k is the next-token distribution after consuming window slots
+    ``0..k`` — and knew/vnew (num_stages, b, K, heads, hd), the rows
+    written at ``[t, t + K)`` (the paged engine scatters those into its
+    page pool)."""
+    total = kc.shape[2]
+    b, K = toks.shape
+    hd = cfg.d_model // cfg.num_heads
+    scale = 1.0 / math.sqrt(hd)
+    ar = jnp.arange(total)
+    tq = t[:, None] + jnp.arange(K)[None, :]               # (b, K)
+    live = ((ar[None, None, :] <= tq[:, :, None])
+            & (ar[None, None, :] >= w[:, None, None]))[:, None]  # (b,1,K,T)
+    state = {'kc': kc, 'vc': vc}
+    knews, vnews = [], []
+    bi = jnp.arange(b)[:, None]
+
+    def attend(i, p, q, k, v):
+        kc = state['kc'].at[i, bi, tq].set(k)
+        vc = state['vc'].at[i, bi, tq].set(v)
+        state['kc'], state['vc'] = kc, vc
+        ki, vi = kc[i], vc[i]
+        s_ = jnp.einsum('bqhd,bkhd->bhqk', q, ki) * scale
+        s_ = jnp.where(live, s_, -jnp.inf)
+        knews.append(k)
+        vnews.append(v)
+        return jnp.einsum(
+            'bhqk,bkhd->bqhd',
+            jax.nn.softmax(s_.astype(jnp.float32),
+                           axis=-1).astype(ki.dtype), vi)
+
+    logits = _window_tokens(params, cfg, toks, attend)
+    return (logits, state['kc'], state['vc'], jnp.stack(knews),
+            jnp.stack(vnews))
+
+
+def verify_step_paged(params, cfg: TransformerConfig, toks, kpool, vpool,
+                      table, t, w):
+    """:func:`verify_step` straight over the PAGED pool — the flash twin
+    (``serve.flash_decode``): each stage scatters its K new K/V rows
+    into their physical pages and hands attention to
+    ``ops.pallas_kernels.paged_flash_verify``, which reads the pages in
+    place with the same per-query live masking.  Returns
+    ``(logits, kpool, vpool)`` (the new rows are already in the pool).
+    Bitwise-equal to gather + :func:`verify_step` (pinned in
+    tests/test_serve_spec.py)."""
+    from ..ops.pallas_kernels import paged_flash_verify
+    b, K = toks.shape
+    ps = kpool.shape[2]
+    hd = cfg.d_model // cfg.num_heads
+    scale = 1.0 / math.sqrt(hd)
+    tq = t[:, None] + jnp.arange(K)[None, :]               # (b, K)
+    page = table[jnp.arange(b)[:, None], tq // ps]
+    off = tq % ps
+    state = {'k': kpool, 'v': vpool}
+
+    def attend(i, p, q, k, v):
+        kp = state['k'].at[i, page, off].set(k)
+        vp = state['v'].at[i, page, off].set(v)
+        state['k'], state['v'] = kp, vp
+        return paged_flash_verify(q, kp[i], vp[i], table, t, w, scale)
+
+    logits = _window_tokens(params, cfg, toks, attend)
+    return logits, state['k'], state['v']
+
+
+def _window_tokens(params, cfg: TransformerConfig, toks, attend):
+    """The (b, K)-window block walk shared by :func:`verify_step` and
+    :func:`verify_step_paged` — :func:`_decode_token`'s body widened to
+    K tokens (same projection/FFN/head call sites, ``attend`` supplies
+    the cache write + attention per stage), with the head applied to
+    EVERY window position instead of just the last."""
+    b, K = toks.shape
+    hd = cfg.d_model // cfg.num_heads
+    h = qtake(params['embed'], toks)
+    for i in range(cfg.num_stages):
+        p = jax.tree.map(lambda a, i=i: a[i], params['stages'])
+        y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
+        q = qdot(y, p['wq']).reshape(b, K, cfg.num_heads, hd)
+        k = qdot(y, p['wk']).reshape(b, K, cfg.num_heads, hd)
+        v = qdot(y, p['wv']).reshape(b, K, cfg.num_heads, hd)
+        attn = attend(i, p, q, k, v)
+        h = h + qdot(attn.reshape(b, K, cfg.d_model), p['wo'])
+        y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
+        h = h + _gen_ffn(cfg, p, y2, gather=True)
+    return qdot(h, params['head']).astype(jnp.float32)
+
+
 def _decode_token(params, cfg: TransformerConfig, tok, attend):
     """THE per-token block walk — embed -> [ln1 -> qkv -> attend -> out
     proj -> ln2 -> ffn] per stage -> head.  ``attend(i, p, q, k, v)``

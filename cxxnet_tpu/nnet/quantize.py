@@ -57,7 +57,8 @@ import numpy as np
 
 __all__ = ['QuantLeaf', 'quantize_leaf', 'quantize_tree',
            'dequantize_tree', 'qdot', 'qtake', 'tree_nbytes',
-           'parse_serve_dtype', 'SERVE_DTYPES', 'LM_MATMUL_KEYS']
+           'parse_serve_dtype', 'SERVE_DTYPES', 'LM_MATMUL_KEYS',
+           'quantize_lm_tree']
 
 SERVE_DTYPES = ('f32', 'bf16', 'int8')
 
@@ -186,6 +187,17 @@ def quantize_tree(tree, mode: str, *, out_dtype=None, quant_key=None):
         return jnp.asarray(leaf, out_dtype)
 
     return _map_named(one, tree)
+
+
+def quantize_lm_tree(tree, mode: str, *, out_dtype=None):
+    """Quantize a transformer param tree into its serving tier under the
+    LM matmul-leaf rule — the one call the decode engine makes for BOTH
+    its target and its speculative-decode draft tree (serve/decode.py),
+    so the two models always land on the same storage tier and the
+    greedy verify math consumes them through the identical ``qdot``
+    dispatch."""
+    return quantize_tree(tree, mode, out_dtype=out_dtype,
+                         quant_key=lm_quant_key)
 
 
 def dequantize_tree(tree, dtype=None):

@@ -985,6 +985,89 @@ def paged_flash_decode(q, kpool, vpool, table, pos, w, scale):
     )(table, pos, w, q, kpool, vpool)
 
 
+def _paged_verify_kernel(table_ref, pos_ref, w_ref, q_ref, k_ref, v_ref,
+                         o_ref, s_scr, v_scr, *, scale, ps, pp, K):
+    """Grid (slots, pages_per_slot): the K-query window extension of
+    :func:`_paged_decode_kernel` (speculative-decode verify / prefix-
+    shared tail, serve/decode.py).  Page j of slot s is DMA'd from
+    physical page ``table[s, j]``; its per-query scores land in the
+    (K, H, T) score scratch as exact slice writes; the last page step
+    applies the PER-QUERY live mask — window query k sees cache
+    positions ``[w, pos + k]``, its own row and earlier drafts, never a
+    later one — and runs one full-width softmax + value contraction per
+    query, mirroring the dense ``verify_step`` ops (same einsum shapes,
+    same f32 cast points) so the two legs are bitwise-equal."""
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    q = q_ref[0]                                   # (K, H, hd)
+    s_scr[:, :, pl.ds(j * ps, ps)] = jnp.einsum('qhd,khd->qhk', q,
+                                                k_ref[0])
+    v_scr[pl.ds(j * ps, ps)] = v_ref[0]
+
+    @pl.when(j == pp - 1)
+    def _finalize():
+        t = pos_ref[s]
+        wv = w_ref[s]
+        ar = jax.lax.broadcasted_iota(jnp.int32, (K, 1, pp * ps), 2)
+        kq = jax.lax.broadcasted_iota(jnp.int32, (K, 1, pp * ps), 0)
+        live = (ar <= t + kq) & (ar >= wv)         # (K, 1, T)
+        sc = s_scr[:] * scale
+        sc = jnp.where(live, sc, -jnp.inf)
+        p = jax.nn.softmax(sc.astype(jnp.float32), axis=-1
+                           ).astype(v_scr.dtype)
+        o_ref[0] = jnp.einsum('qhk,khd->qhd', p, v_scr[:])
+
+
+def paged_flash_verify(q, kpool, vpool, table, pos, w, scale):
+    """A K-token verify window's attention for every slot, in place over
+    the paged pool — :func:`paged_flash_decode` widened to multi-query
+    (serve/decode.py "Speculative decoding" / prefix-shared tail
+    prefill).
+
+    ``q``: (S, K, H, hd) — each slot's K window queries, query k at
+    position ``pos[s] + k``.  ``kpool``/``vpool``: (P, ps, H, hd) — ONE
+    stage's physical page pool (the window's K/V rows must already be
+    scattered in at ``[pos, pos + K)``).  ``table``: (S, pp) int32 page
+    table.  ``pos``/``w``: (S,) int32 per-slot window start and left-pad
+    width.  Returns (S, K, H, hd), bitwise-equal to gathering
+    ``kpool[table]`` dense and running ``transformer.verify_step``'s
+    attention (the per-query mask is the verify-step rule:
+    ``[w, pos + k]``)."""
+    S, K, H, hd = q.shape
+    P, ps = kpool.shape[0], kpool.shape[1]
+    pp = table.shape[1]
+    if pltpu is None:          # pragma: no cover - exotic installs only
+        raise RuntimeError(
+            'paged_flash_verify needs TPU memory spaces '
+            '(jax.experimental.pallas.tpu unavailable); gate callers on '
+            'decode_use_flash()')
+    kernel = functools.partial(_paged_verify_kernel, scale=scale, ps=ps,
+                               pp=pp, K=K)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, pp),
+        in_specs=[
+            pl.BlockSpec((1, K, H, hd),
+                         lambda s, j, tr, pr, wr: (s, 0, 0, 0)),
+            pl.BlockSpec((1, ps, H, hd),
+                         lambda s, j, tr, pr, wr: (tr[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, ps, H, hd),
+                         lambda s, j, tr, pr, wr: (tr[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, K, H, hd),
+                               lambda s, j, tr, pr, wr: (s, 0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((K, H, pp * ps), q.dtype),
+                        pltpu.VMEM((pp * ps, H, hd), vpool.dtype)],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((S, K, H, hd), vpool.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+        **_compiler_params('parallel', 'arbitrary'),
+    )(table, pos, w, q, kpool, vpool)
+
+
 # --- int8 matmul (quantized inference tier, nnet/quantize.py) --------------
 
 def _int8_matmul_kernel(a_ref, b_ref, o_ref, acc_ref):

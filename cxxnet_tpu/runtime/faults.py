@@ -205,6 +205,23 @@ class DecodePagesExhaustedError(ServeError):
             f'after {tokens_emitted} tokens')
 
 
+class PrefixIndexFullError(ServeError):
+    """A prompt's shareable prefix pages could not be published to the
+    content-addressed prefix index: the configured page cap
+    (``serve.prefix_share``) is smaller than the publish batch itself,
+    so even evicting every reusable entry cannot make room.  An
+    *observability* outcome, not a request error: the admission path
+    records it and serves the request unshared — sharing degrades, the
+    stream does not."""
+
+    def __init__(self, needed: int, cap: int):
+        self.needed = int(needed)
+        self.cap = int(cap)
+        super().__init__(
+            f'prefix index cannot hold {needed} pages '
+            f'(serve.prefix_share cap is {cap}): request served unshared')
+
+
 class FreshnessSLOError(ServeError):
     """The train-while-serve freshness SLO was breached: a hot-swapped
     model version took longer than ``online.freshness_slo`` seconds to

@@ -87,7 +87,8 @@ class DynamicBatcher:
     """
 
     def __init__(self, engine, max_queue: int = 64, max_wait: float = 0.002,
-                 deadline: float = 1.0, stats: Optional[StatSet] = None):
+                 deadline: float = 1.0, stats: Optional[StatSet] = None,
+                 cost_fn=None, max_cost: int = 0):
         if max_queue <= 0:
             raise ValueError('max_queue must be positive')
         self.engine = engine
@@ -95,6 +96,15 @@ class DynamicBatcher:
         self.max_wait = float(max_wait)
         self.deadline = float(deadline)
         self.max_batch = int(engine.buckets[-1])
+        # optional per-request admission pricing (decode engines with
+        # prefix sharing expose ``prefill_cost``): the coalescing window
+        # ALSO closes when accumulated cost would pass ``max_cost``, so
+        # a window prices prefix-hit prompts at their tails instead of
+        # treating every request as one equally-expensive row
+        if max_cost > 0 and cost_fn is None:
+            raise ValueError('max_cost needs a cost_fn')
+        self.cost_fn = cost_fn
+        self.max_cost = int(max_cost)
         # engines that own request completion (the decode engine admits
         # requests into slots and finishes them from its own loop) expose
         # execute_requests; the default predict path stays synchronous
@@ -161,9 +171,13 @@ class DynamicBatcher:
 
     def _gather(self, first: ServeRequest) -> List[ServeRequest]:
         """Coalesce from the queue behind ``first`` until the window
-        closes or the next request would overflow ``max_batch``."""
+        closes, the next request would overflow ``max_batch``, or —
+        with a ``cost_fn`` — accumulated admission cost would pass
+        ``max_cost`` (the first request always rides regardless of its
+        cost)."""
         batch = [first]
         rows = first.n
+        cost = self.cost_fn(first) if self.cost_fn is not None else 0
         window_end = time.monotonic() + self.max_wait
         while rows < self.max_batch:
             with self._cond:
@@ -176,6 +190,12 @@ class DynamicBatcher:
                         continue   # spurious wake or window check
                 if self._q[0].n + rows > self.max_batch:
                     break          # preserve order: don't skip ahead
+                if self.max_cost > 0:
+                    nxt_cost = self.cost_fn(self._q[0])
+                    if cost + nxt_cost > self.max_cost:
+                        self.stats.inc('cost_closed')
+                        break      # preserve order: don't skip ahead
+                    cost += nxt_cost
                 nxt = self._q.popleft()
             if nxt.abandoned:      # caller gave up and counted the shed
                 nxt.event.set()

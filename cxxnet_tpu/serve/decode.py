@@ -30,6 +30,30 @@ program that requests join and leave at token boundaries:
   ``transformer.generate`` call with the same seed, no matter when it
   joined the running loop or who shared its steps.
 
+Two serving multipliers ride the same pool (ROADMAP item 2):
+
+* **prefix sharing** (``serve.prefix_share``, doc/serving.md "Prefix
+  sharing") — a content-addressed index maps (model version, pad width,
+  logical page, exact token span) -> physical page for every FULL
+  prompt page a prefill produced.  A new request whose prompt prefix
+  hits the index splices the shared physical pages into its page table
+  (refcounted — a page frees only when its last referencing page table
+  AND the index let go) and prefills only the tail, attending over the
+  shared rows; full shared pages are immutable by construction (decode
+  writes only at positions past the prompt bucket), and the one
+  partially-filled last page is privately rematerialized by the tail
+  prefill — the copy-on-write rule.  N requests sharing a system
+  prompt cost ONE prefill and one set of pages,
+* **greedy speculative decoding** (``serve.draft``/``serve.spec_k``,
+  doc/serving.md "Speculative decoding") — a small draft model
+  proposes K-1 tokens per slot from its own dense per-slot cache; the
+  target verifies the whole (slots, K) window in ONE multi-token step
+  (``transformer.verify_step``) and accepts the longest agreeing prefix
+  plus one corrected token.  Every accepted token is the target's own
+  greedy argmax at its position, so the stream is TOKEN-EQUAL to the
+  target decoding alone — the bitwise-twin discipline holds with a
+  draft bolted on, on every ``serve.dtype`` tier.
+
 The attention itself has two legs behind ``serve.flash_decode``
 (doc/serving.md "Flash paged decode"): the gather path materializes each
 slot's pages into a dense (T, heads, hd) view per step, while the Pallas
@@ -61,7 +85,8 @@ from ..models import transformer as T
 from ..nnet import quantize
 from ..ops import pallas_kernels as PK
 from ..runtime.faults import (DeadlineExceededError, DecodePagesExhaustedError,
-                              DecodeSlotsExhaustedError, ServeError,
+                              DecodeSlotsExhaustedError,
+                              PrefixIndexFullError, ServeError,
                               TokenDeadlineExceededError)
 from ..utils.metric import StatSet
 
@@ -71,6 +96,15 @@ __all__ = ['DecodeEngine', 'DecodeService', 'save_lm_params',
 
 def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
+
+
+def _prompt_bucket(s0: int) -> int:
+    """The engine's prompt size-class — ``generate()``'s bucketing rule
+    in ONE place, so admission (``_admit``) and the batcher's pricing
+    (``prefill_cost``) can never disagree about a prompt's bucket."""
+    if os.environ.get('CXXNET_GEN_BUCKETS', '1') != '0':
+        return T._size_class(s0, floor=8)
+    return s0
 
 
 class _Slot:
@@ -131,12 +165,19 @@ class DecodeEngine:
                  page_size: int = 16, max_prompt: int = 64,
                  max_new_bound: int = 64, eos_id: Optional[int] = None,
                  stats: Optional[StatSet] = None, name: str = 'lm',
-                 dtype: str = 'f32', flash_decode=None):
+                 dtype: str = 'f32', flash_decode=None,
+                 prefix_share: int = 0, spec_k: int = 0, draft=None):
         if not cfg.causal:
             raise ValueError('DecodeEngine requires a causal config')
         if slots < 1 or pages < 2 or page_size < 1:
             raise ValueError('need slots >= 1, pages >= 2 (page 0 is '
                              'scratch), page_size >= 1')
+        if prefix_share < 0:
+            raise ValueError('prefix_share must be >= 0 (a page cap; '
+                             '0 disables sharing)')
+        if spec_k < 0 or (spec_k >= 2 and draft is None):
+            raise ValueError('spec_k >= 2 needs a draft model '
+                             '(draft=(params, cfg)); spec_k must be >= 0')
         # quantized tier (serve.dtype): bf16/int8 serve with a bfloat16
         # compute config — params, KV pool and block math all follow
         # cfg.dtype, so the offline twin is generate(engine.params,
@@ -167,6 +208,23 @@ class DecodeEngine:
         # physical page 0 is scratch: idle slots write there, nobody reads
         self._free_pages: List[int] = list(
             range(self.n_pages - 1, 0, -1))       # guarded-by: _cond
+        # per-physical-page reference counts: every referencing page
+        # table holds one, the prefix index holds one more while an
+        # entry points at the page — a page returns to the free list
+        # only at zero, so preempting a stream can never free a page
+        # another slot (or a future prefix hit) still reads
+        self._page_refs = np.zeros(self.n_pages,
+                                   np.int32)       # guarded-by: _cond
+        self._free_min = self.n_pages - 1          # guarded-by: _cond
+        # content-addressed FULL-prefix-page index (doc/serving.md
+        # "Prefix sharing"): (version, w, logical page, exact padded
+        # token span) -> {page, host K/V rows}.  OrderedDict = LRU;
+        # bounded by ``prefix_share`` pages.  Host row mirrors let the
+        # admitting thread run the tail prefill without touching the
+        # loop-owned device pools.
+        self._prefix_cap = int(prefix_share)
+        self._prefix: collections.OrderedDict = (
+            collections.OrderedDict())             # guarded-by: _cond
         self._table = np.zeros((self.slots, self.pages_per_slot),
                                np.int32)           # guarded-by: _cond
         self._slots: List[Optional[_Slot]] = (
@@ -188,8 +246,43 @@ class DecodeEngine:
         self._pending_version = None  # guarded-by: _cond
         self.version: object = 0
         self.swap_count = 0
+        # --- greedy speculative decoding (serve.draft / serve.spec_k):
+        # the draft keeps a DENSE per-slot cache (it is small — paging
+        # and sharing buy nothing) advanced only inside spec windows
+        self._spec_k = int(spec_k)
+        self._draft_params = None          # guarded-by: _cond
+        self._pending_draft = None         # guarded-by: _cond
+        self._pending_draft_version = None  # guarded-by: _cond
+        self.draft_version: object = -1
+        self._draft_cfg = None
+        if draft is not None:
+            dparams, dcfg = draft
+            if not dcfg.causal:
+                raise ValueError('draft model must be causal')
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f'draft vocab {dcfg.vocab_size} != target '
+                    f'{cfg.vocab_size}: the verify window compares '
+                    'token ids, the vocabularies must match')
+            if self.serve_dtype != 'f32':
+                dcfg = dataclasses.replace(dcfg, dtype=jnp.bfloat16)
+            self._draft_cfg = dcfg
+            self._draft_ref_treedef = jax.tree.structure(dparams)
+            self._draft_ref_shapes = [tuple(l.shape) for l in
+                                      jax.tree.leaves(dparams)]
+            self._draft_params = self.place_draft_params(dparams)
+            self._draft_placed_treedef = jax.tree.structure(
+                self._draft_params)
+            dhd = dcfg.d_model // dcfg.num_heads
+            dshape = (dcfg.num_stages, self.slots, self.cache_len,
+                      dcfg.num_heads, dhd)
+            self._kdc = jax.device_put(np.zeros(dshape, dcfg.dtype))
+            self._vdc = jax.device_put(np.zeros(dshape, dcfg.dtype))
         self._prefill_fns: collections.OrderedDict = collections.OrderedDict()
+        self._tail_fns: collections.OrderedDict = collections.OrderedDict()
+        self._spec_fns: dict = {}
         self._write_fns: dict = {}
+        self._dwrite_fns: dict = {}
         self._step = self._build_step()
         self._pick1 = jax.jit(self._pick_one)
         self._loop = threading.Thread(target=self._run, daemon=True,
@@ -259,31 +352,116 @@ class DecodeEngine:
 
         return jax.jit(step, donate_argnums=(1, 2))
 
-    def _prefill_fn(self, s0b: int):
-        fn = self._prefill_fns.get(s0b)
+    def _prefill_fn(self, s0b: int, draft: bool = False):
+        key = ('draft', s0b) if draft else s0b
+        fn = self._prefill_fns.get(key)
         if fn is None:
             self.stats.inc('prefill_programs')   # retrace visibility
-            cfg = self.cfg
+            cfg = self._draft_cfg if draft else self.cfg
             fn = jax.jit(lambda params, prompt, w:
                          T.prefill_kv(params, prompt, w, cfg))
-            self._prefill_fns[s0b] = fn
+            self._prefill_fns[key] = fn
             # same LRU bound (and env knob) as generate's program cache
             while len(self._prefill_fns) > T._gen_cache_max():
                 self._prefill_fns.popitem(last=False)
         else:
-            self._prefill_fns.move_to_end(s0b)
+            self._prefill_fns.move_to_end(key)
         return fn
 
-    def _write_fn(self, n_pages: int, s0b: int):
-        """Jitted prompt-K/V scatter into ``n_pages`` physical pages."""
-        key = (n_pages, s0b)
+    def _tail_fn(self, t0: int, tt: int):
+        """Jitted prefix-shared tail prefill, keyed by (prefix, tail)
+        lengths (``w`` stays a traced value, like the full prefill)."""
+        fn = self._tail_fns.get((t0, tt))
+        if fn is None:
+            self.stats.inc('prefill_programs')
+            cfg = self.cfg
+            fn = jax.jit(lambda params, pk, pv, tail, w:
+                         T.prefill_tail_kv(params, pk, pv, tail, w, cfg))
+            self._tail_fns[(t0, tt)] = fn
+            while len(self._tail_fns) > T._gen_cache_max():
+                self._tail_fns.popitem(last=False)
+        else:
+            self._tail_fns.move_to_end((t0, tt))
+        return fn
+
+    def _dwrite_fn(self, s0b: int):
+        """Jitted draft-cache prompt write: the draft's prefill rows for
+        one slot land in the dense per-slot cache (``sid`` is traced —
+        one program per prompt bucket covers every slot)."""
+        fn = self._dwrite_fns.get(s0b)
+        if fn is None:
+            def dwrite(kdc, vdc, dks, dvs, sid):
+                kdc = jax.lax.dynamic_update_slice(
+                    kdc, dks, (0, sid, 0, 0, 0))
+                vdc = jax.lax.dynamic_update_slice(
+                    vdc, dvs, (0, sid, 0, 0, 0))
+                return kdc, vdc
+            fn = self._dwrite_fns[s0b] = jax.jit(dwrite,
+                                                 donate_argnums=(0, 1))
+        return fn
+
+    def _spec_fn(self, K: int):
+        """Jitted speculative round at window width ``K``: K-1 greedy
+        draft proposals (sequential ``decode_step``s over the dense
+        draft cache) + ONE target ``verify_step`` over the (slots, K)
+        window, its new K/V rows scattered into the page pool (the
+        flash leg verifies in place).  Returns the consumed window and
+        the target's per-position greedy picks; acceptance is host-side
+        (variable per slot)."""
+        fn = self._spec_fns.get(K)
+        if fn is None:
+            self.stats.inc('spec_programs')
+            cfg, dcfg = self.cfg, self._draft_cfg
+            S, ps, Tlen = self.slots, self.page_size, self.cache_len
+            hd = cfg.d_model // cfg.num_heads
+            use_flash = self.use_flash
+
+            def spec(params, dparams, kpool, vpool, kdc, vdc, table,
+                     pos, w, tok):
+                window = [tok]
+                dtok = tok
+                for k in range(K - 1):
+                    dlogits, kdc, vdc, _, _ = T.decode_step(
+                        dparams, dcfg, dtok, kdc, vdc, pos + k, w)
+                    dtok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+                    window.append(dtok)
+                toks = jnp.stack(window, axis=1)            # (S, K)
+                if use_flash:
+                    logits, kpool, vpool = T.verify_step_paged(
+                        params, cfg, toks, kpool, vpool, table, pos, w)
+                else:
+                    st = kpool.shape[0]
+                    kc = kpool[:, table].reshape(st, S, Tlen,
+                                                 cfg.num_heads, hd)
+                    vc = vpool[:, table].reshape(st, S, Tlen,
+                                                 cfg.num_heads, hd)
+                    logits, _, _, knew, vnew = T.verify_step(
+                        params, cfg, toks, kc, vc, pos, w)
+                    tq = pos[:, None] + jnp.arange(K)[None, :]
+                    page = table[jnp.arange(S)[:, None], tq // ps]
+                    off = tq % ps
+                    si = jnp.arange(st)[:, None, None]
+                    kpool = kpool.at[si, page[None], off[None]].set(knew)
+                    vpool = vpool.at[si, page[None], off[None]].set(vnew)
+                tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return kpool, vpool, kdc, vdc, toks, tgt
+
+            fn = self._spec_fns[K] = jax.jit(spec,
+                                             donate_argnums=(2, 3, 4, 5))
+        return fn
+
+    def _write_fn(self, n_pages: int, nrows: int):
+        """Jitted prompt-K/V scatter: ``nrows`` prefilled rows into
+        ``n_pages`` physical pages (the whole prompt, or just the tail
+        past a prefix hit)."""
+        key = (n_pages, nrows)
         fn = self._write_fns.get(key)
         if fn is None:
             ps = self.page_size
 
             def write(kpool, vpool, ks, vs, pages):
                 st = kpool.shape[0]
-                pad = n_pages * ps - s0b
+                pad = n_pages * ps - nrows
                 shaped = []
                 for arr in (ks, vs):
                     a = arr[:, 0]                      # (stages, s0b, H, hd)
@@ -368,12 +546,219 @@ class DecodeEngine:
             while self._pending_params is not None and not self._closed:
                 self._cond.wait(0.05)
 
+    # -- speculative-decode draft model ------------------------------------
+    def place_draft_params(self, host_params):
+        """Validate + quantize a draft tree into the serving tier (the
+        SAME tier as the target — verify consumes both through one
+        ``qdot`` dispatch) and place it on device."""
+        if self._draft_cfg is None:
+            raise ValueError('engine was built without a draft model')
+        td = jax.tree.structure(host_params)
+        if td == self._draft_ref_treedef:
+            # treedefs are shape-blind (target and draft trees share the
+            # same nesting): a wrong-architecture tree must fail HERE,
+            # typed, not at the next spec round's trace
+            for leaf, shape in zip(jax.tree.leaves(host_params),
+                                   self._draft_ref_shapes):
+                if tuple(leaf.shape) != shape:
+                    raise ValueError(
+                        f'swap_draft_params: leaf {tuple(leaf.shape)} != '
+                        f'draft {shape} — a shape change needs a new '
+                        'engine, not a hot swap')
+            host_params = quantize.quantize_lm_tree(
+                host_params, self.serve_dtype,
+                out_dtype=self._draft_cfg.dtype)
+        elif td != getattr(self, '_draft_placed_treedef', None):
+            raise ValueError('swap_draft_params: tree structure differs '
+                             'from the draft model')
+        return jax.tree.map(
+            lambda h: h if isinstance(h, jax.Array)
+            else jax.device_put(np.asarray(h)), host_params)
+
+    def warm_draft_params(self, params) -> None:
+        placed = self.place_draft_params(params)
+        jax.block_until_ready(jax.tree.leaves(placed))
+
+    def swap_draft_params(self, params, version: object = None) -> None:
+        """Hot-swap the DRAFT tree with the same drain semantics as
+        :meth:`swap_params`.  A draft change can never alter a stream
+        (verify acceptance guards every token), so this only affects
+        acceptance rate — but the drain keeps one spec round on one
+        draft tree by construction."""
+        placed = self.place_draft_params(params)
+        with self._cond:
+            if self._closed:
+                raise ServeError('decode engine is closed')
+            while self._pending_draft is not None:
+                self._cond.wait(0.05)
+            self._pending_draft = placed
+            self._pending_draft_version = version
+            self._cond.notify_all()
+            while self._pending_draft is not None and not self._closed:
+                self._cond.wait(0.05)
+
+    # -- prefix index (requires-lock helpers) ------------------------------
+    def _prefix_keys(self, padded, w, n):  # requires-lock: _cond
+        """Content keys for the first ``n`` full pages of a padded
+        prompt: (model version, pad width, logical page, EXACT token
+        span through that page) — dict equality does the exact match,
+        so there is no hash-collision correctness risk."""
+        ps = self.page_size
+        row = padded[0]
+        return [(self.version, w, lp, row[:(lp + 1) * ps].tobytes())
+                for lp in range(n)]
+
+    def _prefix_probe(self, padded, w, s0b, touch=True):  # requires-lock: _cond
+        """Longest consecutive full-page prefix hit: returns (n_hit,
+        pages, host_k_rows, host_v_rows).  Hits must cover every bucket-
+        pad slot (``n_hit * ps >= w``) so the tail prefill only ever
+        sees real queries, and always leave >= 1 tail token to
+        regenerate the last-position logits."""
+        ps = self.page_size
+        max_hit = (s0b - 1) // ps
+        pages, hks, hvs = [], [], []
+        for key in self._prefix_keys(padded, w, max_hit):
+            ent = self._prefix.get(key)
+            if ent is None:
+                break
+            if touch:
+                self._prefix.move_to_end(key)
+            pages.append(ent['page'])
+            hks.append(ent['hk'])
+            hvs.append(ent['hv'])
+        if len(pages) * ps < w:
+            return 0, [], [], []
+        return len(pages), pages, hks, hvs
+
+    def _prefix_evict_one(self) -> bool:  # requires-lock: _cond
+        """Drop the LRU index entry; frees its page when the index held
+        the last reference."""
+        if not self._prefix:
+            return False
+        _key, ent = self._prefix.popitem(last=False)
+        self._release_pages([ent['page']])
+        return True
+
+    def _prefix_publish(self, padded, w, s0b, pages, hk_full, hv_full):  # requires-lock: _cond
+        """Insert every not-yet-indexed FULL page of a just-prefilled
+        prompt (immutable by construction: decode writes only at
+        positions >= s0b).  ``pages``/``hk_full``/``hv_full``:
+        the slot's physical pages and host K/V row mirrors for
+        positions [0, s0b).  LRU-evicts at the ``prefix_share`` cap; a
+        prompt whose shareable pages exceed the whole cap raises
+        :class:`PrefixIndexFullError` internally — recorded, served
+        unshared, never surfaced to the request."""
+        ps = self.page_size
+        n_pub = s0b // ps
+        keys = self._prefix_keys(padded, w, n_pub)
+        fresh = [i for i, k in enumerate(keys) if k not in self._prefix]
+        if not fresh:
+            return
+        if len(fresh) > self._prefix_cap:
+            self.stats.inc('prefix_index_full')
+            from ..runtime import faults
+            faults.global_failure_log().record(
+                'prefix_index_full',
+                repr(PrefixIndexFullError(n_pub, self._prefix_cap)))
+            return
+        for i in fresh:
+            while len(self._prefix) >= self._prefix_cap:
+                if not self._prefix_evict_one():
+                    return               # cap raced to 0: give up quietly
+            page = int(pages[i])
+            self._page_refs[page] += 1   # the index's own reference
+            self._prefix[keys[i]] = {
+                'page': page,
+                'hk': hk_full[:, i * ps:(i + 1) * ps],
+                'hv': hv_full[:, i * ps:(i + 1) * ps]}
+            self.stats.inc('prefix_published')
+
+    def _reclaim_index_pages(self, n: int, exclude=()):  # requires-lock: _cond
+        """Free up to ``n`` pages by dropping LRU index entries whose
+        page the index alone still references — the pool-dry path
+        prefers forgetting cold prefixes over preempting live streams.
+        ``exclude``: physical pages that must survive even at refcount
+        1 — the admission path passes the prefix pages it just probed,
+        which its slot is about to splice (freeing one would alias the
+        same physical page as both a shared prefix page and a fresh
+        allocation, and tail writes would clobber the prefix rows)."""
+        freed = 0
+        for key in list(self._prefix):
+            if freed >= n:
+                break
+            ent = self._prefix[key]
+            if ent['page'] in exclude:
+                continue
+            if self._page_refs[ent['page']] == 1:
+                del self._prefix[key]
+                self._release_pages([ent['page']])
+                freed += 1
+                self.stats.inc('prefix_reclaimed')
+        return freed
+
+    def _clear_prefix_index(self) -> None:  # requires-lock: _cond
+        """Release every index reference (param swaps: cached rows are
+        the OLD model's activations — stale keys would leak pages)."""
+        while self._prefix:
+            self._prefix_evict_one()
+
+    # -- page accounting (requires-lock helpers) ---------------------------
+    def _alloc_pages(self, n: int) -> List[int]:  # requires-lock: _cond
+        pages = [self._free_pages.pop() for _ in range(n)]
+        for p in pages:
+            self._page_refs[p] = 1
+        if len(self._free_pages) < self._free_min:
+            self._free_min = len(self._free_pages)
+        return pages
+
+    def _release_pages(self, pages) -> None:  # requires-lock: _cond
+        """Drop one reference per page; a page returns to the free list
+        only when nobody — page table or index — references it."""
+        for p in pages:
+            p = int(p)
+            self._page_refs[p] -= 1
+            if self._page_refs[p] <= 0:
+                self._page_refs[p] = 0
+                self._free_pages.append(p)
+        self._cond.notify_all()
+
+    def prefill_cost(self, req) -> int:
+        """Admission-cost estimate for the batcher's coalescing budget
+        (serve/batcher.py): the tokens THIS prompt's prefill would
+        actually compute right now — a prefix-index hit costs only its
+        tail.  Non-binding (the index can shift before admission); never
+        touches the LRU clock."""
+        prompt = np.asarray(req.data, np.int32)
+        if prompt.ndim != 2 or prompt.shape[0] != 1:
+            return max(1, int(prompt.size))
+        s0 = prompt.shape[1]
+        s0b = _prompt_bucket(s0)
+        w = s0b - s0
+        if self._prefix_cap <= 0:
+            return s0b
+        padded = np.pad(prompt, ((0, 0), (w, 0)))
+        with self._cond:
+            n_hit, _, _, _ = self._prefix_probe(padded, w, s0b,
+                                                touch=False)
+        return max(1, s0b - n_hit * self.page_size)
+
     def resident_bytes(self) -> int:
-        """Device-memory ledger entry for the budgeter: params + pool."""
+        """Device-memory ledger entry for the budgeter: params + pools
+        (+ the draft tree and its dense cache when spec decoding).
+        The paged KV pool is ONE allocation counted ONCE — prefix
+        sharing multiplies page-table references, never this number
+        (pinned by a regression test: two slots sharing a prefix report
+        the same footprint as one)."""
         with self._cond:
             params = self._params
+            draft = self._draft_params
             pool = self._kpool.nbytes + self._vpool.nbytes
-        return int(pool + sum(l.nbytes for l in jax.tree.leaves(params)))
+            if self._draft_cfg is not None:
+                pool += self._kdc.nbytes + self._vdc.nbytes
+        total = pool + sum(l.nbytes for l in jax.tree.leaves(params))
+        if draft is not None:
+            total += sum(l.nbytes for l in jax.tree.leaves(draft))
+        return int(total)
 
     def busy(self) -> bool:
         with self._cond:
@@ -436,10 +821,7 @@ class DecodeEngine:
             raise ValueError('max_new must be >= 1')
         if temp > 0 and rng is None:
             raise ValueError('temperature>0 sampling needs an rng key')
-        if os.environ.get('CXXNET_GEN_BUCKETS', '1') != '0':
-            s0b = T._size_class(s0, floor=8)
-        else:
-            s0b = s0
+        s0b = _prompt_bucket(s0)
         w = s0b - s0
         if max_new > self.max_new_bound:
             raise DecodeSlotsExhaustedError(
@@ -458,15 +840,29 @@ class DecodeEngine:
         # reserve the prompt pages plus the first decode position's page
         # now; later pages allocate on demand as the stream grows
         n0 = (s0b // self.page_size + 1) if max_new >= 2 else n_prompt
+        ps = self.page_size
+        padded = np.pad(prompt, ((0, 0), (w, 0)))
         # --- reserve capacity (blocks; bounded by the request deadline)
         with self._cond:
             while True:
                 if self._closed:
                     raise ServeError('decode engine is closed')
+                n_hit, hit_pages, hks, hvs = (
+                    self._prefix_probe(padded, w, s0b)
+                    if self._prefix_cap > 0 else (0, [], [], []))
+                need = n0 - n_hit
                 if (self._pending_params is None
-                        and any(s is None for s in self._slots)
-                        and len(self._free_pages) >= n0):
-                    break
+                        and self._pending_draft is None
+                        and any(s is None for s in self._slots)):
+                    if len(self._free_pages) < need:
+                        # forget cold prefixes before making anyone
+                        # wait — but never the hit pages this request
+                        # is about to splice
+                        self._reclaim_index_pages(
+                            need - len(self._free_pages),
+                            exclude=set(hit_pages))
+                    if len(self._free_pages) >= need:
+                        break
                 remaining = req.deadline_abs - time.monotonic()
                 if remaining <= 0:
                     raise DeadlineExceededError(
@@ -474,9 +870,22 @@ class DecodeEngine:
                 self._cond.wait(min(remaining, 0.05))
             sid = self._slots.index(None)
             self._slots[sid] = 'RESERVED'          # placeholder
-            pages = [self._free_pages.pop() for _ in range(n0)]
+            for p in hit_pages:                    # splice shared pages
+                self._page_refs[p] += 1
+            pages = list(hit_pages) + self._alloc_pages(need)
+            if n_hit:
+                self.stats.inc('prefix_hits')
+                self.stats.inc('prefix_hit_pages', n_hit)
+                if n_hit == (s0b - 1) // ps and s0b % ps:
+                    # the divergence page: everything shareable was
+                    # shared, the partial last page is privately
+                    # rematerialized by the tail prefill (the CoW rule)
+                    self.stats.inc('cow_copies')
+            elif self._prefix_cap > 0:
+                self.stats.inc('prefix_misses')
             self._admitting += 1
             params = self._params
+            draft_params = self._draft_params
             seq = self._join_seq
             self._join_seq += 1
         try:
@@ -487,13 +896,39 @@ class DecodeEngine:
                 keys = np.asarray(jax.random.split(key, max_new + 1))
             else:
                 keys = np.zeros((max_new + 1, 2), np.uint32)
-            # --- prefill off the loop thread (joins stay token-aligned)
-            padded = np.pad(prompt, ((0, 0), (w, 0)))
-            ks, vs, logits0 = self._prefill_fn(s0b)(
-                params, padded, np.int32(w))
+            # --- prefill off the loop thread (joins stay token-aligned):
+            # a prefix hit computes ONLY the tail, attending over the
+            # shared rows' host mirrors (never the loop-owned pools)
+            if n_hit:
+                t0 = n_hit * ps
+                pk = np.concatenate(hks, axis=1)[:, None]
+                pv = np.concatenate(hvs, axis=1)[:, None]
+                ks, vs, logits0 = self._tail_fn(t0, s0b - t0)(
+                    params, pk, pv, padded[:, t0:], np.int32(w))
+                hk_full = np.concatenate(
+                    [pk[:, 0], np.asarray(ks)[:, 0]], axis=1)
+                hv_full = np.concatenate(
+                    [pv[:, 0], np.asarray(vs)[:, 0]], axis=1)
+            else:
+                ks, vs, logits0 = self._prefill_fn(s0b)(
+                    params, padded, np.int32(w))
+                hk_full = hv_full = None   # mirrored lazily below
+            dks = dvs = None
+            if self._draft_cfg is not None and self._spec_k >= 2:
+                # the draft full-prefills every prompt (it is small;
+                # sharing its dense cache would buy nothing)
+                dks, dvs, _ = self._prefill_fn(s0b, draft=True)(
+                    draft_params, padded, np.int32(w))
             tok0 = int(self._pick1(logits0[0],
                                    jax.numpy.asarray(keys[0]),
                                    np.float32(temp)))
+            if (self._prefix_cap > 0 and s0b // ps
+                    and hk_full is None):
+                # publish mirrors sync device->host HERE, outside the
+                # engine lock — the decode loop takes _cond at every
+                # token boundary and must not wait out a D2H copy
+                hk_full = np.asarray(ks)[:, 0]
+                hv_full = np.asarray(vs)[:, 0]
             now = time.monotonic()
             req.tokens.append(tok0)
             req.token_times.append(now)
@@ -502,21 +937,30 @@ class DecodeEngine:
             with self._cond:
                 if done0 or max_new == 1:
                     self._slots[sid] = None
-                    self._free_pages.extend(pages)
+                    self._release_pages(pages)
                     self._finish(req)
                 else:
+                    # rows still to be written into the pool: the tail
+                    # (hit) or the whole prompt (miss)
                     self._joinq.append(
-                        {'sid': sid, 'pages': pages, 'n_prompt': n_prompt,
+                        {'sid': sid, 'pages': pages,
+                         'wpages': pages[n_hit:n_prompt],
+                         'wrows': s0b - n_hit * ps,
                          's0b': s0b, 'w': w, 'ks': ks, 'vs': vs,
+                         'dks': dks, 'dvs': dvs,
                          'tok0': tok0, 'keys': keys, 'temp': temp,
                          'max_new': max_new, 'req': req, 'seq': seq})
                     self.stats.inc('joined')
+                    if hk_full is not None:
+                        self._prefix_publish(padded, w, s0b,
+                                             pages[:s0b // ps],
+                                             hk_full, hv_full)
                 self._admitting -= 1
                 self._cond.notify_all()
         except BaseException:
             with self._cond:
                 self._slots[sid] = None
-                self._free_pages.extend(pages)
+                self._release_pages(pages)
                 self._admitting -= 1
                 self._cond.notify_all()
             raise
@@ -533,24 +977,35 @@ class DecodeEngine:
         req.event.set()
 
     def _free_slot(self, sid: int) -> None:  # requires-lock: _cond
-        """Return a slot's pages to the pool (caller holds the lock)."""
+        """Release a slot's page references (caller holds the lock);
+        refcounting decides which pages actually return to the pool —
+        never one that another slot's table or the prefix index still
+        holds."""
         row = self._table[sid]
-        self._free_pages.extend(int(p) for p in row[row != 0])
+        self._release_pages(int(p) for p in row[row != 0])
         row[:] = 0
         self._slots[sid] = None
         self._cond.notify_all()
 
     def _integrate_joins(self) -> None:  # requires-lock: _cond
         """Token boundary: splice every admitted request into its slot
-        (caller holds the lock; pool writes release it per join)."""
+        (caller holds the lock; pool writes release it per join).  A
+        prefix-hit join splices the SHARED physical pages and writes
+        only its freshly prefilled tail rows."""
         while self._joinq:
             j = self._joinq.popleft()
             sid = j['sid']
             self._table[sid, :len(j['pages'])] = j['pages']
-            wfn = self._write_fn(j['n_prompt'], j['s0b'])
-            self._kpool, self._vpool = wfn(
-                self._kpool, self._vpool, j['ks'], j['vs'],
-                np.asarray(j['pages'][:j['n_prompt']], np.int32))
+            if j['wpages']:
+                wfn = self._write_fn(len(j['wpages']), j['wrows'])
+                self._kpool, self._vpool = wfn(
+                    self._kpool, self._vpool, j['ks'], j['vs'],
+                    np.asarray(j['wpages'], np.int32))
+            if j.get('dks') is not None:
+                dwfn = self._dwrite_fn(j['s0b'])
+                self._kdc, self._vdc = dwfn(
+                    self._kdc, self._vdc, j['dks'], j['dvs'],
+                    np.int32(sid))
             self._slots[sid] = _Slot(j['req'], j['s0b'], j['w'],
                                      j['tok0'], j['keys'], j['temp'],
                                      j['max_new'], j['seq'])
@@ -570,35 +1025,46 @@ class DecodeEngine:
                 self._free_slot(sid)
                 self._finish(req, err)
 
-    def _alloc_step_pages(self) -> None:  # requires-lock: _cond
+    def _alloc_step_pages(self, win: int = 1) -> None:  # requires-lock: _cond
         """On-demand page allocation for every slot about to write into
-        an unmapped logical page; pool-dry sheds the youngest stream."""
+        an unmapped logical page — the whole ``win``-token window when
+        spec decoding (verify writes rows at ``[pos, pos + win)``).
+        Pool-dry first reclaims index-only prefix pages, then sheds the
+        youngest stream (refcount-aware: a victim's shared pages stay
+        alive for everyone else)."""
         order = sorted((s.join_seq, sid) for sid, s in
                        enumerate(self._slots) if isinstance(s, _Slot))
         for _seq, sid in order:
             slot = self._slots[sid]
             if not isinstance(slot, _Slot):
                 continue            # shed as a victim earlier this pass
-            lp = slot.pos // self.page_size
-            if self._table[sid, lp] != 0:
-                continue
-            while not self._free_pages:
-                victims = [(s.join_seq, vid) for vid, s in
-                           enumerate(self._slots) if isinstance(s, _Slot)]
-                vseq, vid = max(victims)
-                vslot = self._slots[vid]
-                self.stats.inc('shed_pages')
-                self.stats.inc('tokens_shed',
-                               vslot.max_new - len(vslot.req.tokens))
-                err = DecodePagesExhaustedError(
-                    len(vslot.req.tokens), self.n_pages - 1)
-                vreq = vslot.req
-                self._free_slot(vid)
-                self._finish(vreq, err)
-                if vid == sid:
-                    break
-            if isinstance(self._slots[sid], _Slot):
-                self._table[sid, lp] = self._free_pages.pop()
+            last = min(slot.pos + win - 1, self.cache_len - 1)
+            for lp in range(slot.pos // self.page_size,
+                            last // self.page_size + 1):
+                if self._table[sid, lp] != 0:
+                    continue
+                while not self._free_pages:
+                    if self._reclaim_index_pages(1):
+                        continue
+                    victims = [(s.join_seq, vid) for vid, s in
+                               enumerate(self._slots)
+                               if isinstance(s, _Slot)]
+                    vseq, vid = max(victims)
+                    vslot = self._slots[vid]
+                    self.stats.inc('shed_pages')
+                    self.stats.inc('tokens_shed',
+                                   vslot.max_new - len(vslot.req.tokens))
+                    err = DecodePagesExhaustedError(
+                        len(vslot.req.tokens), self.n_pages - 1)
+                    vreq = vslot.req
+                    self._free_slot(vid)
+                    self._finish(vreq, err)
+                    if vid == sid:
+                        break
+                if not isinstance(self._slots[sid], _Slot):
+                    break           # shed as its own victim
+                if self._free_pages:
+                    self._table[sid, lp] = self._alloc_pages(1)[0]
 
     def _run(self) -> None:
         """Decode-loop thread body; a non-request fault (trace error,
@@ -634,13 +1100,26 @@ class DecodeEngine:
                     # swap belongs to the old params' in-flight set
                     self._integrate_joins()
                     live = any(isinstance(s, _Slot) for s in self._slots)
-                    if (self._pending_params is not None and not live
+                    if ((self._pending_params is not None
+                            or self._pending_draft is not None)
+                            and not live
                             and not self._joinq and self._admitting == 0):
-                        self._params = self._pending_params
-                        if self._pending_version is not None:
-                            self.version = self._pending_version
-                        self._pending_params = None
-                        self.swap_count += 1
+                        if self._pending_params is not None:
+                            self._params = self._pending_params
+                            if self._pending_version is not None:
+                                self.version = self._pending_version
+                            self._pending_params = None
+                            self.swap_count += 1
+                            # the cached rows are the OLD model's
+                            # activations: stale keys would leak pages
+                            self._clear_prefix_index()
+                        if self._pending_draft is not None:
+                            self._draft_params = self._pending_draft
+                            self._pending_draft = None
+                            if self._pending_draft_version is not None:
+                                self.draft_version = (
+                                    self._pending_draft_version)
+                                self._pending_draft_version = None
                         self._cond.notify_all()
                         continue
                     if live:
@@ -649,10 +1128,23 @@ class DecodeEngine:
                             and self._admitting == 0):
                         return
                     self._cond.wait(0.05)
-                self._alloc_step_pages()
+                # speculative window width: K proposals only when every
+                # live stream is greedy (sampled streams keep their
+                # per-key RNG schedule — spec pauses, never approximates)
+                # and nobody is within K tokens of its horizon
+                live_slots = [s for s in self._slots
+                              if isinstance(s, _Slot)]
+                K_step = 1
+                if (self._spec_k >= 2 and self._draft_params is not None
+                        and all(s.temp == 0 for s in live_slots)):
+                    rem = min(s.max_new - len(s.req.tokens)
+                              for s in live_slots)
+                    K_step = max(1, min(self._spec_k, rem))
+                self._alloc_step_pages(K_step)
                 if not any(isinstance(s, _Slot) for s in self._slots):
                     continue        # every stream was shed this pass
                 params = self._params
+                dparams = self._draft_params
                 table = np.array(self._table)
                 pos = np.zeros(S, np.int32)
                 w = np.zeros(S, np.int32)
@@ -668,8 +1160,57 @@ class DecodeEngine:
                         temp[sid] = slot.temp
                         r[sid] = slot.keys[slot.kidx]
                         stepped.append(sid)
-            # the K/V pools are loop-thread-owned between token
-            # boundaries; resident_bytes snapshots them under _cond
+            # the K/V pools (and the draft's dense caches) are
+            # loop-thread-owned between token boundaries;
+            # resident_bytes snapshots them under _cond
+            if K_step >= 2:
+                # lint: allow(lock-discipline): single-writer pool handoff (loop thread)
+                (self._kpool, self._vpool, self._kdc, self._vdc,
+                 window, tgt) = self._spec_fn(K_step)(
+                    params, dparams, self._kpool, self._vpool,
+                    self._kdc, self._vdc, table, pos, w, tok)
+                window = np.asarray(window)
+                tgt = np.asarray(tgt)
+                now = time.monotonic()
+                self.stats.inc('decode_steps')
+                self.stats.inc('spec_steps')
+                self.stats.observe('step_occupancy', len(stepped) / S)
+                with self._cond:
+                    for sid in stepped:
+                        slot = self._slots[sid]
+                        if not isinstance(slot, _Slot):
+                            continue   # shed concurrently (defensive)
+                        # accept the longest draft prefix the target
+                        # agrees with, plus the target's own corrected
+                        # token — every accepted token IS the target's
+                        # greedy pick at its position
+                        a = 0
+                        while (a + 1 < K_step
+                               and window[sid, a + 1] == tgt[sid, a]):
+                            a += 1
+                        self.stats.inc('spec_proposed', K_step - 1)
+                        self.stats.inc('spec_accepted', a)
+                        self.stats.observe('spec_window', a + 1)
+                        for token in (int(t) for t in tgt[sid, :a + 1]):
+                            slot.req.tokens.append(token)
+                            slot.req.token_times.append(now)
+                            self.stats.inc('tokens')
+                            self.stats.observe(
+                                'token_ms',
+                                (now - slot.last_emit) * 1e3)
+                            slot.last_emit = now
+                            slot.last_tok = token
+                            slot.pos += 1
+                            slot.kidx += 1
+                            hit_eos = (self.eos_id is not None
+                                       and token == self.eos_id)
+                            if (hit_eos or
+                                    len(slot.req.tokens) >= slot.max_new):
+                                req = slot.req
+                                self._free_slot(sid)
+                                self._finish(req)
+                                break
+                continue
             # lint: allow(lock-discipline): single-writer pool handoff (loop thread)
             self._kpool, self._vpool, nxt = self._step(
                 params, self._kpool, self._vpool, table, pos, w, tok, r,
@@ -714,12 +1255,25 @@ class DecodeEngine:
 
     def report(self, name: Optional[str] = None) -> str:
         """Eval-line stats snapshot; folds in the ``generate`` program-
-        cache hit/miss tallies (the serve surface for them)."""
+        cache hit/miss tallies (the serve surface for them) and the
+        page-pool / prefix-share / spec-decode gauges (free-page
+        low-water mark, shared-page count, index size, acceptance
+        rate) so both multipliers are observable, not inferred."""
         gs = T.gen_cache_stats()
         self.stats.gauge('gen_cache.hit', gs['hit'])
         self.stats.gauge('gen_cache.miss', gs['miss'])
         with self._cond:
-            self.stats.gauge('free_pages', len(self._free_pages))
+            free = len(self._free_pages)
+            self.stats.gauge('free_pages', free)
+            self.stats.gauge('free_pages_min', self._free_min)
+            self.stats.gauge('pages_used', self.n_pages - 1 - free)
+            self.stats.gauge('pages_shared',
+                             int((self._page_refs[1:] > 1).sum()))
+            self.stats.gauge('prefix_index_pages', len(self._prefix))
+        proposed = self.stats.get('spec_proposed')
+        if proposed:
+            self.stats.gauge('spec_accept_rate',
+                             self.stats.get('spec_accepted') / proposed)
         return self.stats.print(name or self.name)
 
 
@@ -786,17 +1340,28 @@ class DecodeService:
                  max_new_bound: int = 64, eos_id: Optional[int] = None,
                  max_queue: int = 64, max_wait: float = 0.002,
                  deadline: float = 30.0, dtype: str = 'f32',
-                 flash_decode=None):
+                 flash_decode=None, prefix_share: int = 0,
+                 spec_k: int = 0, draft=None):
         from .batcher import DynamicBatcher
         stats = StatSet()
         self.engine = DecodeEngine(
             params, cfg, slots=slots, pages=pages, page_size=page_size,
             max_prompt=max_prompt, max_new_bound=max_new_bound,
             eos_id=eos_id, stats=stats, dtype=dtype,
-            flash_decode=flash_decode)
+            flash_decode=flash_decode, prefix_share=prefix_share,
+            spec_k=spec_k, draft=draft)
+        # with prefix sharing on, admission prices each request at its
+        # ACTUAL prefill cost (a hit is just its tail), so a coalescing
+        # window full of hits admits everything while a burst of cold
+        # prompts closes early instead of stacking full prefills in
+        # front of the decode loop
+        cost_kw = {}
+        if prefix_share > 0:
+            cost_kw = {'cost_fn': self.engine.prefill_cost,
+                       'max_cost': 2 * self.engine.max_prompt}
         self.batcher = DynamicBatcher(self.engine, max_queue=max_queue,
                                       max_wait=max_wait, deadline=deadline,
-                                      stats=stats)
+                                      stats=stats, **cost_kw)
 
     def submit_async(self, prompt, max_new: int, temperature: float = 0.0,
                      rng=None, deadline: Optional[float] = None):

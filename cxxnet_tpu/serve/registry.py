@@ -349,6 +349,38 @@ class _ManagedModel:
         self.leases = 0                # callers inside lease() blocks
 
 
+class _DraftAdapter:
+    """The ``ModelRegistry`` engine shim for speculative-decode drafts:
+    verified checkpoints route into the target ``DecodeEngine``'s draft
+    slot (``place_draft_params``/``swap_draft_params``) instead of its
+    serving params, so the registry's DETECTED->...->SWAPPED machinery
+    applies to drafts unchanged.  The target is resolved THROUGH the
+    fleet on every call (never a captured engine reference): if the
+    budgeter evicted and reloaded the model in between, the swap lands
+    on the LIVE engine instead of a closed husk — and the lease holds
+    off eviction for the duration of the swap."""
+
+    __slots__ = ('fleet', 'model_id', 'version')
+
+    def __init__(self, fleet, model_id):
+        self.fleet = fleet
+        self.model_id = model_id
+        self.version = -1
+
+    def place_params(self, host_params):
+        with self.fleet.lease(self.model_id) as engine:
+            return engine.place_draft_params(host_params)
+
+    def warm_params(self, placed) -> None:
+        import jax
+        jax.block_until_ready(jax.tree.leaves(placed))
+
+    def swap_params(self, placed, version: object = None) -> None:
+        with self.fleet.lease(self.model_id) as engine:
+            engine.swap_draft_params(placed, version=version)
+        self.version = version
+
+
 class MultiModelRegistry:
     """N-model registry with a device-memory budgeter: one chip serves a
     fleet of workloads (doc/serving.md "Multi-model serving").
@@ -380,6 +412,7 @@ class MultiModelRegistry:
         self.poll_interval = float(poll_interval)
         self.log = faults.global_failure_log() if log is None else log
         self._models: Dict[str, _ManagedModel] = {}  # guarded-by: _lock
+        self._drafts: List[ModelRegistry] = []       # guarded-by: _lock
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -505,6 +538,32 @@ class MultiModelRegistry:
             if entry.engine is not None:
                 self._evict(entry)
 
+    # -- speculative-decode drafts -----------------------------------------
+    def attach_draft(self, model_id: str, draft_dir: str,
+                     pattern: Optional[str] = None,
+                     loader: Optional[Callable] = None,
+                     current: int = -1) -> 'ModelRegistry':
+        """Watch ``draft_dir`` for newer draft checkpoints and hot-swap
+        them into ``model_id``'s decode engine's DRAFT slot through the
+        same verify/blacklist machinery every serving model gets
+        (serve/decode.py "Speculative decoding" — a rejected draft file
+        can no more reach the engine than a rejected target can; a
+        GOOD one swaps with drain semantics and can never change a
+        stream, only its acceptance rate).  The target engine must have
+        been built with a draft model.  Returns the watching registry
+        (it polls with the fleet)."""
+        with self.lease(model_id) as engine:
+            if getattr(engine, '_draft_cfg', None) is None:
+                raise ValueError(
+                    f'model {model_id!r} has no draft slot (build its '
+                    'engine with draft=(params, cfg))')
+        adapter = _DraftAdapter(self, model_id)
+        reg = ModelRegistry(adapter, draft_dir, current=current,
+                            pattern=pattern, loader=loader, log=self.log)
+        with self._lock:
+            self._drafts.append(reg)
+        return reg
+
     # -- hot swap ----------------------------------------------------------
     def swap_model(self, model_id: str, host_params,
                    version: object = None) -> None:
@@ -516,11 +575,11 @@ class MultiModelRegistry:
         engine.swap_params(placed, version=version)
 
     def poll_once(self) -> int:
-        """One reload cycle across every loaded, watched model; returns
-        the number of swaps."""
+        """One reload cycle across every loaded, watched model (and
+        every attached draft watcher); returns the number of swaps."""
         with self._lock:
             regs = [e.registry for e in self._models.values()
-                    if e.registry is not None]
+                    if e.registry is not None] + list(self._drafts)
         return sum(1 for r in regs if r.poll_once())
 
     # -- watcher / observability -------------------------------------------
@@ -546,6 +605,9 @@ class MultiModelRegistry:
         if t is not None and t is not threading.current_thread():
             t.join(timeout)
         with self._lock:
+            for reg in self._drafts:
+                reg.close(timeout=timeout)
+            self._drafts.clear()
             for entry in self._models.values():
                 if entry.registry is not None:
                     entry.registry.close(timeout=timeout)

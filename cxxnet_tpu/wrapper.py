@@ -505,3 +505,109 @@ def train(cfg: str, data, label, num_round: int, param) -> Net:
         net.start_round(r)
         net.update(data=data, label=label)
     return net
+
+
+class LMServe:
+    """Python-embedder surface for the continuous-batching decode stack
+    (doc/serving.md "Continuous decode") — the LM counterpart of
+    :class:`Net`'s serving surface, and the object the flat C ABI's
+    ``lm_serve_*`` calls hand around (capi.py delegates here).
+
+    Built from a compact ``k=v[;k=v...]`` spec: model
+    ``vocab``/``d_model``/``heads``/``d_ff``/``stages``/``experts``,
+    params from ``model_in`` (a ``%04d.lm`` tree) or ``seed`` init,
+    engine shape ``slots``/``pages``/``page_size``/``max_prompt``/
+    ``max_new``/``eos``, batcher knobs ``max_queue``/``max_wait``/
+    ``deadline``, serving tier ``dtype`` (``f32``/``bf16``/``int8``),
+    attention leg ``flash_decode`` (``auto``/``0``/``1``), prefix
+    sharing ``prefix_share`` (index page cap, 0 = off; doc/serving.md
+    "Prefix sharing"), and greedy speculative decoding ``spec_k`` plus
+    ``draft.*`` keys (``draft.d_model=16;draft.stages=1;draft.seed=1``
+    or ``draft.model_in=`` — the draft's vocab defaults to the
+    target's; doc/serving.md "Speculative decoding")."""
+
+    def __init__(self, svc):
+        self.svc = svc
+
+    @classmethod
+    def from_spec(cls, cfg: str) -> 'LMServe':
+        from .models import transformer as T
+        from .serve.decode import DecodeService, load_lm_params
+        from .utils.config import parse_kv_list
+
+        def build_model(kw, model_in, seed):
+            tcfg = T.TransformerConfig(**kw)
+            params = (load_lm_params(model_in) if model_in
+                      else T.init_params(np.random.RandomState(seed),
+                                         tcfg))
+            return params, tcfg
+
+        cfg_kw = {'attn': 'local'}
+        draft_kw = {'attn': 'local'}
+        svc_kw = {}
+        seed, model_in, eos = 0, None, None
+        draft_seed, draft_model_in, has_draft = 0, None, False
+        names = {'vocab': 'vocab_size', 'd_model': 'd_model',
+                 'heads': 'num_heads', 'd_ff': 'd_ff',
+                 'stages': 'num_stages', 'experts': 'num_experts',
+                 'seq': 'seq_len'}
+        ints = ('slots', 'pages', 'page_size', 'max_prompt', 'max_queue',
+                'prefix_share', 'spec_k')
+        for key, val in parse_kv_list(cfg or ''):
+            if key in names:
+                cfg_kw[names[key]] = int(val)
+            elif key in ints:
+                svc_kw[key] = int(val)
+            elif key == 'max_new':
+                svc_kw['max_new_bound'] = int(val)
+            elif key in ('max_wait', 'deadline'):
+                svc_kw[key] = float(val)
+            elif key == 'seed':
+                seed = int(val)
+            elif key == 'model_in':
+                model_in = val
+            elif key == 'eos':
+                eos = None if int(val) < 0 else int(val)
+            elif key == 'dtype':
+                svc_kw['dtype'] = val
+            elif key == 'flash_decode':
+                svc_kw['flash_decode'] = val
+            elif key.startswith('draft.'):
+                has_draft = True
+                sub = key[len('draft.'):]
+                if sub in names:
+                    draft_kw[names[sub]] = int(val)
+                elif sub == 'seed':
+                    draft_seed = int(val)
+                elif sub == 'model_in':
+                    draft_model_in = val
+                else:
+                    raise ValueError(f'unknown lm_serve option: {key!r}')
+            else:
+                raise ValueError(f'unknown lm_serve option: {key!r}')
+        params, tcfg = build_model(cfg_kw, model_in, seed)
+        if has_draft:
+            draft_kw.setdefault('vocab_size', tcfg.vocab_size)
+            svc_kw['draft'] = build_model(draft_kw, draft_model_in,
+                                          draft_seed)
+        return cls(DecodeService(params, tcfg, eos_id=eos, **svc_kw))
+
+    # --- DecodeService delegation (the capi duck-type surface) ------------
+    @property
+    def engine(self):
+        return self.svc.engine
+
+    @property
+    def batcher(self):
+        return self.svc.batcher
+
+    def generate(self, prompt, max_new: int, temperature: float = 0.0,
+                 rng=None, deadline: Optional[float] = None) -> np.ndarray:
+        return self.svc.generate(prompt, max_new, temperature, rng,
+                                 deadline)
+
+    def report(self, name: str = 'decode') -> str:
+        return self.svc.report(name)
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        self.svc.close(timeout)

@@ -84,6 +84,13 @@ def pytest_configure(config):
         '(tier-1: runs under -m "not slow"; select with -m quant)')
     config.addinivalue_line(
         'markers',
+        'serve_spec: prefix-shared paged KV cache + greedy speculative '
+        'decoding suite — content-addressed prefix index, refcounted '
+        'pages, CoW, tail prefill bitwise twins, verify-window '
+        'token-equality, draft hot-swap; CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m serve_spec)')
+    config.addinivalue_line(
+        'markers',
         'dist: elastic multi-host training suite — coordinator/client '
         'membership, host-sharded stream bitwise twins, and the '
         'multi-process chaos drills (real worker subprocesses over '

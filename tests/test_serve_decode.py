@@ -451,6 +451,34 @@ class TestSlotLifecycle:
         finally:
             eng.close(30)
 
+    def test_unshared_pages_refcount_to_zero_and_low_water_tracked(self):
+        """The refcount plumbing (PR 12 prefix sharing) is invisible on
+        the unshared path: every page a retired stream held goes back to
+        refcount 0 / the free list, and the free-page low-water mark
+        gauge records the deepest draw."""
+        params = _params()
+        eng = DecodeEngine(params, CFG, slots=2, pages=32, page_size=8,
+                           max_prompt=16, max_new_bound=16)
+        try:
+            rng = np.random.RandomState(13)
+            for _ in range(2):
+                p = _prompt(rng)
+                _assert_twin(_wait_ok(eng.submit_direct(p, max_new=6)),
+                             _offline(params, p, 6))
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                with eng._cond:
+                    if len(eng._free_pages) == eng.n_pages - 1:
+                        break
+                time.sleep(0.01)
+            with eng._cond:
+                assert len(eng._free_pages) == eng.n_pages - 1
+                assert (eng._page_refs == 0).all()
+                assert eng._free_min < eng.n_pages - 1
+            assert 'pg-free_pages_min' in eng.report('pg')
+        finally:
+            eng.close(30)
+
     def test_inadmissible_requests_typed(self, engine):
         rng = np.random.RandomState(9)
         r = engine.submit_direct(rng.randint(0, 64, (1, 200)), max_new=4)
