@@ -810,6 +810,195 @@ def bench_scan() -> int:
     return 0
 
 
+def bench_obs() -> int:
+    """Always-on telemetry tax (doc/observability.md): the graftscope
+    flight recorder + span instrumentation runs on EVERY production
+    path, so its cost must be provably negligible.  Two A/B legs with
+    the recorder disabled vs enabled (the only difference — the hub
+    object, StatSets, and trace-id counters exist either way):
+
+    * supervised train steps/sec — the real TrainSupervisor loop with
+      dispatch/save spans and io.produce events riding each batch,
+    * decode tokens/sec — the DecodeService continuous-batching stack
+      with per-request lifecycle spans and per-step decode spans.
+
+    Each leg runs back-to-back off/on PAIRS and reports the median of
+    per-pair overhead ratios: host noise between bursts spans ±5-10%,
+    far above the recorder's true cost, and only the paired ratio
+    cancels it.  The decode model is mid-sized (d_model 128) like the
+    ``decode`` mode's, not a toy: the span cost is constant per step,
+    so a micro model would overstate the relative tax ~10x against any
+    production step time.  Acceptance: overhead < 2% on both.  The
+    receipt also lands in BENCH_OBS_r01.json (cpu-fallback policy tags
+    apply)."""
+    import tempfile
+
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.models import transformer as T
+    from cxxnet_tpu.nnet.execution import ExecutionPlan
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.obs import get_hub
+    from cxxnet_tpu.runtime.supervisor import (SupervisorConfig,
+                                               TrainSupervisor)
+    from cxxnet_tpu.serve.decode import DecodeService
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    hub = get_hub()
+    batch_size = _bench_batch(64)
+    n_batches = int(os.environ.get('CXXNET_OBS_BATCHES', '192'))
+    n_req = int(os.environ.get('CXXNET_OBS_REQUESTS', '32'))
+    max_new = int(os.environ.get('CXXNET_OBS_MAX_NEW', '48'))
+    reps = int(os.environ.get('CXXNET_OBS_REPS', '6'))
+    conf = _SCAN_MLP + f'batch_size = {batch_size}\n' + _extra_conf()
+
+    rng = np.random.RandomState(0)
+    centers = rng.randn(16, 256).astype(np.float32) * 2
+    batches = []
+    for _ in range(n_batches):
+        y = rng.randint(0, 16, batch_size)
+        x = centers[y] + 0.3 * rng.randn(batch_size, 256).astype(np.float32)
+        batches.append(DataBatch(x.reshape(batch_size, 1, 1, 256),
+                                 y[:, None].astype(np.float32)))
+
+    def make_train(tmp):
+        trainer = NetTrainer(parse_config_string(conf))
+        trainer.init_model()
+        plan = ExecutionPlan.resolve(requested_k=1, silent=True)
+        sup = TrainSupervisor(
+            trainer, os.path.join(tmp, 'sup'),
+            SupervisorConfig(batch_deadline=120.0, nan_breaker=3,
+                             save_every=0))
+        stepper = lambda: plan.round_stepper(trainer, lookahead=0)  # noqa: E731
+        factory = lambda s: iter(batches[s % n_batches:])           # noqa: E731
+        sup.run(factory, make_stepper=stepper)            # warm/compile
+
+        def epoch():
+            t0 = time.perf_counter()
+            n = sup.run(factory, make_stepper=stepper)
+            return n / (time.perf_counter() - t0)
+        return epoch, sup
+
+    lm_cfg = T.TransformerConfig(vocab_size=512, d_model=128, num_heads=8,
+                                 d_ff=256, num_stages=2, seq_len=64,
+                                 attn='local')
+    lm_params = T.init_params(np.random.RandomState(0), lm_cfg)
+    prompt_rng = np.random.RandomState(7)
+    prompts = [prompt_rng.randint(
+        0, lm_cfg.vocab_size,
+        (1, int(prompt_rng.randint(1, 12)))).astype(np.int32)
+        for _ in range(n_req)]
+
+    def make_decode():
+        svc = DecodeService(lm_params, lm_cfg, slots=4, pages=96,
+                            page_size=8, max_prompt=16,
+                            max_new_bound=max_new, deadline=240.0)
+        svc.generate(prompts[0], max_new)                 # warm/compile
+
+        def burst():
+            t0 = time.perf_counter()
+            reqs = [svc.submit_async(p, max_new) for p in prompts]
+            toks = 0
+            for r in reqs:
+                svc.batcher.wait(r)
+                toks += len(r.tokens)
+            return toks / (time.perf_counter() - t0)
+        return burst, svc
+
+    import statistics
+    samples = {'train': {False: [], True: []},
+               'decode': {False: [], True: []}}
+    pair_tax = {'train': [], 'decode': []}
+    with tempfile.TemporaryDirectory() as tmp:
+        train_epoch, sup = make_train(tmp)
+        decode_burst, svc = make_decode()
+        try:
+            # per-leg back-to-back off/on pairs: only the paired ratio
+            # cancels slow host drift, so nothing runs inside a pair.
+            # Decode measures first and a full collection precedes each
+            # leg: the recorder's only indirect cost is extra gc
+            # triggers, and their price scales with how much garbage
+            # the OTHER leg left behind — that cross-talk is bench
+            # artifact, not recorder tax
+            import gc
+            for leg, run in (('decode', decode_burst),
+                             ('train', train_epoch)):
+                gc.collect()
+                for i in range(reps):
+                    # alternate which state runs first within the pair:
+                    # the second slot of a pair is systematically a bit
+                    # different (heap growth, cache state), and a fixed
+                    # order would book that bias to one state
+                    order = (False, True) if i % 2 == 0 else (True, False)
+                    rate = {}
+                    for state in order:
+                        hub.enabled = state
+                        # max rate of two runs per slot: scheduler
+                        # spikes only ever ADD time, so the better of
+                        # two is the honest steady-state sample
+                        rate[state] = max(run(), run())
+                    samples[leg][False].append(rate[False])
+                    samples[leg][True].append(rate[True])
+                    pair_tax[leg].append(1.0 - rate[True] / rate[False])
+        finally:
+            hub.enabled = True
+            svc.close(30.0)
+            sup.close()
+
+    rates = {leg: {st: statistics.median(v) for st, v in legs.items()}
+             for leg, legs in samples.items()}
+
+    def tax(leg):
+        return round(statistics.median(pair_tax[leg]), 4)
+
+    import jax
+    payload = {
+        'metric': 'obs_recorder_overhead',
+        # a negative per-leg reading means the recorder's cost is below
+        # this machine's run-to-run noise floor; the headline is the
+        # worst leg clamped at 0 (the raw legs stay in the receipt)
+        'value': max(0.0, tax('train'), tax('decode')),
+        'unit': 'fraction',
+        'platform': jax.devices()[0].platform,
+        'vs_baseline': None,
+        'train_steps_per_sec_recorder_on': round(rates['train'][True], 1),
+        'train_steps_per_sec_recorder_off': round(rates['train'][False], 1),
+        'train_overhead': tax('train'),
+        'train_tax_pairs': [round(t, 4) for t in pair_tax['train']],
+        'decode_tokens_per_sec_recorder_on': round(rates['decode'][True],
+                                                   1),
+        'decode_tokens_per_sec_recorder_off': round(rates['decode'][False],
+                                                    1),
+        'decode_overhead': tax('decode'),
+        'decode_tax_pairs': [round(t, 4) for t in pair_tax['decode']],
+        'acceptance': 'overhead < 0.02 on both legs',
+        'batch': batch_size,
+        'steps': n_batches,
+        'requests': n_req,
+        'max_new': max_new,
+        'receipt_file': 'BENCH_OBS_r01.json',
+        'timing': f'median of {reps} back-to-back off/on pair ratios '
+                  'per leg, warm leg discarded; negative = below this '
+                  'host\'s noise floor',
+    }
+    _write_receipt_file(payload)
+    _emit(payload)
+    return 0
+
+
+def _write_receipt_file(payload: dict) -> None:
+    """Commit a mode's receipt next to the ledger files (the
+    ``receipt_file`` key names it); the cpu-fallback wrapper rewrites
+    the same file with the tagged payload so the committed receipt is
+    always self-describing."""
+    name = payload.get('receipt_file')
+    if not name:
+        return
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, 'w') as f:
+        json.dump(payload, f, indent=1)
+        f.write('\n')
+
+
 def _q_ms(tracker, name: str, q: float):
     """A tracker quantile in ms, or None when unmeasured — the receipt
     must stay strict JSON (NaN is not)."""
@@ -1265,6 +1454,7 @@ _MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
           'bench_io': ('host_io_images_per_sec', bench_io),  # alias
           'scan': ('supervised_scan_steps_per_sec', bench_scan),
           'online': ('online_steps_per_sec_while_serving', bench_online),
+          'obs': ('obs_recorder_overhead', bench_obs),
           'mnist_tta': ('mnist_time_to_2pct_error', bench_mnist_tta),
           'transformer': ('transformer_tokens_per_sec_per_chip',
                           bench_transformer),
@@ -1419,6 +1609,9 @@ def _cpu_fallback(mode: str, err: BaseException) -> int:
         return 1
     payload['platform'] = 'cpu-fallback'
     payload['fallback_reason'] = f'{type(err).__name__}: {err}'
+    # a mode that commits a receipt file gets the TAGGED payload in it —
+    # the committed receipt must say cpu-fallback, not the child's 'cpu'
+    _write_receipt_file(payload)
     _emit(payload)
     return 0 if payload.get('value') is not None else 1
 

@@ -125,14 +125,15 @@ def default_root() -> str:
 
 def _checkers():
     from . import (config_keys, fault_taxonomy, lock_discipline,
-                   monotonic_clock, tracer_hygiene)
+                   monotonic_clock, span_hygiene, tracer_hygiene)
     return (lock_discipline, tracer_hygiene, fault_taxonomy, config_keys,
-            monotonic_clock)
+            monotonic_clock, span_hygiene)
 
 
 ALL_RULES: Tuple[str, ...] = ('lock-discipline', 'lock-order',
                               'tracer-hygiene', 'fault-taxonomy',
-                              'config-key-drift', 'monotonic-clock')
+                              'config-key-drift', 'monotonic-clock',
+                              'span-hygiene')
 
 
 def run_all(root: Optional[str] = None,

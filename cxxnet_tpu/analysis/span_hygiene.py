@@ -1,0 +1,137 @@
+"""Span hygiene for the telemetry hub (rule ``span-hygiene``).
+
+Two invariants keep graftscope spans (``obs/hub.py``) from corrupting
+the paths they observe:
+
+* **no spans inside jitted/scanned scopes** — a span body runs
+  ``time.monotonic_ns()`` and a Python deque append: inside a traced
+  function that is at best a trace-time constant and at worst a forced
+  device→host sync per dispatch, exactly the regression the
+  ``tracer-hygiene`` rule exists to prevent.  Spans bracket
+  *dispatches* from the host side; they never ride into a trace.
+  Traced scope is resolved with the same machinery as
+  ``tracer_hygiene`` (decorators, ``jax.jit(fn)`` wrapping, ``lax``
+  combinators, ``pallas_call`` operands, lexical nesting),
+* **context-manager form only** — ``with span(...):`` (or the
+  decorator form).  A manually-entered span (``s = span(...);
+  s.__enter__()``) leaks its slot on any exception between begin and
+  end, and the recorded duration silently covers the wrong region.
+
+The rule applies to every module that imports from the ``obs`` package
+(plus the fixtures); the ``obs`` package itself is exempt from the
+form check — it *constructs* spans.  The existing ``monotonic-clock``
+rule already covers ``obs/`` (it scans the whole package), so the
+hub's clocks are checked for free.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from . import tracer_hygiene
+from .core import Finding, Module, Repo, dotted_name
+
+RULES = ('span-hygiene',)
+
+#: the span-construction package — exempt from the with-form check
+#: (it returns spans; everyone else must ``with`` them)
+OBS_PACKAGE_PREFIX = 'cxxnet_tpu/obs/'
+
+
+def _uses_obs(mod: Module) -> bool:
+    """Does this module import the telemetry surface at all?  Keys the
+    rule to relevant modules so an unrelated local ``span()`` helper in
+    some future module is not misflagged."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            parts = (node.module or '').split('.')
+            if 'obs' in parts:
+                return True
+        elif isinstance(node, ast.Import):
+            if any('obs' in a.name.split('.') for a in node.names):
+                return True
+    return False
+
+
+def _span_calls(mod: Module) -> List[ast.Call]:
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ''
+            if name.split('.')[-1] == 'span':
+                out.append(node)
+    return out
+
+
+def _allowed_call_ids(mod: Module) -> Set[int]:
+    """ids of Call nodes in sanctioned positions: a ``with`` item's
+    context expression or a decorator."""
+    ok: Set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    ok.add(id(item.context_expr))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    ok.add(id(dec))
+    return ok
+
+
+def _parent_map(tree: ast.AST) -> dict:
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _enclosing_fn(node: ast.AST, parents: dict) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def check_module(mod: Module) -> List[Finding]:
+    calls = _span_calls(mod)
+    if not calls:
+        return []
+    scope = tracer_hygiene._Scope(mod)
+    allowed = _allowed_call_ids(mod)
+    parents = _parent_map(mod.tree)
+    in_obs = mod.rel.startswith(OBS_PACKAGE_PREFIX)
+    findings: List[Finding] = []
+    for call in calls:
+        fn = _enclosing_fn(call, parents)
+        label = getattr(fn, 'name', '<module>') if fn is not None \
+            else '<module>'
+        if fn is not None and fn in scope.traced:
+            findings.append(Finding(
+                'span-hygiene', mod.rel, call.lineno,
+                f'span() inside jitted/scanned scope {label} — a span '
+                'body is host code (monotonic_ns + ring append) and '
+                'would sync or constant-fold inside the trace; bracket '
+                'the dispatch from outside instead'))
+        elif id(call) not in allowed and not in_obs:
+            findings.append(Finding(
+                'span-hygiene', mod.rel, call.lineno,
+                f'span() in {label} must use the context-manager form '
+                '(`with span(...):`) or the decorator form — a manual '
+                'begin leaks the span on any exception before the end'))
+    findings.sort(key=lambda f: f.line)
+    return findings
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in repo.package_files():
+        mod = repo.module(rel)
+        if rel.startswith(OBS_PACKAGE_PREFIX) or _uses_obs(mod):
+            findings.extend(check_module(mod))
+    return findings

@@ -202,6 +202,13 @@ def net_serve_stop(net: Net) -> None:
     net.serve_stop()
 
 
+def net_obs_stats(net: Net) -> str:
+    """The process-wide telemetry hub's ``/statusz`` JSON as one string
+    (doc/observability.md) — the C embedder's machine-readable window
+    into a live trainer/server without binding an HTTP port."""
+    return net.obs_stats()
+
+
 # ---- train-while-serve surface (CXNNetOnline*) ----------------------------
 
 def net_online_start(net: Net, it: DataIter, cfg: str) -> None:

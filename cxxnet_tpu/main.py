@@ -112,6 +112,12 @@ class LearnTask:
         self.dist_shards = 0           # dist.shards micro-shards (0=hosts)
         self.dist_sync_timeout = 60.0  # dist.sync_timeout seconds
         self.dist_launch = 0           # dist.launch=1 forces launcher role
+        # graftscope telemetry (doc/observability.md)
+        self.obs_port = -1             # obs.port: -1 off, 0 ephemeral, >0 fixed
+        self.obs_trace_export = ''     # obs.trace_export Chrome-trace path
+        self.obs_ring_events = 4096    # obs.ring_events flight-recorder ring
+        self.obs_dump_dir = ''         # obs.dump_dir ('' = model_dir/flight)
+        self._obs_server = None
         self.cfg: List[ConfigEntry] = []
         self.net_trainer: Optional[NetTrainer] = None
         self.itr_train = None
@@ -177,6 +183,10 @@ class LearnTask:
             'dist.shards': ('dist_shards', int),
             'dist.sync_timeout': ('dist_sync_timeout', float),
             'dist.launch': ('dist_launch', int),
+            'obs.port': ('obs_port', int),
+            'obs.trace_export': ('obs_trace_export', str),
+            'obs.ring_events': ('obs_ring_events', int),
+            'obs.dump_dir': ('obs_dump_dir', str),
             'online.save_every': ('online_save_every', int),
             'online.freshness_slo': ('online_freshness_slo', float),
             'online.freshness_strict': ('online_freshness_strict', int),
@@ -626,16 +636,65 @@ class LearnTask:
         """Pipeline observability: when the train chain is instrumented
         (``nworker`` set, doc/io.md) its per-stage stats join the round's
         eval line in the same ``\\tio-key:value`` format, then reset so
-        each round reports its own pass."""
+        each round reports its own pass.  Render-and-reset is ONE atomic
+        drain (``print_and_clear``): the old print()-then-clear() pair
+        silently dropped any update a pool/buffer worker recorded
+        between the two lock holds."""
         if self.itr_train is None:
             return
         stats = self.itr_train.pipeline_stats()
         if stats is None:
             return
-        line = stats.print('io')
+        line = stats.print_and_clear('io')
         if line:
             sys.stderr.write(line)
-        stats.clear()
+
+    # --- telemetry (graftscope, doc/observability.md) ----------------------
+    def _obs_start(self) -> None:
+        """Arm the telemetry hub for this run: flight-recorder ring +
+        fault-triggered dumps + SIGUSR1 are always armed (the recorder
+        is the postmortem every chaos drill ships); the live
+        ``/metrics`` + ``/statusz`` + ``/healthz`` endpoint thread comes
+        up only with ``obs.port >= 0`` (0 = ephemeral — the bound port
+        prints to stdout)."""
+        from .obs import get_hub
+        hub = get_hub()
+        if self.obs_ring_events > 0:
+            hub.set_ring(self.obs_ring_events)
+        dump_dir = self.obs_dump_dir or os.path.join(self.name_model_dir,
+                                                     'flight')
+        hub.arm_flight_recorder(dump_dir)
+        hub.arm_signal_dump()
+        if self.obs_port >= 0:
+            from .obs.endpoints import ObsServer
+            self._obs_server = ObsServer(hub, port=self.obs_port)
+            print(f'obs: telemetry on http://127.0.0.1:'
+                  f'{self._obs_server.port} (/metrics /statusz /healthz), '
+                  f'flight dumps in {dump_dir}', flush=True)
+
+    def _obs_register_iterators(self) -> None:
+        """Instrumented io chains join the hub so their per-stage stats
+        serve on /metrics alongside the eval line."""
+        if self.itr_train is None:
+            return
+        stats = self.itr_train.pipeline_stats()
+        if stats is not None:
+            from .obs import get_hub
+            get_hub().register_stats('io', stats)
+
+    def _obs_stop(self) -> None:
+        from .obs import get_hub
+        hub = get_hub()
+        if self.obs_trace_export:
+            path = hub.export_chrome_trace(self.obs_trace_export)
+            if not self.silent:
+                print(f'obs: Chrome trace exported to {path} '
+                      '(load in Perfetto; doc/observability.md)',
+                      flush=True)
+        if self._obs_server is not None:
+            self._obs_server.close(timeout=5.0)
+            self._obs_server = None
+        hub.disarm()
 
     def task_predict(self) -> None:
         assert self.itr_pred is not None, 'must specify a pred iterator'
@@ -697,8 +756,20 @@ class LearnTask:
                     lambda c, p: print(f'serve: hot-reloaded checkpoint '
                                        f'{c} from {p}', flush=True)))
             registry.start()
+        # live telemetry: the batcher's per-bucket gauges serve on
+        # /metrics, the registry state machine on /statusz
+        from .obs import get_hub
+        from .utils.metric import StatSet
+        _hub = get_hub()
+        _hub.register_stats('serve', batcher.stats)
+        if registry is not None:
+            registry.register_into(_hub)
         fleet = self._serve_fleet(engine)
         if fleet is not None:
+            _fleet_stats_set = StatSet()
+            _hub.register_stats(
+                'fleet', _fleet_stats_set,
+                refresh=lambda: fleet.report(stats=_fleet_stats_set))
             for mid in fleet.models():
                 try:
                     fleet.get(mid)       # budgeter decides who stays warm
@@ -932,6 +1003,13 @@ class LearnTask:
             dtype=self.serve_dtype, flash_decode=self.serve_flash,
             prefix_share=self.serve_prefix_share,
             spec_k=self.serve_spec_k, draft=draft)
+        from .obs import get_hub
+        # ONE StatSet backs both the engine and the batcher
+        # (DecodeService shares it), so this single registration carries
+        # the admission gauges too; refresh folds the pull-style page/
+        # gen-cache/acceptance gauges before each /metrics render
+        get_hub().register_stats('decode', svc.engine.stats,
+                                 refresh=lambda: svc.report('decode'))
         if not self.silent:
             print(f'serve: decode engine up — {self.serve_slots} slots, '
                   f'{self.serve_pages}x{self.serve_page_size}-token KV '
@@ -1073,24 +1151,29 @@ class LearnTask:
             faults.install_plan(plan)
             if not self.silent:
                 print(f'fault plan armed: {plan.describe()}', flush=True)
-        self.init()
-        if not self.silent:
-            print('initializing end, start working')
-        if self.task in ('train', 'finetune'):
-            self.task_train()
-        elif self.task == 'pred':
-            self.task_predict()
-        elif self.task == 'pred_raw':
-            self.task_predict_raw()
-        elif self.task == 'extract':
-            self.task_extract()
-        elif self.task == 'serve':
-            if self.serve_mode == 'decode':
-                self.task_serve_decode()
-            else:
-                self.task_serve()
-        elif self.task == 'online':
-            self.task_online()
+        self._obs_start()
+        try:
+            self.init()
+            self._obs_register_iterators()
+            if not self.silent:
+                print('initializing end, start working')
+            if self.task in ('train', 'finetune'):
+                self.task_train()
+            elif self.task == 'pred':
+                self.task_predict()
+            elif self.task == 'pred_raw':
+                self.task_predict_raw()
+            elif self.task == 'extract':
+                self.task_extract()
+            elif self.task == 'serve':
+                if self.serve_mode == 'decode':
+                    self.task_serve_decode()
+                else:
+                    self.task_serve()
+            elif self.task == 'online':
+                self.task_online()
+        finally:
+            self._obs_stop()
         if plan is not None and not self.silent:
             # chaos-drill closure: which events actually fired, and what
             # the runtime saw/did about them (doc/fault_tolerance.md)

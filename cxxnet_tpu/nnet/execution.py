@@ -93,6 +93,14 @@ class ExecutionPlan:
                    silent=silent)
         if reason is not None:
             plan.demote(reason)
+        # the run's plan choice is /statusz state: one provider per
+        # process, latest resolve wins (one plan per run by contract)
+        from ..obs import get_hub
+        get_hub().register_status(
+            'execution_plan',
+            lambda p=plan: {'requested_k': p.requested_k, 'k': p.k,
+                            'scanned': p.scanned,
+                            'demotions': sorted(p._noted)})
         return plan
 
     @property
@@ -175,8 +183,10 @@ class WindowedStepper:
         self.demoted = False
 
     def _step_one(self, staged) -> None:
+        from ..obs import span
         self.before_dispatch(self.updates)
-        self.trainer.update_staged(staged)
+        with span('train.dispatch', 'train', k=1, update=self.updates):
+            self.trainer.update_staged(staged)
         self.updates += 1
 
     def feed(self, batch) -> int:
@@ -202,8 +212,15 @@ class WindowedStepper:
             if len(self.window) == self.k:
                 # no tracer hook inside a window: profile_dir demotes at
                 # resolve time (a trace window can't bracket steps inside
-                # one dispatch)
-                self.trainer.update_staged_window(self.scan_fn, self.window)
+                # one dispatch).  The span brackets the DISPATCH (host
+                # enqueue of one scanned window), never a step inside
+                # it — which is why it composes where profile_dir must
+                # demote (doc/observability.md)
+                from ..obs import span
+                with span('train.dispatch', 'train', k=self.k,
+                          update=self.updates):
+                    self.trainer.update_staged_window(self.scan_fn,
+                                                      self.window)
                 self.updates += self.k
                 self.window = []
         return self.updates - u0

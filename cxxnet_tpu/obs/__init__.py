@@ -1,0 +1,28 @@
+"""graftscope: the unified telemetry subsystem (doc/observability.md).
+
+Three legs, one hub:
+
+* :mod:`~cxxnet_tpu.obs.hub` — the process-wide :class:`TelemetryHub`
+  (StatSet registry, span flight recorder, Prometheus/statusz
+  renderers, fault-triggered postmortem dumps, Chrome trace export),
+* :mod:`~cxxnet_tpu.obs.endpoints` — the ``/metrics`` + ``/statusz`` +
+  ``/healthz`` http thread (``obs.port=`` in the CLI),
+* the ``span()`` / ``record_event()`` instrumentation every layer
+  (io chain, train loop, serve request lifecycle, elastic protocol)
+  records through.
+"""
+
+from .hub import (TelemetryHub, format_report, get_hub, install_hub,
+                  next_trace_id, record_event, span)
+
+__all__ = ['TelemetryHub', 'format_report', 'get_hub', 'install_hub',
+           'next_trace_id', 'record_event', 'span', 'ObsServer']
+
+
+def __getattr__(name):
+    # endpoints import http.server lazily — embedders that never serve
+    # telemetry pay nothing for it
+    if name == 'ObsServer':
+        from .endpoints import ObsServer
+        return ObsServer
+    raise AttributeError(name)

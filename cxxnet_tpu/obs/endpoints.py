@@ -1,0 +1,102 @@
+"""Live telemetry endpoints: ``/metrics``, ``/statusz``, ``/healthz``.
+
+A stdlib ``http.server`` thread (no new dependencies) serving the
+process-wide :class:`~cxxnet_tpu.obs.hub.TelemetryHub`:
+
+* ``/metrics`` — Prometheus text exposition format rendered live from
+  every registered ``StatSet`` (the machine-readable gauges ROADMAP
+  item 5's SLO autoscaler consumes),
+* ``/statusz`` — one JSON snapshot: registry state machines, freshness,
+  page-pool/refcount/spec counters, elastic generation + membership,
+  execution-plan choice — whatever the subsystems registered,
+* ``/healthz`` — liveness (``ok``).
+
+One serving thread (named ``cxxnet-obs-*`` so the test suite's
+thread-leak fixture holds the line on lifecycle); requests are handled
+serially — metrics scrapes are small and rare, and a single thread
+keeps shutdown deterministic.  ``port=0`` binds an ephemeral port
+(exposed as :attr:`ObsServer.port`); binding is loopback-only by
+default — fronting a fleet-visible scrape endpoint is a deployment
+concern, not the hub's.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Optional
+
+__all__ = ['ObsServer']
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # quiet: scrape access logs are noise on the CLI's stderr
+    def log_message(self, fmt, *args):  # noqa: D102 — stdlib override
+        pass
+
+    def _reply(self, code: int, ctype: str, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header('Content-Type', ctype)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — stdlib naming
+        hub = self.server.hub
+        path = self.path.split('?', 1)[0]
+        try:
+            if path == '/healthz':
+                self._reply(200, 'text/plain; charset=utf-8', b'ok\n')
+            elif path == '/metrics':
+                body = hub.metrics_text().encode('utf-8')
+                self._reply(200, 'text/plain; version=0.0.4; '
+                                 'charset=utf-8', body)
+            elif path == '/statusz':
+                body = (json.dumps(hub.status(), sort_keys=True,
+                                   default=str) + '\n').encode('utf-8')
+                self._reply(200, 'application/json', body)
+            else:
+                self._reply(404, 'text/plain; charset=utf-8',
+                            b'not found: /metrics /statusz /healthz\n')
+        # lint: allow(fault-taxonomy): an endpoint render error must answer 500 to the scraper, never kill the serving thread
+        except Exception as e:
+            try:
+                self._reply(500, 'text/plain; charset=utf-8',
+                            f'error: {e!r}\n'.encode('utf-8'))
+            except OSError:
+                pass                 # client went away mid-error
+
+
+class ObsServer:
+    """The telemetry endpoint thread.  ``port=0`` = ephemeral (read
+    :attr:`port` after construction); :meth:`close` is idempotent and
+    joins the serving thread."""
+
+    def __init__(self, hub, port: int = 0, host: str = '127.0.0.1'):
+        self.hub = hub
+        self._srv = HTTPServer((host, int(port)), _Handler)
+        self._srv.hub = hub
+        self.host = host
+        self.port = int(self._srv.server_address[1])
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, kwargs={'poll_interval': 0.1},
+            daemon=True, name=f'cxxnet-obs-{self.port}')
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f'http://{self.host}:{self.port}'
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Stop serving and join the thread (idempotent); returns True
+        once the thread exited."""
+        if not self._closed:
+            self._closed = True
+            self._srv.shutdown()
+            self._srv.server_close()
+        if self._thread is threading.current_thread():
+            return False
+        self._thread.join(timeout)
+        return not self._thread.is_alive()

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..obs import format_report
 from ..runtime import faults
 from ..utils.metric import StatSet
 
@@ -140,8 +141,7 @@ class FreshnessTracker:
                     v = self.stats.quantile(key, q)
                     if v == v:                      # has samples
                         stats.gauge(f'{key}.{tag}', v)
-            return stats.print(name)
-        return stats.print(name)
+        return format_report(name, stats)
 
     def check_strict(self) -> None:
         """Raise the last typed breach (strict mode, run boundaries)."""

@@ -47,6 +47,7 @@ import numpy as np
 
 from ..nnet import checkpoint as model_io
 from ..nnet.execution import ExecutionPlan
+from ..obs import get_hub, span
 from ..runtime import faults
 from ..runtime.async_ckpt import AsyncCheckpointer, host_tree, snapshot_tree
 from ..runtime.supervisor import SupervisorConfig, TrainSupervisor
@@ -166,10 +167,6 @@ class OnlinePipeline:
         tr = self.trainer
         path = self._model_path(counter)
         self.tracker.record_step(counter)
-        header = self._model_header()
-        net = tr.net
-        psnap = snapshot_tree(tr.params)
-
         def job():
             blob = model_io.serialize_blob(net, host_tree(psnap))
             # digest-before-rename publish: the watching registry can
@@ -181,15 +178,23 @@ class OnlinePipeline:
                 retry=self.cfg.retry)
             return path
 
-        if sync or not self.cfg.save_async:
-            job()
-        else:
-            # drain (not wait): a failed PREVIOUS model save is already
-            # in the failure log as async_save_failed — online, a lost
-            # serving checkpoint degrades freshness, never training
-            self._ckpt.drain()
-            self._ckpt.submit(job, step=counter,
-                              label=f'publish_model:{counter:04d}')
+        # the span brackets what the STEP LOOP pays: the snapshot plus
+        # either the whole write (sync) or the background hand-off
+        with span('online.publish', 'online', step=counter,
+                  sync=bool(sync or not self.cfg.save_async)):
+            header = self._model_header()
+            net = tr.net
+            psnap = snapshot_tree(tr.params)
+            if sync or not self.cfg.save_async:
+                job()
+            else:
+                # drain (not wait): a failed PREVIOUS model save is
+                # already in the failure log as async_save_failed —
+                # online, a lost serving checkpoint degrades freshness,
+                # never training
+                self._ckpt.drain()
+                self._ckpt.submit(job, step=counter,
+                                  label=f'publish_model:{counter:04d}')
         return path
 
     def _on_train_save(self, step: int) -> None:
@@ -230,6 +235,15 @@ class OnlinePipeline:
             current=counter, retry=cfg.retry, log=self.log,
             on_swap=self._on_swap)
         self.registry.start()
+        # register the live stat sets + status views into the telemetry
+        # hub: /metrics serves the batcher/freshness/registry gauges and
+        # /statusz the registry state machine while the process runs
+        hub = get_hub()
+        hub.register_stats('serve', self.batcher.stats)
+        hub.register_stats('online', self.tracker.stats,
+                           refresh=self._refresh_online_gauges)
+        self.registry.register_into(hub)
+        hub.register_status('online', self.summary)
         if self.request_source is not None:
             self._traffic_stop.clear()
             self._traffic_thread = threading.Thread(
@@ -344,6 +358,14 @@ class OnlinePipeline:
         return self.summary()
 
     # -- observability ------------------------------------------------------
+    def _refresh_online_gauges(self) -> None:
+        """Pull-style gauges for /metrics renders (the eval line gets
+        the same values through :meth:`eval_line`)."""
+        self.tracker.report()      # gauges swaps/breaches/unserved_swaps
+        with self._served_lock:
+            self.tracker.stats.gauge('served', self._served)
+        self.tracker.stats.gauge('dropped', self.dropped())
+
     def dropped(self) -> int:
         """Requests that got an error instead of scores — the zero-drop
         acceptance counter (batcher sheds + engine faults + client-side
@@ -414,6 +436,11 @@ class OnlinePipeline:
         if self._closed:
             return
         self._closed = True
+        hub = get_hub()
+        for name in ('serve', 'online', 'registry'):
+            hub.unregister_stats(name)
+        for name in ('online', 'registry'):
+            hub.unregister_status(name)
         self._traffic_stop.set()
         t = self._traffic_thread
         if t is not None:

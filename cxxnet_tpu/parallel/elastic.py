@@ -661,28 +661,35 @@ class ElasticClient:
     def rendezvous(self) -> int:
         """Join the current membership generation (blocks until all
         ``nhosts`` ranks are present).  Returns the sealed generation."""
-        reply, _ = self._call({'op': 'hello'},
-                              timeout=self.rendezvous_timeout)
-        if reply['op'] != 'welcome':
-            raise faults.ElasticSyncError(
-                f'expected welcome, got {reply["op"]!r}')
-        with self._lock:
-            self.generation = int(reply['gen'])
-            self._bar_seq.clear()
-            return self.generation
+        from ..obs import span
+        with span('elastic.rendezvous', 'elastic',
+                  rank=self.rank) as sp:
+            reply, _ = self._call({'op': 'hello'},
+                                  timeout=self.rendezvous_timeout)
+            if reply['op'] != 'welcome':
+                raise faults.ElasticSyncError(
+                    f'expected welcome, got {reply["op"]!r}')
+            with self._lock:
+                self.generation = int(reply['gen'])
+                self._bar_seq.clear()
+                sp.attrs['gen'] = self.generation
+                return self.generation
 
     def all_shards(self, step: int, shard_ids: List[int],
                    flats: List[np.ndarray], losses: List[np.ndarray],
                    ) -> Tuple[Dict[int, np.ndarray], Dict[int, np.float32]]:
         """Push this host's shard gradients, pull the full set (every
         shard's bytes exactly as some host pushed them)."""
+        from ..obs import span
         bufs: List[bytes] = []
         for f, l in zip(flats, losses):
             bufs.append(np.ascontiguousarray(f, np.float32).tobytes())
             bufs.append(np.ascontiguousarray(l, np.float32).tobytes())
-        reply, rbufs = self._call(
-            {'op': 'push', 'step': int(step),
-             'shards': [int(s) for s in shard_ids]}, tuple(bufs))
+        with span('elastic.push_pull', 'elastic', step=int(step),
+                  rank=self.rank, shards=len(shard_ids)):
+            reply, rbufs = self._call(
+                {'op': 'push', 'step': int(step),
+                 'shards': [int(s) for s in shard_ids]}, tuple(bufs))
         out_f: Dict[int, np.ndarray] = {}
         out_l: Dict[int, np.float32] = {}
         for i, sid in enumerate(reply['shards']):
@@ -697,12 +704,15 @@ class ElasticClient:
         Wire tags are scoped by (generation, per-tag sequence) — all
         hosts execute the same barrier sequence within a generation, so
         the scoped tags line up by construction."""
+        from ..obs import span
         with self._lock:
             seq = self._bar_seq.get(tag, 0)
             self._bar_seq[tag] = seq + 1
             wire = f'{self.generation}/{tag}#{seq}'
-        reply, _ = self._call({'op': 'barrier', 'tag': wire,
-                               'value': value}, timeout=timeout)
+        with span('elastic.barrier', 'elastic', tag=tag,
+                  rank=self.rank, wire=wire):
+            reply, _ = self._call({'op': 'barrier', 'tag': wire,
+                                   'value': value}, timeout=timeout)
         return {int(r): v for r, v in reply['values'].items()}
 
     def report_fault(self, kind: str, step: int) -> None:
@@ -1019,7 +1029,12 @@ class ElasticSupervisor(TrainSupervisor):
         resiliently (it alone may quarantine corrupt steps), broadcasts
         the landed step, peers restore that exact step, and a CRC
         barrier proves every host resumed from identical params."""
+        from ..obs import span
         tr = self.trainer
+        with span('elastic.restore', 'elastic', rank=self.elastic.rank):
+            return self._restore_synced_inner(tr)
+
+    def _restore_synced_inner(self, tr) -> int:
         if self.elastic.rank == 0:
             step = super().restore()
             self.client.barrier('restore', value=step)
@@ -1135,6 +1150,29 @@ def elastic_train(task) -> None:
         save_workers=task.save_workers,
         pipeline_stats=it.pipeline_stats())
     sup = ElasticSupervisor(tr, ckpt_dir, sup_cfg, client, ecfg)
+    # every worker registers into the process-wide telemetry hub: the
+    # elastic gauges ride /metrics and the generation + membership view
+    # rides /statusz (each worker process has its own hub + endpoints)
+    from ..obs import get_hub
+    from ..utils.metric import StatSet
+    estats = StatSet()
+
+    def _refresh_elastic():
+        estats.gauge('rank', ecfg.rank)
+        estats.gauge('hosts', ecfg.hosts)
+        estats.gauge('generation', client.generation)
+        estats.gauge('incarnation', ecfg.incarnation)
+        estats.gauge('steps', tr.sample_counter)
+        estats.gauge('restarts', sup.restarts_total)
+
+    get_hub().register_stats('elastic', estats, refresh=_refresh_elastic)
+    get_hub().register_status(
+        'elastic', lambda: {'rank': ecfg.rank, 'hosts': ecfg.hosts,
+                            'generation': client.generation,
+                            'incarnation': ecfg.incarnation,
+                            'shards': list(ecfg.owned_shards),
+                            'steps': int(tr.sample_counter),
+                            'restarts': sup.restarts_total})
     try:
         client.connect()
         gen = client.rendezvous()

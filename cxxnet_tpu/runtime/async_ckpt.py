@@ -128,10 +128,12 @@ class AsyncCheckpointer:
         self.wait()
 
         def task():
+            from ..obs import span
             with self._lock:
                 self._in_flight += 1
             try:
-                out = fn()
+                with span('ckpt.commit', 'ckpt', step=step, label=label):
+                    out = fn()
                 with self._lock:
                     self.commits += 1
                 return out

@@ -287,6 +287,41 @@ class FailureRecord:
     monotonic: float = 0.0
 
 
+#: class-level failure listeners: called as ``fn(record, log)`` after a
+#: record lands in ANY FailureLog (the telemetry hub's flight recorder
+#: hooks here so a fault-triggered postmortem covers supervisor-owned
+#: logs and the global one alike, doc/observability.md)
+_FAILURE_LISTENERS: List[Callable] = []
+
+
+def add_failure_listener(fn: Callable) -> Callable:
+    """Register ``fn(record, log)`` on every FailureLog record; returns
+    ``fn`` so callers can :func:`remove_failure_listener` it later."""
+    if fn not in _FAILURE_LISTENERS:
+        _FAILURE_LISTENERS.append(fn)
+    return fn
+
+
+def remove_failure_listener(fn: Callable) -> None:
+    try:
+        _FAILURE_LISTENERS.remove(fn)
+    except ValueError:
+        pass
+
+
+def training_fault_kinds() -> set:
+    """The ``record()`` kind strings that denote a typed
+    :class:`TrainingFault` (the supervisor records faults under
+    ``type(e).__name__``) — what arms a flight-recorder dump."""
+    out = set()
+    stack = [TrainingFault]
+    while stack:
+        cls = stack.pop()
+        out.add(cls.__name__)
+        stack.extend(cls.__subclasses__())
+    return out
+
+
 class FailureLog:
     """Append-only, thread-safe record of faults seen and actions taken.
     The supervisor owns one; subsystems without a supervisor reference
@@ -302,6 +337,12 @@ class FailureLog:
         rec = FailureRecord(kind, detail, step, time.monotonic())
         with self._lock:
             self._records.append(rec)
+        for fn in list(_FAILURE_LISTENERS):     # outside the lock
+            try:
+                fn(rec, self)
+            # lint: allow(fault-taxonomy): a broken telemetry listener must never turn an observed fault into a new one
+            except Exception:
+                pass
         return rec
 
     def records(self, kind: Optional[str] = None) -> List[FailureRecord]:

@@ -43,6 +43,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
+from ..obs import span
 from ..utils.thread_buffer import ThreadBuffer
 from . import faults
 
@@ -139,17 +140,20 @@ class TrainSupervisor:
             self._async.close()
             self._async = None
         if self._async is not None:
-            self._async.save_sharded_async(
-                self.ckpt_dir, step, tr.snapshot_training_state(),
-                retry=self.config.retry, on_commit=lambda _p: self._prune())
+            with span('train.save', 'train', step=step, mode='async'):
+                self._async.save_sharded_async(
+                    self.ckpt_dir, step, tr.snapshot_training_state(),
+                    retry=self.config.retry,
+                    on_commit=lambda _p: self._prune())
             if self.config.on_save is not None:
                 self.config.on_save(step)
             return sharded_ckpt.step_dir(self.ckpt_dir, step)
-        old = sharded_ckpt.step_dir(self.ckpt_dir, step)
-        if os.path.isdir(old):
-            shutil.rmtree(old, ignore_errors=True)
-        path = tr.save_training_state(self.ckpt_dir, step,
-                                      retry=self.config.retry)
+        with span('train.save', 'train', step=step, mode='sync'):
+            old = sharded_ckpt.step_dir(self.ckpt_dir, step)
+            if os.path.isdir(old):
+                shutil.rmtree(old, ignore_errors=True)
+            path = tr.save_training_state(self.ckpt_dir, step,
+                                          retry=self.config.retry)
         self._prune()
         if self.config.on_save is not None:
             self.config.on_save(step)
@@ -186,7 +190,8 @@ class TrainSupervisor:
         the sync path's error surface, one boundary late.  ``run()``
         passes the FINAL save through this always."""
         if self._async is not None:
-            self._async.wait()
+            with span('train.save_barrier', 'train'):
+                self._async.wait()
 
     def close(self) -> None:
         """Release the background writer's threads (drains first).  The
@@ -231,10 +236,12 @@ class TrainSupervisor:
             # back to the previous good step, not die on the save error.
             self._async.drain()
         tr = self.trainer
-        tr.reset_transient_state()
-        step = tr.load_training_state(self.ckpt_dir, restore_params=True,
-                                      fallback=True,
-                                      retry=self.config.retry)
+        with span('train.restore', 'train'):
+            tr.reset_transient_state()
+            step = tr.load_training_state(self.ckpt_dir,
+                                          restore_params=True,
+                                          fallback=True,
+                                          retry=self.config.retry)
         self.failure_log.record('restored', f'resumed from step {step}',
                                 step=step)
         return step

@@ -36,6 +36,7 @@ from typing import Deque, List, Optional
 
 import numpy as np
 
+from ..obs import format_report, next_trace_id, record_event, span
 from ..runtime.faults import (DeadlineExceededError, ServeError,
                               ServeOverloadError)
 from ..utils.bucketing import bucket_for
@@ -56,11 +57,15 @@ class ServeRequest:
 
     __slots__ = ('data', 'n', 't_submit', 'deadline', 'deadline_abs',
                  'event', 'result', 'error', 'abandoned', 'meta',
-                 'tokens', 'token_times')
+                 'tokens', 'token_times', 'trace_id')
 
     def __init__(self, data: np.ndarray, deadline: float, meta=None):
         self.data = data
         self.n = int(data.shape[0])
+        # one trace id per request lifetime: every span of this
+        # request's lifecycle (admit -> queue -> prefill -> decode ->
+        # emit -> finish) carries it, across batcher and engine threads
+        self.trace_id = next_trace_id()
         self.t_submit = time.monotonic()
         self.deadline = float(deadline)
         self.deadline_abs = self.t_submit + float(deadline)
@@ -137,8 +142,11 @@ class DynamicBatcher:
                 self.stats.inc('rejected')
                 raise ServeOverloadError(len(self._q), self.max_queue)
             self._q.append(req)
-            self.stats.peak('queue_peak', len(self._q))
+            depth = len(self._q)
+            self.stats.peak('queue_peak', depth)
             self._cond.notify()
+        record_event('serve.admit', 'serve', req.trace_id, rows=req.n,
+                     queue_depth=depth)
         return req
 
     def wait(self, req: ServeRequest) -> np.ndarray:
@@ -167,6 +175,8 @@ class DynamicBatcher:
         req.error = DeadlineExceededError(req.deadline, now - req.t_submit,
                                           req.n)
         self.stats.inc('expired')
+        record_event('serve.finish', 'serve', req.trace_id, rows=req.n,
+                     error='DeadlineExceededError')
         req.event.set()
 
     def _gather(self, first: ServeRequest) -> List[ServeRequest]:
@@ -226,6 +236,13 @@ class DynamicBatcher:
         if not live:
             return
         batch = live
+        # queue-wait span per request: submit -> window close (the same
+        # monotonic clock, expressed in ns for the flight recorder)
+        now_ns = time.monotonic_ns()
+        for r in batch:
+            t0_ns = int(r.t_submit * 1e9)
+            record_event('serve.queue', 'serve', r.trace_id,
+                         t_start_ns=t0_ns, dur_ns=now_ns - t0_ns)
         if self._exec is not None:
             # engine-owned completion (decode): admission into slots may
             # block per-request; errors land per request inside the
@@ -247,7 +264,9 @@ class DynamicBatcher:
             # the worker thread and wedge the service
             data = (batch[0].data if len(batch) == 1 else
                     np.concatenate([r.data for r in batch], axis=0))
-            scores = self.engine.predict_scores(data)
+            with span('serve.forward', 'serve', rows=rows,
+                      coalesced=len(batch)):
+                scores = self.engine.predict_scores(data)
         except BaseException as e:  # surface engine faults per-request
             self.stats.inc('engine_errors')
             for r in batch:
@@ -268,6 +287,8 @@ class DynamicBatcher:
         self.stats.inc(f'rows[b{bucket}]', rows)
         self.stats.observe('coalesced', len(batch))
         for r in batch:
+            record_event('serve.finish', 'serve', r.trace_id, rows=r.n,
+                         latency_ms=(done - r.t_submit) * 1e3)
             r.event.set()
 
     def _loop(self) -> None:
@@ -302,8 +323,9 @@ class DynamicBatcher:
 
     def report(self, name: str = 'serve') -> str:
         """Eval-line-format stats snapshot (``utils.metric.StatSet``),
-        with overall requests/sec appended."""
+        with overall requests/sec appended — rendered by the hub's one
+        ``format_report`` so key spelling cannot drift."""
         elapsed = max(time.monotonic() - self._t0, 1e-9)
         self.stats.gauge('reqs_per_sec',
                          self.stats.get('requests') / elapsed)
-        return self.stats.print(name)
+        return format_report(name, self.stats)

@@ -83,6 +83,7 @@ import numpy as np
 
 from ..models import transformer as T
 from ..nnet import quantize
+from ..obs import format_report, record_event, span
 from ..ops import pallas_kernels as PK
 from ..runtime.faults import (DeadlineExceededError, DecodePagesExhaustedError,
                               DecodeSlotsExhaustedError,
@@ -900,18 +901,24 @@ class DecodeEngine:
             # a prefix hit computes ONLY the tail, attending over the
             # shared rows' host mirrors (never the loop-owned pools)
             if n_hit:
+                record_event('decode.prefix_hit', 'decode', req.trace_id,
+                             hit_pages=n_hit)
                 t0 = n_hit * ps
-                pk = np.concatenate(hks, axis=1)[:, None]
-                pv = np.concatenate(hvs, axis=1)[:, None]
-                ks, vs, logits0 = self._tail_fn(t0, s0b - t0)(
-                    params, pk, pv, padded[:, t0:], np.int32(w))
-                hk_full = np.concatenate(
-                    [pk[:, 0], np.asarray(ks)[:, 0]], axis=1)
-                hv_full = np.concatenate(
-                    [pv[:, 0], np.asarray(vs)[:, 0]], axis=1)
+                with span('decode.tail_prefill', 'decode', req.trace_id,
+                          prompt=s0b, tail=s0b - t0):
+                    pk = np.concatenate(hks, axis=1)[:, None]
+                    pv = np.concatenate(hvs, axis=1)[:, None]
+                    ks, vs, logits0 = self._tail_fn(t0, s0b - t0)(
+                        params, pk, pv, padded[:, t0:], np.int32(w))
+                    hk_full = np.concatenate(
+                        [pk[:, 0], np.asarray(ks)[:, 0]], axis=1)
+                    hv_full = np.concatenate(
+                        [pv[:, 0], np.asarray(vs)[:, 0]], axis=1)
             else:
-                ks, vs, logits0 = self._prefill_fn(s0b)(
-                    params, padded, np.int32(w))
+                with span('decode.prefill', 'decode', req.trace_id,
+                          prompt=s0b):
+                    ks, vs, logits0 = self._prefill_fn(s0b)(
+                        params, padded, np.int32(w))
                 hk_full = hv_full = None   # mirrored lazily below
             dks = dvs = None
             if self._draft_cfg is not None and self._spec_k >= 2:
@@ -933,6 +940,8 @@ class DecodeEngine:
             req.tokens.append(tok0)
             req.token_times.append(now)
             self.stats.inc('tokens')
+            record_event('decode.emit', 'decode', req.trace_id,
+                         token_index=0)
             done0 = self.eos_id is not None and tok0 == self.eos_id
             with self._cond:
                 if done0 or max_new == 1:
@@ -974,6 +983,10 @@ class DecodeEngine:
             req.result = np.asarray(req.tokens, np.int32)
             self.stats.inc('completed')
             self.stats.observe('stream_len', len(req.tokens))
+        record_event('decode.finish', 'decode',
+                     getattr(req, 'trace_id', None),
+                     tokens=len(req.tokens),
+                     error=None if error is None else type(error).__name__)
         req.event.set()
 
     def _free_slot(self, sid: int) -> None:  # requires-lock: _cond
@@ -1164,6 +1177,10 @@ class DecodeEngine:
             # loop-thread-owned between token boundaries;
             # resident_bytes snapshots them under _cond
             if K_step >= 2:
+                # hot path: record_event with explicit timestamps (not
+                # a span ctx) — one fewer allocation per step, and gc
+                # trigger frequency is the recorder's only real cost
+                t0_ns = time.monotonic_ns()
                 # lint: allow(lock-discipline): single-writer pool handoff (loop thread)
                 (self._kpool, self._vpool, self._kdc, self._vdc,
                  window, tgt) = self._spec_fn(K_step)(
@@ -1171,6 +1188,12 @@ class DecodeEngine:
                     self._kdc, self._vdc, table, pos, w, tok)
                 window = np.asarray(window)
                 tgt = np.asarray(tgt)
+                # measured THROUGH the host sync above, like the plain
+                # step leg — the dispatch alone is async and ~free
+                record_event('decode.spec_verify', 'decode',
+                             t_start_ns=t0_ns,
+                             dur_ns=time.monotonic_ns() - t0_ns,
+                             window=K_step, slots=len(stepped))
                 now = time.monotonic()
                 self.stats.inc('decode_steps')
                 self.stats.inc('spec_steps')
@@ -1211,11 +1234,17 @@ class DecodeEngine:
                                 self._finish(req)
                                 break
                 continue
+            # hot path: explicit-timestamp record, not a span ctx (same
+            # reasoning as the spec leg above)
+            t0_ns = time.monotonic_ns()
             # lint: allow(lock-discipline): single-writer pool handoff (loop thread)
             self._kpool, self._vpool, nxt = self._step(
-                params, self._kpool, self._vpool, table, pos, w, tok, r,
-                temp)
+                params, self._kpool, self._vpool, table, pos, w, tok,
+                r, temp)
             nxt = np.asarray(nxt)
+            record_event('decode.step', 'decode', t_start_ns=t0_ns,
+                         dur_ns=time.monotonic_ns() - t0_ns,
+                         slots=len(stepped))
             now = time.monotonic()
             self.stats.inc('decode_steps')
             self.stats.observe('step_occupancy', len(stepped) / S)
@@ -1274,7 +1303,7 @@ class DecodeEngine:
         if proposed:
             self.stats.gauge('spec_accept_rate',
                              self.stats.get('spec_accepted') / proposed)
-        return self.stats.print(name or self.name)
+        return format_report(name or self.name, self.stats)
 
 
 # -- on-disk format for transformer param trees ----------------------------

@@ -37,6 +37,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..nnet import checkpoint
 from ..nnet.net_config import NetConfig
+from ..obs import format_report, span
 from ..runtime import faults
 
 __all__ = ['ModelRegistry', 'MultiModelRegistry', 'MemoryBudgeter',
@@ -176,6 +177,7 @@ class ModelRegistry:
             return out
         for name in names:
             m = self._re.match(name)
+            # lint: allow(lock-discipline): current is a single monotone int advanced only by the poll thread; GIL-atomic reads, a stale value is one poll late
             if m and int(m.group(1)) > self.current:
                 out.append((int(m.group(1)),
                             os.path.join(self.model_dir, name)))
@@ -201,16 +203,18 @@ class ModelRegistry:
                 continue                  # blacklisted: persistent reject
             self._note('DETECTED', path)
             try:
-                self._note('VERIFYING', path)
-                reason = checkpoint.verify_model_digest(path)
-                if reason:
-                    raise faults.CheckpointCorruptError(f'{path}: {reason}')
-                self._note('LOADING', path)
-                params = self._loader(self.engine, path,
-                                      retry=self.retry)
-                self._note('WARMING', path)
-                placed = self.engine.place_params(params)
-                self.engine.warm_params(placed)
+                with span('registry.reload', 'serve', counter=counter):
+                    self._note('VERIFYING', path)
+                    reason = checkpoint.verify_model_digest(path)
+                    if reason:
+                        raise faults.CheckpointCorruptError(
+                            f'{path}: {reason}')
+                    self._note('LOADING', path)
+                    params = self._loader(self.engine, path,
+                                          retry=self.retry)
+                    self._note('WARMING', path)
+                    placed = self.engine.place_params(params)
+                    self.engine.warm_params(placed)
             except Exception as e:
                 # ANY failure (I/O, structure, device OOM during warm...)
                 # must reject-and-count: an uncounted error would re-run
@@ -220,7 +224,8 @@ class ModelRegistry:
                 self.log.record('serve_reload_reject',
                                 f'checkpoint {counter} rejected: {e!r}')
                 continue
-            self.engine.swap_params(placed, version=counter)
+            with span('registry.swap', 'serve', counter=counter):
+                self.engine.swap_params(placed, version=counter)
             self.current = counter
             with self._lock:
                 self.swaps += 1
@@ -256,7 +261,29 @@ class ModelRegistry:
         stats.gauge('blacklisted',
                     sum(1 for v in self._attempts.copy().values()
                         if v >= self.retry.max_attempts))
-        return stats.print(name)
+        return format_report(name, stats)
+
+    def status_view(self) -> dict:
+        """The /statusz JSON shape for one registry (state machine tail
+        + swap stamps); the guarded stamps snapshot under the lock."""
+        current = self.current     # single int, the poll loop's idiom
+        with self._lock:
+            return {'current': current,
+                    'swaps': self.swaps,
+                    'last_swap_step': self.last_swap_step,
+                    'transitions': [s for s, _ in self.transitions[-12:]]}
+
+    def register_into(self, hub, name: str = 'registry'):
+        """Register this registry's gauges + state-machine view into a
+        telemetry hub (ONE definition of the /metrics refresh and the
+        /statusz shape, shared by task=serve and the online pipeline so
+        the two can't drift).  Returns the hub-owned StatSet."""
+        from ..utils.metric import StatSet
+        stats = StatSet()
+        hub.register_stats(name, stats,
+                           refresh=lambda: self.report(stats=stats))
+        hub.register_status(name, self.status_view)
+        return stats
 
     # -- watcher lifecycle -------------------------------------------------
     def start(self) -> None:
@@ -628,4 +655,4 @@ class MultiModelRegistry:
         stats.gauge('evictions', self.evictions)
         for mid, nb in sorted(self.budgeter.resident().items()):
             stats.gauge(f'bytes[{mid}]', nb)
-        return stats.print(name)
+        return format_report(name, stats)

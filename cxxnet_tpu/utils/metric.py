@@ -146,8 +146,8 @@ class StatSet:
 
     def __init__(self, max_samples: int = 100_000):
         self._lock = threading.Lock()
-        self._counters: dict[str, float] = {}
-        self._samples: dict[str, list[float]] = {}
+        self._counters: dict[str, float] = {}       # guarded-by: _lock
+        self._samples: dict[str, list[float]] = {}  # guarded-by: _lock
         self._max_samples = int(max_samples)
 
     def inc(self, name: str, v: float = 1.0) -> None:
@@ -175,10 +175,33 @@ class StatSet:
             return self._counters.get(name, default)
 
     def clear(self) -> None:
-        """Reset every counter and distribution (per-round reporting)."""
+        """Reset every counter and distribution.  NOTE: a separate
+        ``print()``-then-``clear()`` sequence LOSES any update that
+        lands between the two lock acquisitions — per-round reporting
+        from a live pipeline must use :meth:`drain` /
+        :meth:`print_and_clear`, which swap the state out under ONE
+        lock hold."""
         with self._lock:
             self._counters.clear()
             self._samples.clear()
+
+    def snapshot(self) -> tuple:
+        """Consistent ``(counters, samples)`` copies under one lock
+        hold — the read every renderer (eval line, Prometheus, statusz,
+        flight dumps) goes through."""
+        with self._lock:
+            return (dict(self._counters),
+                    {k: list(v) for k, v in self._samples.items() if v})
+
+    def drain(self) -> tuple:
+        """Atomic snapshot-and-reset (epoch swap): returns
+        ``(counters, samples)`` and leaves the set empty, under ONE
+        lock hold — an update racing the drain lands either in the
+        returned epoch or the next one, never nowhere."""
+        with self._lock:
+            counters, self._counters = self._counters, {}
+            samples, self._samples = self._samples, {}
+            return counters, {k: v for k, v in samples.items() if v}
 
     def quantile(self, name: str, q: float) -> float:
         with self._lock:
@@ -188,19 +211,16 @@ class StatSet:
         return float(np.quantile(np.asarray(s), q))
 
     def print(self, evname: str) -> str:
-        with self._lock:
-            counters = dict(self._counters)
-            samples = {k: list(v) for k, v in self._samples.items() if v}
-        out = []
-        for key in sorted(counters):
-            out.append(f'\t{evname}-{key}:{counters[key]:g}')
-        for key in sorted(samples):
-            arr = np.asarray(samples[key])
-            out.append(f'\t{evname}-{key}.p50:{np.quantile(arr, 0.5):g}')
-            out.append(f'\t{evname}-{key}.p99:{np.quantile(arr, 0.99):g}')
-            out.append(f'\t{evname}-{key}.mean:{arr.mean():g}')
-            out.append(f'\t{evname}-{key}.n:{arr.size:g}')
-        return ''.join(out)
+        from ..obs.hub import format_report
+        return format_report(evname, self)
+
+    def print_and_clear(self, evname: str) -> str:
+        """Render one epoch's stats and reset atomically (see
+        :meth:`drain`) — the per-round reporting path
+        (``main._write_io_stats``)."""
+        from ..obs.hub import format_report_parts
+        counters, samples = self.drain()
+        return format_report_parts(evname, counters, samples)
 
 
 class MetricSet:

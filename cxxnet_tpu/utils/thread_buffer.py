@@ -68,11 +68,21 @@ class ThreadBuffer:
 
     def _run(self, q: queue.Queue, stop: threading.Event, box: list) -> None:
         try:
+            from ..obs import record_event
+            t_prev = time.monotonic_ns()
             for i, item in enumerate(self._make_iter()):
                 if self._fault_scope is not None:
                     from ..runtime import faults
                     faults.pipeline_item(self._fault_scope,
                                          self._fault_base + i)
+                    # per-batch production interval on the flight
+                    # recorder (batch-scoped buffers only — page and
+                    # instance buffers would drown the ring)
+                    now_ns = time.monotonic_ns()
+                    record_event('io.produce', 'io', t_start_ns=t_prev,
+                                 dur_ns=now_ns - t_prev,
+                                 index=self._fault_base + i)
+                    t_prev = now_ns
                 while not stop.is_set():
                     try:
                         q.put(item, timeout=0.1)

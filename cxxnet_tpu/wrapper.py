@@ -430,6 +430,19 @@ class Net:
         self._online = None
         self._online_thread = None
 
+    # --- telemetry (doc/observability.md) ---------------------------------
+    def obs_stats(self) -> str:
+        """One JSON snapshot of the process-wide telemetry hub — the
+        same body the ``/statusz`` endpoint serves: uptime, every
+        registered StatSet's counters, subsystem status views
+        (registry state machine, execution plan, elastic membership),
+        and the flight-recorder state.  Works with or without a loaded
+        model: the hub is process-wide."""
+        import json
+
+        from .obs import get_hub
+        return json.dumps(get_hub().status(), sort_keys=True, default=str)
+
     # --- weight access (visitor equivalent) -------------------------------
     def _resolve(self, layer_name: str):
         tr = self._require()

@@ -91,6 +91,13 @@ def pytest_configure(config):
         '(tier-1: runs under -m "not slow"; select with -m serve_spec)')
     config.addinivalue_line(
         'markers',
+        'obs: graftscope telemetry suite — hub registration, span '
+        'nesting + trace-id propagation, flight-recorder ring + '
+        'fault-triggered dumps, Prometheus/statusz endpoints, Chrome '
+        'trace export; CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m obs)')
+    config.addinivalue_line(
+        'markers',
         'dist: elastic multi-host training suite — coordinator/client '
         'membership, host-sharded stream bitwise twins, and the '
         'multi-process chaos drills (real worker subprocesses over '
@@ -105,7 +112,7 @@ def pytest_configure(config):
 # coordinator/heartbeat threads) precisely so this fixture can hold the
 # line on lifecycle
 _PIPELINE_THREAD_PREFIXES = ('cxxnet-tb-', 'cxxnet-pool-', 'cxxnet-decode-',
-                             'cxxnet-elastic-')
+                             'cxxnet-elastic-', 'cxxnet-obs-')
 
 
 def _pipeline_threads():

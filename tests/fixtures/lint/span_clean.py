@@ -1,0 +1,20 @@
+"""Fixture twin: span-hygiene-clean instrumentation — spans bracket
+the dispatch from the host side, context-manager (or decorator) form
+only."""
+from jax import lax
+
+from cxxnet_tpu.obs import span
+
+
+def _body(c, x):
+    return c + x, x
+
+
+def dispatch(xs, scan_fn):
+    with span('train.dispatch', 'train', k=4):
+        return scan_fn(xs)
+
+
+@span('train.round', 'train')
+def round_loop(xs):
+    return lax.scan(_body, 0, xs)

@@ -1,0 +1,19 @@
+"""Fixture: span-hygiene violations — a span inside a scanned body
+(host sync in the trace) and a manually-entered span (leaks on any
+exception before the end)."""
+from jax import lax
+
+from cxxnet_tpu.obs import span
+
+
+def train(xs):
+    def body(c, x):
+        with span('bad.step', 'train'):     # inside the lax.scan trace
+            return c + x, x
+    return lax.scan(body, 0, xs)
+
+
+def manual_begin(h):
+    s = span('leaky', 'io')                 # no `with`: manual begin
+    s.__enter__()
+    return s

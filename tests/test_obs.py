@@ -1,0 +1,527 @@
+"""graftscope telemetry suite (``-m obs``, doc/observability.md).
+
+The load-bearing claims:
+
+* the hub is ONE registry (idempotent registration, one eval-line
+  formatter every subsystem shares),
+* spans nest, inherit trace ids on a thread, and a serve request's
+  trace id appears on EVERY span of its lifecycle across the batcher
+  and engine threads,
+* the flight recorder is bounded and a ``TrainingFault`` reaching the
+  failure log dumps a postmortem that contains the failing span,
+* ``/metrics`` is valid Prometheus text (golden-pinned), ``/statusz``
+  is one JSON snapshot, the endpoint thread shuts down clean,
+* the CLI serves both live under ``task=online`` with ``obs.port=0``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.obs import (TelemetryHub, format_report, get_hub,
+                            install_hub, record_event, span)
+from cxxnet_tpu.obs.endpoints import ObsServer
+from cxxnet_tpu.runtime import faults
+from cxxnet_tpu.runtime.supervisor import SupervisorConfig, TrainSupervisor
+from cxxnet_tpu.serve.batcher import DynamicBatcher
+from cxxnet_tpu.utils.config import parse_config_string
+from cxxnet_tpu.utils.metric import StatSet
+from tests.test_net_mnist import MLP_CONF, synth_batches
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def hub():
+    """A fresh hub installed process-wide for the test (the production
+    wiring records through the module-level span()/record_event())."""
+    h = TelemetryHub(ring_events=256)
+    prev = install_hub(h)
+    yield h
+    h.disarm()
+    install_hub(prev)
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+# --- hub registry -----------------------------------------------------------
+
+def test_register_stats_idempotent_and_replacing(hub):
+    s1, s2 = StatSet(), StatSet()
+    assert hub.register_stats('serve', s1) is s1
+    assert hub.register_stats('serve', s1) is s1      # re-register: no-op
+    assert hub.stat_sets() == {'serve': s1}
+    hub.register_stats('serve', s2)                   # restart: replaces
+    assert hub.stat_sets() == {'serve': s2}
+    hub.register_stats('io', s1)
+    assert sorted(hub.stat_sets()) == ['io', 'serve']
+    hub.unregister_stats('io')
+    assert sorted(hub.stat_sets()) == ['serve']
+
+
+def test_status_provider_errors_degrade_not_kill(hub):
+    hub.register_status('ok', lambda: {'x': 1})
+    hub.register_status('broken', lambda: 1 / 0)
+    st = hub.status()
+    assert st['status']['ok'] == {'x': 1}
+    assert 'error' in st['status']['broken']
+
+
+def test_format_report_is_the_one_formatter(hub):
+    """StatSet.print and every report() spell keys through
+    format_report — byte-identical output."""
+    s = StatSet()
+    s.inc('requests', 3)
+    s.gauge('queue_peak', 2)
+    s.inc('rows[b8]', 16)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        s.observe('latency_ms', v)
+    assert format_report('serve', s) == s.print('serve')
+    assert '\tserve-requests:3' in s.print('serve')
+    assert '\tserve-latency_ms.p50:2.5' in s.print('serve')
+
+
+def test_print_and_clear_never_loses_concurrent_updates():
+    """The satellite fix: render-and-reset is one atomic drain, so an
+    update racing the per-round report lands in this epoch or the next,
+    never nowhere (the old print()-then-clear() pair dropped it)."""
+    s = StatSet()
+    total = 20_000
+    done = threading.Event()
+
+    def writer():
+        for _ in range(total):
+            s.inc('n')
+        done.set()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    seen = 0.0
+    while not done.is_set():
+        counters, _ = s.drain()
+        seen += counters.get('n', 0.0)
+    t.join()
+    counters, _ = s.drain()
+    seen += counters.get('n', 0.0)
+    assert seen == total
+
+
+# --- spans ------------------------------------------------------------------
+
+def test_span_nesting_inherits_trace_id(hub):
+    with span('outer', 'test', trace_id='t-req'):
+        with span('inner', 'test'):
+            pass
+    with span('sibling', 'test'):
+        pass
+    evs = {e['name']: e for e in hub.events()}
+    assert evs['inner']['trace_id'] == 't-req'
+    assert evs['inner']['attrs']['parent'] == 'outer'
+    assert evs['outer']['trace_id'] == 't-req'
+    assert evs['sibling']['trace_id'] is None
+
+
+def test_span_records_error_kind_and_duration(hub):
+    with pytest.raises(ValueError):
+        with span('boom', 'test'):
+            raise ValueError('x')
+    ev = hub.events()[-1]
+    assert ev['name'] == 'boom'
+    assert ev['attrs']['error'] == 'ValueError'
+    assert ev['dur_ns'] >= 0
+
+
+def test_trace_id_propagates_across_threads(hub):
+    tid = hub.next_trace_id()
+
+    def worker():
+        with span('worker.step', 'test', trace_id=tid):
+            pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    with span('main.step', 'test', trace_id=tid):
+        pass
+    evs = [e for e in hub.events() if e['trace_id'] == tid]
+    assert {e['name'] for e in evs} == {'worker.step', 'main.step'}
+    assert len({e['thread'] for e in evs}) == 2
+
+
+def test_ring_is_bounded_newest_win(hub):
+    hub.set_ring(64)
+    for i in range(500):
+        record_event('e', 'test', n=i)
+    evs = hub.events()
+    assert len(evs) <= 64
+    assert evs[-1]['attrs']['n'] == 499        # newest survived
+
+
+def test_disabled_recorder_records_nothing(hub):
+    hub.enabled = False
+    with span('off', 'test'):
+        record_event('off2', 'test')
+    hub.enabled = True
+    assert [e for e in hub.events() if e['name'] in ('off', 'off2')] == []
+
+
+def test_span_decorator_form_respects_enabled_flips(hub):
+    """The decorator re-evaluates hub.enabled per CALL — decorating
+    while disabled must neither crash nor permanently disable the
+    site, and flipping enabled off silences a site decorated while
+    on."""
+    hub.enabled = False
+
+    @span('decorated', 'test', k=1)
+    def work():
+        return 42
+
+    assert work() == 42                      # disabled: no record, no crash
+    hub.enabled = True
+    assert work() == 42
+    evs = [e for e in hub.events() if e['name'] == 'decorated']
+    assert len(evs) == 1 and evs[0]['attrs']['k'] == 1
+    hub.enabled = False
+    work()
+    assert len([e for e in hub.events() if e['name'] == 'decorated']) == 1
+    hub.enabled = True
+
+
+# --- serve lifecycle trace propagation --------------------------------------
+
+class _StubEngine:
+    buckets = (4,)
+
+    def predict_scores(self, data):
+        return np.zeros((data.shape[0], 2), np.float32)
+
+
+def test_request_trace_id_spans_batcher_worker_threads(hub):
+    """One request's trace id stitches admit (client thread), queue
+    wait + forward + finish (worker thread) into one lifecycle."""
+    b = DynamicBatcher(_StubEngine(), max_queue=8, max_wait=0.001,
+                       deadline=5.0)
+    try:
+        req = b.submit_async(np.zeros((1, 3), np.float32))
+        b.wait(req)
+    finally:
+        b.close(timeout=5.0)
+    mine = [e for e in hub.events() if e['trace_id'] == req.trace_id]
+    names = {e['name'] for e in mine}
+    assert {'serve.admit', 'serve.queue', 'serve.finish'} <= names
+    assert len({e['thread'] for e in mine}) >= 2
+
+
+def test_decode_request_lifecycle_spans_in_chrome_trace(hub, tmp_path):
+    """Acceptance: a decode request's trace id appears on every span of
+    its lifecycle (admit -> queue -> prefill -> emit -> finish) in the
+    exported Chrome trace."""
+    from cxxnet_tpu.models import transformer as T
+    from cxxnet_tpu.serve.decode import DecodeService
+    cfg = T.TransformerConfig(vocab_size=64, d_model=32, num_heads=4,
+                              d_ff=48, num_stages=2, seq_len=32,
+                              attn='local')
+    params = T.init_params(np.random.RandomState(0), cfg)
+    svc = DecodeService(params, cfg, slots=2, pages=32, page_size=8,
+                        max_prompt=16, max_new_bound=8, deadline=60.0)
+    try:
+        req = svc.submit_async(np.arange(5, dtype=np.int32), max_new=4)
+        svc.batcher.wait(req)
+    finally:
+        svc.close(30.0)
+    out = str(tmp_path / 'trace.json')
+    hub.export_chrome_trace(out)
+    with open(out) as f:
+        trace = json.load(f)
+    mine = [e for e in trace['traceEvents'] if e.get('ph') == 'X'
+            and e['args'].get('trace_id') == req.trace_id]
+    names = {e['name'] for e in mine}
+    assert {'serve.admit', 'serve.queue', 'decode.prefill',
+            'decode.emit', 'decode.finish'} <= names, names
+    # shared decode.step spans exist but carry no request trace id
+    steps = [e for e in trace['traceEvents'] if e['name'] == 'decode.step']
+    assert steps and all('trace_id' not in e['args'] for e in steps)
+    # thread names are preserved via metadata events
+    assert any(e.get('ph') == 'M' and e['name'] == 'thread_name'
+               for e in trace['traceEvents'])
+
+
+# --- flight recorder dumps --------------------------------------------------
+
+def test_fault_plan_divergence_dumps_flight_record(hub, tmp_path):
+    """THE postmortem contract: drive a FaultPlan NaN through a real
+    supervised run until the supervisor gives up — the dump appears
+    without anyone calling dump(), and it contains the failing
+    dispatch span, the stat snapshots, and the failure log."""
+    hub.arm_flight_recorder(str(tmp_path / 'flight'))
+    hub.register_stats('probe', StatSet())
+    batches = synth_batches(n_batches=6)
+    plan = faults.FaultPlan(nan_at_step=(3,))
+    prev = faults.install_plan(plan)
+    tr = NetTrainer(parse_config_string(MLP_CONF))
+    tr.init_model()
+    log = faults.FailureLog()
+    sup = TrainSupervisor(
+        tr, str(tmp_path / 'sup'),
+        SupervisorConfig(batch_deadline=30.0, max_restarts=0,
+                         nan_breaker=1, retry=faults.NO_WAIT_RETRY),
+        failure_log=log)
+    try:
+        with pytest.raises(faults.DivergenceError):
+            sup.run(lambda k: iter(batches[k:]))
+    finally:
+        faults.install_plan(prev)
+        sup.close()
+    assert plan.fired() == ['nan_at_step=3']
+    dumps = sorted(os.listdir(tmp_path / 'flight'))
+    assert dumps, 'no flight dump written'
+    with open(tmp_path / 'flight' / dumps[0]) as f:
+        d = json.load(f)
+    assert d['reason'] in ('DivergenceError', 'giving_up')
+    span_names = {e['name'] for e in d['events']}
+    assert 'train.dispatch' in span_names        # the failing span
+    kinds = {r['kind'] for r in d['failure_log']}
+    assert 'DivergenceError' in kinds
+    assert 'probe' in d['stats']
+    # give-up also dumped (both are armed kinds), bounded by keep
+    assert len(dumps) <= TelemetryHub.DEFAULT_KEEP
+
+
+def test_dump_kinds_are_training_faults_only(hub, tmp_path):
+    hub.arm_flight_recorder(str(tmp_path / 'flight'))
+    log = faults.FailureLog()
+    log.record('io_retry', 'transient — not a fault')
+    log.record('serve_reload_reject', 'bad ckpt — serving concern')
+    assert not os.path.exists(tmp_path / 'flight')
+    log.record('PipelineStallError', 'stalled', step=3)
+    assert len(os.listdir(tmp_path / 'flight')) == 1
+
+
+def test_sigusr1_dumps_flight_record(hub, tmp_path):
+    import signal
+    hub.configure_dump(str(tmp_path / 'flight'))
+    if not hub.arm_signal_dump():
+        pytest.skip('SIGUSR1 unavailable on this platform')
+    record_event('before.signal', 'test')
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        # the handler runs in the main thread between bytecodes
+        deadline = time.monotonic() + 5
+        while not os.path.exists(tmp_path / 'flight') \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        dumps = os.listdir(tmp_path / 'flight')
+        assert len(dumps) == 1 and 'SIGUSR1' in dumps[0]
+    finally:
+        signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+
+
+# --- renderers / endpoints --------------------------------------------------
+
+GOLDEN_METRICS = '''\
+# TYPE cxxnet_serve_latency_ms_count gauge
+cxxnet_serve_latency_ms_count 4
+# TYPE cxxnet_serve_latency_ms_mean gauge
+cxxnet_serve_latency_ms_mean 2.5
+# TYPE cxxnet_serve_latency_ms_p50 gauge
+cxxnet_serve_latency_ms_p50 2.5
+# TYPE cxxnet_serve_latency_ms_p99 gauge
+cxxnet_serve_latency_ms_p99 3.97
+# TYPE cxxnet_serve_queue_peak gauge
+cxxnet_serve_queue_peak 2
+# TYPE cxxnet_serve_requests gauge
+cxxnet_serve_requests 3
+# TYPE cxxnet_serve_rows gauge
+cxxnet_serve_rows{tag="b8"} 16
+'''
+
+
+def test_prometheus_text_golden(hub):
+    """The exposition format is an advertised machine surface: pin it
+    byte-for-byte (minus the hub's own uptime/ring self-gauges)."""
+    s = StatSet()
+    s.inc('requests', 3)
+    s.gauge('queue_peak', 2)
+    s.inc('rows[b8]', 16)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        s.observe('latency_ms', v)
+    hub.register_stats('serve', s)
+    text = hub.metrics_text()
+    lines = [ln for ln in text.splitlines()
+             if 'cxxnet_obs_' not in ln]
+    assert '\n'.join(lines) + '\n' == GOLDEN_METRICS
+    # the hub self-gauges are present too
+    assert 'cxxnet_obs_events_recorded' in text
+    assert 'cxxnet_obs_uptime_seconds' in text
+
+
+def test_endpoints_serve_metrics_statusz_healthz(hub):
+    s = StatSet()
+    s.inc('tokens', 7)
+    hub.register_stats('decode', s,
+                       refresh=lambda: s.gauge('free_pages', 31))
+    hub.register_status('registry', lambda: {'current': 5,
+                                             'transitions': ['SWAPPED']})
+    srv = ObsServer(hub, port=0)
+    try:
+        assert _get(f'{srv.url}/healthz') == b'ok\n'
+        text = _get(f'{srv.url}/metrics').decode()
+        assert 'cxxnet_decode_tokens 7' in text
+        assert 'cxxnet_decode_free_pages 31' in text    # refresh ran
+        st = json.loads(_get(f'{srv.url}/statusz'))
+        for key in ('uptime_s', 'pid', 'stats', 'status', 'ring_events',
+                    'events_recorded', 'flight_dumps'):
+            assert key in st, key
+        assert st['stats']['decode']['tokens'] == 7
+        assert st['status']['registry']['current'] == 5
+        with pytest.raises(urllib.error.HTTPError):
+            _get(f'{srv.url}/nope')
+    finally:
+        assert srv.close(timeout=10.0)
+
+
+def test_endpoint_thread_clean_shutdown(hub):
+    srv = ObsServer(hub, port=0)
+    name = f'cxxnet-obs-{srv.port}'
+    assert any(t.name == name for t in threading.enumerate())
+    assert srv.close(timeout=10.0)
+    assert srv.close(timeout=1.0)       # idempotent
+    assert not any(t.name == name for t in threading.enumerate())
+    with pytest.raises(OSError):
+        _get(f'{srv.url}/healthz')
+
+
+# --- wrapper / capi surface -------------------------------------------------
+
+def test_wrapper_and_capi_obs_stats(hub):
+    from cxxnet_tpu import capi, wrapper
+    s = StatSet()
+    s.inc('served', 2)
+    hub.register_stats('online', s)
+    net = capi.net_create('cpu', '')
+    for payload in (wrapper.Net(dev='cpu').obs_stats(),
+                    capi.net_obs_stats(net)):
+        st = json.loads(payload)
+        assert st['stats']['online']['served'] == 2
+        assert 'uptime_s' in st
+
+
+# --- CLI e2e ----------------------------------------------------------------
+
+def test_cli_task_online_obs_port_ephemeral(tmp_path):
+    """One live process (task=online, obs.port=0) answers /metrics in
+    Prometheus text and /statusz in JSON WHILE training-and-serving,
+    with serve/freshness/registry gauges present; the Chrome trace
+    exports at exit."""
+    from tests.test_io import write_mnist
+    write_mnist(str(tmp_path), n=256, rows=8, cols=8, seed=4)
+    conf = tmp_path / 'online.conf'
+    conf.write_text(f"""
+data = train
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+  shuffle = 0
+iter = end
+pred = pred.txt
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+iter = end
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.05
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 3
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,64
+batch_size = 16
+dev = cpu
+eta = 0.05
+momentum = 0.9
+metric[label] = error
+task = online
+num_round = 2
+online.save_every = 5
+online.reload = 0.02
+online.qps = 100
+serve.buckets = 8,16
+obs.port = 0
+obs.trace_export = {tmp_path}/trace.json
+""")
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               PYTHONPATH=REPO + os.pathsep + os.environ.get('PYTHONPATH',
+                                                             ''))
+    out_path = tmp_path / 'stdout.txt'
+    with open(out_path, 'w') as out_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'cxxnet_tpu.main', str(conf)],
+            cwd=str(tmp_path), env=env, stdout=out_f,
+            stderr=subprocess.STDOUT, text=True)
+        try:
+            # the port line prints before init; poll it out of stdout
+            port = None
+            deadline = time.monotonic() + 120
+            while port is None and time.monotonic() < deadline:
+                for line in out_path.read_text().splitlines():
+                    if line.startswith('obs: telemetry on http://'):
+                        port = int(line.split(':')[3].split('/')[0].split()
+                                   [0])
+                        break
+                if port is None:
+                    assert proc.poll() is None, out_path.read_text()
+                    time.sleep(0.05)
+            assert port is not None, out_path.read_text()
+            base = f'http://127.0.0.1:{port}'
+            # poll /metrics until the serving stack registered (the
+            # pipeline starts a beat after the endpoint)
+            text = ''
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    break
+                try:
+                    text = _get(f'{base}/metrics').decode()
+                except OSError:
+                    time.sleep(0.1)
+                    continue
+                if 'cxxnet_serve_' in text and 'cxxnet_online_' in text \
+                        and 'cxxnet_registry_' in text:
+                    break
+                time.sleep(0.2)
+            assert 'cxxnet_serve_' in text, text[:2000]
+            assert 'cxxnet_online_' in text, text[:2000]
+            assert 'cxxnet_registry_last_swap_step' in text, text[:2000]
+            st = json.loads(_get(f'{base}/statusz'))
+            assert st['status']['execution_plan']['k'] >= 1
+            assert 'registry' in st['status']
+            assert _get(f'{base}/healthz') == b'ok\n'
+            rc = proc.wait(timeout=300)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    assert rc == 0, out_path.read_text()
+    # Chrome trace export landed with lifecycle spans inside
+    with open(tmp_path / 'trace.json') as f:
+        trace = json.load(f)
+    names = {e['name'] for e in trace['traceEvents']}
+    assert 'train.dispatch' in names
+    assert 'serve.finish' in names or 'serve.queue' in names

@@ -23,7 +23,7 @@ import pytest
 
 from cxxnet_tpu.analysis import (config_keys, core, fault_taxonomy,
                                  lock_discipline, monotonic_clock,
-                                 tracer_hygiene)
+                                 span_hygiene, tracer_hygiene)
 from cxxnet_tpu.analysis.core import (Finding, Repo, apply_suppressions,
                                       diff_against_baseline, load_baseline,
                                       run_all)
@@ -353,6 +353,65 @@ def test_clock_aliased_imports_caught():
     assert monotonic_clock.check_module(core.parse_snippet(src2)) == []
 
 
+# --- span-hygiene: fixtures --------------------------------------------------
+
+def test_span_traced_and_manual_begin_caught():
+    """Both halves of the rule fire on the seeded fixture: a span inside
+    a lax.scan body (host work in the trace) and a manually-entered
+    span (no `with`)."""
+    findings = span_hygiene.check_module(fixture('span_traced.py'))
+    assert rules_of(findings) == ['span-hygiene', 'span-hygiene']
+    msgs = ' | '.join(f.message for f in findings)
+    assert 'jitted/scanned scope' in msgs
+    assert 'context-manager form' in msgs
+
+
+def test_span_clean_twin_silent():
+    """With-form host-side spans (and the decorator form) pass."""
+    assert span_hygiene.check_module(fixture('span_clean.py')) == []
+
+
+def test_span_rule_keys_on_obs_import():
+    """A module with its own unrelated span() helper — and no obs
+    import — is out of scope (no misfires on foreign vocabulums)."""
+    src = '''\
+def span(x):
+    return x
+
+def use():
+    s = span(3)
+    return s
+'''
+    mod = core.parse_snippet(src, rel='cxxnet_tpu/foreign.py')
+    assert not span_hygiene._uses_obs(mod)
+    repo_like_findings = (span_hygiene.check_module(mod)
+                          if span_hygiene._uses_obs(mod) else [])
+    assert repo_like_findings == []
+
+
+def test_span_obs_package_exempt_from_form_only():
+    """The obs package constructs spans (its module-level span() helper
+    returns one) — exempt from the with-form check, NOT from the
+    traced-scope check."""
+    src = '''\
+from jax import lax
+from cxxnet_tpu.obs.hub import span
+
+def helper():
+    return span('ok', 'obs')
+
+def bad(xs):
+    def body(c, x):
+        with span('bad', 'obs'):
+            return c, x
+    return lax.scan(body, 0, xs)
+'''
+    mod = core.parse_snippet(src, rel='cxxnet_tpu/obs/extra.py')
+    findings = span_hygiene.check_module(mod)
+    assert rules_of(findings) == ['span-hygiene']
+    assert 'jitted/scanned scope' in findings[0].message
+
+
 # --- live repo: clean or exactly baselined -----------------------------------
 
 def test_live_repo_clean_or_baselined():
@@ -378,6 +437,10 @@ def test_live_monotonic_clean():
 
 def test_live_config_keys_documented():
     assert run_all(root=REPO, rules=['config-key-drift']) == []
+
+
+def test_live_span_hygiene_clean():
+    assert run_all(root=REPO, rules=['span-hygiene']) == []
 
 
 def test_live_threaded_classes_declare_guards():
