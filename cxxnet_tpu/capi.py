@@ -209,6 +209,13 @@ def net_obs_stats(net: Net) -> str:
     return net.obs_stats()
 
 
+def net_obs_slos(net: Net) -> str:
+    """The ``/slos`` JSON as one string: every attached SLO engine's
+    typed verdicts (doc/observability.md "SLOs and burn rates") — the
+    portless health surface for C embedders and the future autoscaler."""
+    return net.obs_slos()
+
+
 # ---- train-while-serve surface (CXNNetOnline*) ----------------------------
 
 def net_online_start(net: Net, it: DataIter, cfg: str) -> None:

@@ -117,7 +117,18 @@ class LearnTask:
         self.obs_trace_export = ''     # obs.trace_export Chrome-trace path
         self.obs_ring_events = 4096    # obs.ring_events flight-recorder ring
         self.obs_dump_dir = ''         # obs.dump_dir ('' = model_dir/flight)
+        # graftwatch: gauge history sampler + declarative SLO engine
+        # (doc/observability.md "SLOs and burn rates" / "Fleet view")
+        self.obs_sample_every = 0.0    # obs.sample_every s (0 = auto: on
+                                       # at 0.25s only when slo.* given)
+        self.obs_fleet_port = -1       # obs.fleet_port launcher merged
+                                       # endpoint: -1 off, 0 ephemeral
+        self.obs_trace_merge = ''      # obs.trace_merge merged Perfetto
+                                       # trace path (launcher role)
+        self.slo_specs: List[ConfigEntry] = []   # slo.<name> grammar
         self._obs_server = None
+        self._obs_sampler = None
+        self._obs_slo = None
         self.cfg: List[ConfigEntry] = []
         self.net_trainer: Optional[NetTrainer] = None
         self.itr_train = None
@@ -187,6 +198,9 @@ class LearnTask:
             'obs.trace_export': ('obs_trace_export', str),
             'obs.ring_events': ('obs_ring_events', int),
             'obs.dump_dir': ('obs_dump_dir', str),
+            'obs.sample_every': ('obs_sample_every', float),
+            'obs.fleet_port': ('obs_fleet_port', int),
+            'obs.trace_merge': ('obs_trace_merge', str),
             'online.save_every': ('online_save_every', int),
             'online.freshness_slo': ('online_freshness_slo', float),
             'online.freshness_strict': ('online_freshness_strict', int),
@@ -196,6 +210,22 @@ class LearnTask:
         if name in simple:
             attr, typ = simple[name]
             setattr(self, attr, typ(val))
+        if name.startswith('slo.') and len(name) > 4:
+            # declarative SLO grammar (doc/observability.md):
+            # slo.<name> = <set>.<key><op><threshold>@<window>[:burn];
+            # fleet.-scoped specs evaluate at the elastic launcher.
+            # Validated here so a bad spec fails at config parse, and
+            # @0 rejected outright: per-sample specs are fed through
+            # SLOEngine.observe by in-process code (the freshness
+            # path) — from the CLI one would never evaluate, a dead
+            # objective reading OK forever
+            from .obs.slo import SLOSpec
+            spec = SLOSpec.parse(name[4:], val)
+            if spec.window <= 0:
+                raise ValueError(
+                    f'{name}: @0 per-sample specs are engine-API-only '
+                    f'(SLOEngine.observe); give a window > 0 seconds')
+            self.slo_specs.append((name[4:], val))
         if name == 'output_format':
             self.output_format = 1 if val == 'txt' else 0
         self.cfg.append((name, val))
@@ -654,9 +684,15 @@ class LearnTask:
         """Arm the telemetry hub for this run: flight-recorder ring +
         fault-triggered dumps + SIGUSR1 are always armed (the recorder
         is the postmortem every chaos drill ships); the live
-        ``/metrics`` + ``/statusz`` + ``/healthz`` endpoint thread comes
-        up only with ``obs.port >= 0`` (0 = ephemeral — the bound port
-        prints to stdout)."""
+        ``/metrics`` + ``/statusz`` + ``/healthz`` + ``/slos`` endpoint
+        thread comes up only with ``obs.port >= 0`` (0 = ephemeral —
+        the bound port prints to stdout, and announces into
+        ``CXXNET_OBS_PORT_FILE`` when the elastic launcher set one).
+        Any ``slo.<name>=`` spec (or an explicit ``obs.sample_every``)
+        additionally starts the gauge-history sampler + SLO engine —
+        verdicts serve on ``/slos``/``/metrics``, a breach records the
+        typed ``SLOBreachError`` kind (which dumps a postmortem), and
+        ``/healthz`` reports ``degraded`` while one is BREACHED."""
         from .obs import get_hub
         hub = get_hub()
         if self.obs_ring_events > 0:
@@ -665,12 +701,47 @@ class LearnTask:
                                                      'flight')
         hub.arm_flight_recorder(dump_dir)
         hub.arm_signal_dump()
+        # fleet.-scoped specs belong to the launcher's cross-rank view;
+        # a worker evaluating one would only ever see "no data"
+        local_specs = [(n, v) for n, v in self.slo_specs
+                       if not v.startswith('fleet.')]
+        fleet_specs = [n for n, v in self.slo_specs
+                       if v.startswith('fleet.')]
+        if fleet_specs and not os.environ.get('CXXNET_OBS_PORT_FILE') \
+                and not self.silent:
+            # this process is neither the launcher (that role returned
+            # from _maybe_elastic_launch before ever reaching here) nor
+            # a worker under one (the launcher sets the port file) —
+            # nothing will evaluate these specs, and silence here would
+            # be the watching-nothing trap all over again
+            print(f"obs: warning — fleet-scoped "
+                  f"slo.{{{','.join(sorted(fleet_specs))}}} only "
+                  'evaluate at the elastic launcher (dist.hosts > 1); '
+                  'nothing watches them in this run', flush=True)
+        if local_specs or self.obs_sample_every > 0:
+            from .obs.history import GaugeSampler, hub_source
+            # <= 0 (including a -1 spelled like obs.port's off) means
+            # "auto": the 0.25s default cadence, never a clamped 100 Hz
+            self._obs_sampler = GaugeSampler(
+                hub_source(hub),
+                period=(self.obs_sample_every
+                        if self.obs_sample_every > 0 else 0.25))
+            if local_specs:
+                from .obs.slo import SLOEngine, SLOSpec
+                self._obs_slo = SLOEngine(self._obs_sampler.history)
+                for spec_name, text in local_specs:
+                    self._obs_slo.add(SLOSpec.parse(spec_name, text))
+                self._obs_slo.register_into(hub)
+                self._obs_sampler.add_listener(self._obs_slo.on_tick)
+            self._obs_sampler.start()
         if self.obs_port >= 0:
             from .obs.endpoints import ObsServer
-            self._obs_server = ObsServer(hub, port=self.obs_port)
+            self._obs_server = ObsServer(
+                hub, port=self.obs_port,
+                port_file=os.environ.get('CXXNET_OBS_PORT_FILE'))
             print(f'obs: telemetry on http://127.0.0.1:'
-                  f'{self._obs_server.port} (/metrics /statusz /healthz), '
-                  f'flight dumps in {dump_dir}', flush=True)
+                  f'{self._obs_server.port} (/metrics /statusz /healthz '
+                  f'/slos), flight dumps in {dump_dir}', flush=True)
 
     def _obs_register_iterators(self) -> None:
         """Instrumented io chains join the hub so their per-stage stats
@@ -691,6 +762,16 @@ class LearnTask:
                 print(f'obs: Chrome trace exported to {path} '
                       '(load in Perfetto; doc/observability.md)',
                       flush=True)
+        if self._obs_sampler is not None:
+            self._obs_sampler.close(timeout=5.0)
+            self._obs_sampler = None
+        if self._obs_slo is not None:
+            if not self.silent:
+                from .obs.slo import summary_lines
+                for line in summary_lines(self._obs_slo.status_view()):
+                    print(f'obs: {line}', flush=True)
+            self._obs_slo.close()
+            self._obs_slo = None
         if self._obs_server is not None:
             self._obs_server.close(timeout=5.0)
             self._obs_server = None
@@ -761,7 +842,9 @@ class LearnTask:
         from .obs import get_hub
         from .utils.metric import StatSet
         _hub = get_hub()
-        _hub.register_stats('serve', batcher.stats)
+        # the refresh folds the LIVE queue depth per render, so an SLO
+        # over serve.queue_depth reads admission pressure, not peaks
+        batcher.register_into(_hub)
         if registry is not None:
             registry.register_into(_hub)
         fleet = self._serve_fleet(engine)
@@ -1137,7 +1220,14 @@ class LearnTask:
             return ElasticLauncher(
                 argv=list(argv), hosts=self.dist_hosts,
                 rejoin=self.dist_rejoin, heartbeat=self.dist_heartbeat,
-                silent=bool(self.silent)).run()
+                silent=bool(self.silent),
+                # fleet observability: merged rank-labeled /metrics,
+                # cross-rank (fleet.*) SLOs, per-host-lane trace merge
+                fleet_port=self.obs_fleet_port,
+                sample_every=self.obs_sample_every,
+                slo_specs=[(n, v) for n, v in self.slo_specs
+                           if v.startswith('fleet.')],
+                trace_merge=self.obs_trace_merge).run()
         # classic jax.distributed world (param_server=dist / cluster
         # env): one global mesh over every host's devices
         from .parallel.distributed import maybe_init_distributed

@@ -6,23 +6,35 @@ Three legs, one hub:
   (StatSet registry, span flight recorder, Prometheus/statusz
   renderers, fault-triggered postmortem dumps, Chrome trace export),
 * :mod:`~cxxnet_tpu.obs.endpoints` — the ``/metrics`` + ``/statusz`` +
-  ``/healthz`` http thread (``obs.port=`` in the CLI),
+  ``/healthz`` + ``/slos`` http thread (``obs.port=`` in the CLI),
 * the ``span()`` / ``record_event()`` instrumentation every layer
   (io chain, train loop, serve request lifecycle, elastic protocol)
-  records through.
+  records through,
+* graftwatch — :mod:`~cxxnet_tpu.obs.history` (the ``obs.sample_every``
+  gauge-history sampler), :mod:`~cxxnet_tpu.obs.slo` (the declarative
+  ``slo.<name>=`` burn-rate engine with typed OK/AT_RISK/BREACHED
+  verdicts), and :mod:`~cxxnet_tpu.obs.fleet` (the elastic launcher's
+  merged rank-labeled scrape + per-host-lane trace merge).
 """
 
 from .hub import (TelemetryHub, format_report, get_hub, install_hub,
                   next_trace_id, record_event, span)
 
 __all__ = ['TelemetryHub', 'format_report', 'get_hub', 'install_hub',
-           'next_trace_id', 'record_event', 'span', 'ObsServer']
+           'next_trace_id', 'record_event', 'span', 'ObsServer',
+           'GaugeHistory', 'GaugeSampler', 'SLOEngine', 'SLOSpec']
 
 
 def __getattr__(name):
-    # endpoints import http.server lazily — embedders that never serve
-    # telemetry pay nothing for it
+    # endpoints/history/slo import lazily — embedders that never serve
+    # telemetry or evaluate SLOs pay nothing for them
     if name == 'ObsServer':
         from .endpoints import ObsServer
         return ObsServer
+    if name in ('GaugeHistory', 'GaugeSampler'):
+        from . import history
+        return getattr(history, name)
+    if name in ('SLOEngine', 'SLOSpec'):
+        from . import slo
+        return getattr(slo, name)
     raise AttributeError(name)

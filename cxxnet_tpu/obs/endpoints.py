@@ -1,36 +1,61 @@
-"""Live telemetry endpoints: ``/metrics``, ``/statusz``, ``/healthz``.
+"""Live telemetry endpoints: ``/metrics``, ``/statusz``, ``/healthz``,
+``/slos``.
 
 A stdlib ``http.server`` thread (no new dependencies) serving the
 process-wide :class:`~cxxnet_tpu.obs.hub.TelemetryHub`:
 
 * ``/metrics`` — Prometheus text exposition format rendered live from
   every registered ``StatSet`` (the machine-readable gauges ROADMAP
-  item 5's SLO autoscaler consumes),
+  item 5's SLO autoscaler consumes), including the SLO engine's
+  ``cxxnet_slo_verdict{tag=...}`` / ratio rows when one is attached,
 * ``/statusz`` — one JSON snapshot: registry state machines, freshness,
   page-pool/refcount/spec counters, elastic generation + membership,
   execution-plan choice — whatever the subsystems registered,
-* ``/healthz`` — liveness (``ok``).
+* ``/slos`` — the attached SLO engines' typed verdicts (state, burn
+  ratios, breach counts, window samples, verdict history) as one JSON
+  object; ``{}`` when no engine is attached,
+* ``/healthz`` — LIVENESS: always HTTP 200 while the process serves.
+  The body is ``ok``, or ``degraded`` while any SLO is BREACHED — so a
+  probe (or the future autoscaler) reads health without parsing
+  ``/slos``, while restart-on-non-200 semantics stay untouched (a
+  degraded process is alive and must keep serving).
 
 One serving thread (named ``cxxnet-obs-*`` so the test suite's
 thread-leak fixture holds the line on lifecycle); requests are handled
 serially — metrics scrapes are small and rare, and a single thread
 keeps shutdown deterministic.  ``port=0`` binds an ephemeral port
-(exposed as :attr:`ObsServer.port`); binding is loopback-only by
-default — fronting a fleet-visible scrape endpoint is a deployment
-concern, not the hub's.
+(exposed as :attr:`ObsServer.port`, and announced into ``port_file``
+when given — how each elastic rank tells the launcher's fleet scraper
+where it lives); binding is loopback-only by default — fronting a
+fleet-visible scrape endpoint is a deployment concern, not the hub's.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, HTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional, Tuple
 
-__all__ = ['ObsServer']
+__all__ = ['ObsServer', 'EndpointThread', 'PROM_CTYPE', 'TEXT_CTYPE',
+           'JSON_CTYPE', 'json_body']
+
+PROM_CTYPE = 'text/plain; version=0.0.4; charset=utf-8'
+TEXT_CTYPE = 'text/plain; charset=utf-8'
+JSON_CTYPE = 'application/json'
+
+#: path -> (content type, zero-arg render returning the body bytes)
+Routes = Dict[str, Tuple[str, Callable[[], bytes]]]
 
 
-class _Handler(BaseHTTPRequestHandler):
+def json_body(obj) -> bytes:
+    """One canonical JSON body spelling for every obs endpoint."""
+    return (json.dumps(obj, sort_keys=True, default=str)
+            + '\n').encode('utf-8')
+
+
+class _RoutedHandler(BaseHTTPRequestHandler):
     # quiet: scrape access logs are noise on the CLI's stderr
     def log_message(self, fmt, *args):  # noqa: D102 — stdlib override
         pass
@@ -43,46 +68,47 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 — stdlib naming
-        hub = self.server.hub
+        routes: Routes = self.server.routes
         path = self.path.split('?', 1)[0]
         try:
-            if path == '/healthz':
-                self._reply(200, 'text/plain; charset=utf-8', b'ok\n')
-            elif path == '/metrics':
-                body = hub.metrics_text().encode('utf-8')
-                self._reply(200, 'text/plain; version=0.0.4; '
-                                 'charset=utf-8', body)
-            elif path == '/statusz':
-                body = (json.dumps(hub.status(), sort_keys=True,
-                                   default=str) + '\n').encode('utf-8')
-                self._reply(200, 'application/json', body)
+            route = routes.get(path)
+            if route is None:
+                known = ' '.join(sorted(routes))
+                self._reply(404, TEXT_CTYPE,
+                            f'not found: {known}\n'.encode('utf-8'))
             else:
-                self._reply(404, 'text/plain; charset=utf-8',
-                            b'not found: /metrics /statusz /healthz\n')
+                ctype, render = route
+                self._reply(200, ctype, render())
         # lint: allow(fault-taxonomy): an endpoint render error must answer 500 to the scraper, never kill the serving thread
         except Exception as e:
             try:
-                self._reply(500, 'text/plain; charset=utf-8',
+                self._reply(500, TEXT_CTYPE,
                             f'error: {e!r}\n'.encode('utf-8'))
             except OSError:
                 pass                 # client went away mid-error
 
 
-class ObsServer:
-    """The telemetry endpoint thread.  ``port=0`` = ephemeral (read
-    :attr:`port` after construction); :meth:`close` is idempotent and
-    joins the serving thread."""
+class EndpointThread:
+    """The shared endpoint scaffolding every obs server rides: one
+    bound stdlib ``HTTPServer`` + a named daemon serving thread,
+    route-table dispatch (404 lists the known paths, a render error
+    answers 500), and an idempotent :meth:`close` that joins the
+    thread.  Requests are handled serially — scrapes are small and
+    rare, and a single thread keeps shutdown deterministic.  Thread
+    names start ``cxxnet-obs-`` so the test suite's leak fixture holds
+    the line on lifecycle."""
 
-    def __init__(self, hub, port: int = 0, host: str = '127.0.0.1'):
-        self.hub = hub
-        self._srv = HTTPServer((host, int(port)), _Handler)
-        self._srv.hub = hub
+    def __init__(self, routes: Routes, port: int = 0,
+                 host: str = '127.0.0.1',
+                 thread_prefix: str = 'cxxnet-obs'):
+        self._srv = HTTPServer((host, int(port)), _RoutedHandler)
+        self._srv.routes = routes
         self.host = host
         self.port = int(self._srv.server_address[1])
         self._closed = False
         self._thread = threading.Thread(
             target=self._srv.serve_forever, kwargs={'poll_interval': 0.1},
-            daemon=True, name=f'cxxnet-obs-{self.port}')
+            daemon=True, name=f'{thread_prefix}-{self.port}')
         self._thread.start()
 
     @property
@@ -100,3 +126,30 @@ class ObsServer:
             return False
         self._thread.join(timeout)
         return not self._thread.is_alive()
+
+
+class ObsServer(EndpointThread):
+    """The per-process telemetry endpoint thread over a
+    :class:`~cxxnet_tpu.obs.hub.TelemetryHub`.  ``port=0`` = ephemeral
+    (read :attr:`port` after construction); ``port_file=`` atomically
+    writes the bound port for out-of-process discovery (the elastic
+    launcher reads one per rank)."""
+
+    def __init__(self, hub, port: int = 0, host: str = '127.0.0.1',
+                 port_file: Optional[str] = None):
+        self.hub = hub
+        super().__init__({
+            '/healthz': (TEXT_CTYPE,
+                         lambda: f'{hub.health()}\n'.encode('utf-8')),
+            '/metrics': (PROM_CTYPE,
+                         lambda: hub.metrics_text().encode('utf-8')),
+            '/statusz': (JSON_CTYPE, lambda: json_body(hub.status())),
+            '/slos': (JSON_CTYPE, lambda: json_body(hub.slos_view())),
+        }, port=port, host=host)
+        if port_file:
+            # temp+rename: a concurrent reader sees the whole port or
+            # no file, never a partial write
+            tmp = f'{port_file}.tmp.{os.getpid()}'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                f.write(f'{self.port}\n')
+            os.replace(tmp, port_file)

@@ -1,0 +1,321 @@
+"""Fleet-wide observability: scrape every rank, serve one merged view.
+
+PR 11's elastic runtime runs N worker processes, each with its own
+isolated TelemetryHub + ObsServer — exactly the cross-host blind spot
+the distributed-training literature blames most multi-host debugging
+pain on.  This module is the launcher-side cure (doc/observability.md
+"Fleet view"):
+
+* :class:`FleetScraper` — polls each rank's loopback ``/metrics`` /
+  ``/statusz`` / ``/healthz``, merges the Prometheus text into ONE
+  exposition with a ``rank`` label on every sample
+  (``cxxnet_elastic_steps{rank="1"} 42``), and aggregates label-less
+  gauges across ranks into ``fleet.<name>.min/.max/.mean/.sum`` —
+  the sampler source fleet-scoped SLOs (``slo.x = fleet...``) evaluate
+  burn rates over.  A dead rank degrades to absence (its rows drop,
+  ``ranks_alive`` dips, ``/statusz`` marks it) — the scrape itself
+  survives any single rank's death by construction.
+* :class:`FleetServer` — the merged endpoints on the launcher:
+  ``/metrics`` (rank-labeled union), ``/statusz`` (per-rank health,
+  generation, membership + the fleet SLO verdicts), ``/healthz``
+  (``degraded`` while a fleet SLO is BREACHED, still 200), ``/slos``.
+* :func:`merge_chrome_traces` — folds each rank's exported Chrome
+  trace into one Perfetto file with one process lane per host (pid =
+  rank, ``process_name`` = ``host rank R``), so a cross-host timeline
+  reads as lanes instead of N files.
+
+Discovery is file-based: each worker's ObsServer announces its
+ephemeral port into ``CXXNET_OBS_PORT_FILE`` (endpoints.py), one file
+per rank, re-written by respawned incarnations — the launcher polls
+the files from its existing supervision loop.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+from typing import Dict, List, Optional
+
+from .endpoints import (JSON_CTYPE, PROM_CTYPE, TEXT_CTYPE,
+                        EndpointThread, json_body)
+
+__all__ = ['FleetScraper', 'FleetServer', 'merge_chrome_traces',
+           'merge_metrics', 'parse_gauges']
+
+#: one Prometheus sample line: name, optional {labels}, value
+_SAMPLE_RE = re.compile(
+    r'^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(\S+)$')
+
+
+def parse_gauges(text: str) -> Dict[str, float]:
+    """Label-less samples of one exposition as ``{name: value}`` with
+    the ``cxxnet_`` prefix stripped (labeled rows are per-tag detail;
+    fleet aggregation reads the totals)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith('#'):
+            continue
+        m = _SAMPLE_RE.match(line.strip())
+        if m is None or m.group(2):
+            continue
+        name = m.group(1)
+        if name.startswith('cxxnet_'):
+            name = name[len('cxxnet_'):]
+        try:
+            out[name] = float(m.group(3))
+        except ValueError:
+            continue
+    return out
+
+
+def merge_metrics(texts: Dict[int, Optional[str]]) -> str:
+    """Merge per-rank expositions into one: every sample gains a
+    ``rank`` label (first position, so per-rank series never collide),
+    ``# TYPE`` lines dedupe, metric names sort."""
+    types: Dict[str, str] = {}
+    samples: Dict[str, List[str]] = {}
+    for rank in sorted(texts):
+        text = texts[rank]
+        if not text:
+            continue
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith('# TYPE '):
+                parts = line.split()
+                if len(parts) >= 3:
+                    types.setdefault(parts[2], line)
+                continue
+            if line.startswith('#'):
+                continue
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                continue
+            name, labels, value = m.groups()
+            inner = f'rank="{rank}"'
+            if labels:
+                inner = f'{inner},{labels[1:-1]}' if labels != '{}' \
+                    else inner
+            samples.setdefault(name, []).append(
+                f'{name}{{{inner}}} {value}')
+    lines: List[str] = []
+    for name in sorted(samples):
+        lines.append(types.get(name, f'# TYPE {name} gauge'))
+        lines.extend(samples[name])
+    return '\n'.join(lines) + '\n' if lines else ''
+
+
+def merge_chrome_traces(paths: Dict[int, str],
+                        out_path: str) -> Optional[str]:
+    """Fold per-rank Chrome traces into one Perfetto file: rank R's
+    events move to ``pid=R`` with a ``process_name`` metadata row
+    (``host rank R``), so each host renders as its own lane group.
+    Unreadable/missing inputs (a killed incarnation never exports) are
+    skipped; returns ``out_path``, or None when nothing merged."""
+    merged: List[dict] = []
+    for rank in sorted(paths):
+        try:
+            with open(paths[rank], encoding='utf-8') as f:
+                events = json.load(f).get('traceEvents', [])
+        except (OSError, ValueError):
+            continue
+        for e in events:
+            e = dict(e)
+            e['pid'] = rank
+            merged.append(e)
+        merged.append({'ph': 'M', 'name': 'process_name', 'pid': rank,
+                       'tid': 0, 'args': {'name': f'host rank {rank}'}})
+    if not merged:
+        return None
+    with open(out_path, 'w', encoding='utf-8') as f:
+        json.dump({'traceEvents': merged, 'displayTimeUnit': 'ms'}, f,
+                  default=str)
+    return out_path
+
+
+class FleetScraper:
+    """Poll each registered rank's ObsServer and merge (module
+    docstring).  Thread-safe: the launcher loop registers targets and
+    paces sampling while the FleetServer thread scrapes per GET."""
+
+    def __init__(self, timeout: float = 2.0):
+        self.timeout = float(timeout)
+        self._lock = threading.Lock()
+        self._targets: Dict[int, str] = {}     # guarded-by: _lock
+        self._alive: Dict[int, bool] = {}      # guarded-by: _lock
+        self._errors = 0                       # guarded-by: _lock
+        self._last_texts: Dict[int, str] = {}  # guarded-by: _lock
+
+    def add_target(self, rank: int, url: str) -> None:
+        """Register (or re-register after a respawn) one rank's base
+        URL, e.g. ``http://127.0.0.1:43121``."""
+        with self._lock:
+            self._targets[int(rank)] = url.rstrip('/')
+
+    def targets(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._targets)
+
+    def scrape_errors(self) -> int:
+        with self._lock:
+            return self._errors
+
+    def alive(self) -> Dict[int, bool]:
+        """Rank -> did its last scrape answer."""
+        with self._lock:
+            return dict(self._alive)
+
+    def last_merged(self) -> str:
+        """The newest-known exposition PER RANK merged into one (for
+        consumers reading after the run): each rank's rows are from its
+        newest successful scrape, so a staggered teardown — or a rank
+        that died mid-run — can never shrink the post-run artifact to a
+        partial fleet (the live :meth:`merged_metrics` is where a dead
+        rank's rows drop).  Empty until any rank ever answered."""
+        with self._lock:
+            texts = dict(self._last_texts)
+            alive = sum(1 for v in self._alive.values() if v)
+            total = len(self._targets)
+            errors = self._errors
+        if not texts:
+            return ''
+        return merge_metrics(texts) + self._self_gauges(alive, total,
+                                                        errors)
+
+    @staticmethod
+    def _self_gauges(alive: int, total: int, errors: int) -> str:
+        """The fleet self-gauge suffix both expositions share (the
+        live merge and the post-run snapshot must never drift)."""
+        return ('# TYPE cxxnet_fleet_ranks_alive gauge\n'
+                f'cxxnet_fleet_ranks_alive {alive}\n'
+                '# TYPE cxxnet_fleet_ranks_total gauge\n'
+                f'cxxnet_fleet_ranks_total {total}\n'
+                '# TYPE cxxnet_fleet_scrape_errors_total gauge\n'
+                f'cxxnet_fleet_scrape_errors_total {errors}\n')
+
+    def _get(self, url: str) -> str:
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            return r.read().decode('utf-8', 'replace')
+
+    def scrape(self, path: str = '/metrics') -> Dict[int, Optional[str]]:
+        """One pass over every target; a rank that does not answer maps
+        to None (and is marked not alive) — one dead rank never stalls
+        or fails the fleet view."""
+        out: Dict[int, Optional[str]] = {}
+        for rank, url in sorted(self.targets().items()):
+            try:
+                text = self._get(url + path)
+                out[rank] = text
+                with self._lock:
+                    self._alive[rank] = True
+                    if path == '/metrics':
+                        self._last_texts[rank] = text
+            except (OSError, ValueError):
+                out[rank] = None
+                with self._lock:
+                    self._alive[rank] = False
+                    self._errors += 1
+        return out
+
+    def merged_metrics(self) -> str:
+        """Live rank-labeled union of every rank's ``/metrics``, plus
+        the fleet self-gauges."""
+        texts = self.scrape()
+        alive = sum(1 for t in texts.values() if t)
+        with self._lock:
+            errors = self._errors
+        return merge_metrics(texts) + self._self_gauges(
+            alive, len(texts), errors)
+
+    def source(self) -> Dict[str, float]:
+        """The fleet gauge dict a :class:`GaugeSampler` records —
+        cross-rank aggregates under the ``fleet.`` set: for every
+        label-less gauge present on any rank, ``fleet.<name>.min`` /
+        ``.max`` / ``.mean`` / ``.sum``, plus membership counts.  The
+        grammar's fleet-scoped SLOs (steps/sec floor = a ``.rate`` over
+        ``fleet.elastic_steps.max``; a latency-distribution ceiling
+        reads the rank's already-rendered quantile row, underscore-
+        joined exactly as on ``/metrics``: ``fleet.serve_wait_ms_p99.max``)
+        read these."""
+        texts = self.scrape()
+        per = {r: parse_gauges(t) for r, t in texts.items() if t}
+        out: Dict[str, float] = {
+            'fleet.ranks_alive': float(len(per)),
+            'fleet.ranks_total': float(len(texts)),
+        }
+        names = set()
+        for gauges in per.values():
+            names.update(gauges)
+        for name in names:
+            vals = [per[r][name] for r in per if name in per[r]]
+            if not vals:
+                continue
+            out[f'fleet.{name}.min'] = min(vals)
+            out[f'fleet.{name}.max'] = max(vals)
+            out[f'fleet.{name}.mean'] = sum(vals) / len(vals)
+            out[f'fleet.{name}.sum'] = float(sum(vals))
+        return out
+
+    def statusz(self) -> dict:
+        """Per-rank fleet health: liveness, the rank's ``/healthz``
+        body, and its ``/statusz`` elastic section (generation, steps,
+        incarnation, membership shards) when it answers."""
+        ranks: Dict[str, dict] = {}
+        for rank, url in sorted(self.targets().items()):
+            entry: Dict[str, object] = {'url': url}
+            try:
+                entry['health'] = self._get(url + '/healthz').strip()
+                st = json.loads(self._get(url + '/statusz'))
+                entry['alive'] = True
+                entry['elastic'] = st.get('status', {}).get('elastic')
+                entry['uptime_s'] = st.get('uptime_s')
+            except (OSError, ValueError):
+                # deliberately NOT counted into _errors: that gauge
+                # means "metrics scrapes that failed" — a /statusz
+                # render probing a dead rank must not inflate it at
+                # the dashboard's poll rate
+                entry['alive'] = False
+            ranks[str(rank)] = entry
+        return ranks
+
+
+class FleetServer(EndpointThread):
+    """The launcher's merged telemetry endpoint thread (loopback, like
+    ObsServer, riding the same :class:`EndpointThread` scaffolding;
+    ``port=0`` ephemeral).  ``engine`` (optional) is the fleet-scoped
+    :class:`~cxxnet_tpu.obs.slo.SLOEngine` behind ``/slos`` and the
+    degraded ``/healthz``."""
+
+    def __init__(self, scraper: FleetScraper, engine=None, port: int = 0,
+                 host: str = '127.0.0.1'):
+        self.scraper = scraper
+        self.engine = engine
+        super().__init__({
+            '/healthz': (TEXT_CTYPE, self._healthz),
+            '/metrics': (PROM_CTYPE,
+                         lambda: scraper.merged_metrics()
+                         .encode('utf-8')),
+            '/slos': (JSON_CTYPE,
+                      lambda: json_body({} if engine is None
+                                        else engine.status_view())),
+            '/statusz': (JSON_CTYPE, self._statusz),
+        }, port=port, host=host, thread_prefix='cxxnet-obs-fleet')
+
+    def _healthz(self) -> bytes:
+        body = 'ok'
+        if self.engine is not None and self.engine.breached():
+            body = 'degraded'
+        return f'{body}\n'.encode('utf-8')
+
+    def _statusz(self) -> bytes:
+        return json_body({
+            'ranks': self.scraper.statusz(),
+            'targets': {str(r): u for r, u in
+                        self.scraper.targets().items()},
+            'scrape_errors': self.scraper.scrape_errors(),
+            'slos': ({} if self.engine is None
+                     else self.engine.status_view()),
+        })

@@ -194,6 +194,9 @@ class TelemetryHub:
         self._dump_seq = 0             # guarded-by: _lock
         self.dumps: List[str] = []     # guarded-by: _lock
         self._listener = None
+        # SLO engines (obs/slo.py) attached via attach_slo: what /slos
+        # merges, /healthz degrades on, and a postmortem dump includes
+        self._slo_engines: List[object] = []   # guarded-by: _lock
 
     # -- StatSet / status registries ---------------------------------------
     def register_stats(self, name: str, stats,
@@ -214,6 +217,52 @@ class TelemetryHub:
     def stat_sets(self) -> Dict[str, object]:
         with self._lock:
             return {k: v[0] for k, v in self._stats.items()}
+
+    def attach_slo(self, engine) -> None:
+        """Put an SLO engine (obs/slo.py) on the hub's roster: its
+        verdicts merge into ``/slos`` and :meth:`slos_view`, a BREACHED
+        objective flips :meth:`health` to ``degraded``, and every
+        flight dump carries its window samples + verdict history."""
+        with self._lock:
+            if engine not in self._slo_engines:
+                self._slo_engines.append(engine)
+
+    def detach_slo(self, engine) -> None:
+        with self._lock:
+            try:
+                self._slo_engines.remove(engine)
+            except ValueError:
+                pass
+
+    def slo_engines(self) -> List[object]:
+        with self._lock:
+            return list(self._slo_engines)
+
+    def slos_view(self) -> dict:
+        """Every attached engine's verdicts merged into one dict (the
+        ``/slos`` body); empty when no engine is attached."""
+        out: Dict[str, object] = {}
+        for eng in self.slo_engines():
+            try:
+                out.update(eng.status_view())
+            # lint: allow(fault-taxonomy): a broken engine view must degrade its own entries, never the endpoint or a postmortem dump
+            except Exception as e:
+                out[f'error:{type(eng).__name__}'] = repr(e)
+        return out
+
+    def health(self) -> str:
+        """``'ok'``, or ``'degraded'`` while any attached SLO engine
+        holds a BREACHED objective.  Both answer HTTP 200 — ``/healthz``
+        stays a *liveness* probe (a degraded process is alive and still
+        serving); readiness-style consumers read the body or ``/slos``."""
+        for eng in self.slo_engines():
+            try:
+                if eng.breached():
+                    return 'degraded'
+            # lint: allow(fault-taxonomy): health must fail open (alive) when a verdict read breaks, never take the endpoint down
+            except Exception:
+                continue
+        return 'ok'
 
     def register_status(self, name: str, provider: Callable[[], object]):
         """Register a ``/statusz`` JSON provider (a zero-arg callable
@@ -343,6 +392,50 @@ class TelemetryHub:
             out.append((name, counters, samples))
         return out
 
+    #: newest samples per distribution a sampler tick reduces over —
+    #: bounds the per-tick cost no matter how large an uncleared
+    #: serving StatSet grows (a full copy-and-sort of a ~100k-sample
+    #: latency list at 20 Hz measurably taxed the decode hot path)
+    SAMPLE_TAIL = 512
+
+    def gauge_snapshot(self) -> Dict[str, float]:
+        """One flat ``{'<set>.<key>': value}`` snapshot of every
+        registered StatSet (refreshed) plus the hub self-gauges — the
+        sampler source behind ``obs.sample_every`` (obs/history.py).
+        Distributions expand to ``.p50/.p99/.mean`` over the newest
+        :attr:`SAMPLE_TAIL` samples (recent behavior is what a
+        time-series ring wants, and the bounded read keeps the tick
+        O(tail) off the recording threads' lock) plus ``.n`` = total
+        retained count, so history keys spell exactly like their
+        ``/metrics`` rows."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            regs = sorted(self._stats.items())
+        for name, (stats, refresh) in regs:
+            if refresh is not None:
+                try:
+                    refresh()
+                # lint: allow(fault-taxonomy): a broken gauge refresher must degrade that one stat set, never the sampler tick
+                except Exception:
+                    pass
+            view = getattr(stats, 'tail_view', None)
+            if view is not None:
+                counters, samples = view(self.SAMPLE_TAIL)
+            else:   # duck-typed stats object: unbounded fallback
+                counters, samples = stats.snapshot()
+                samples = {k: (v, len(v)) for k, v in samples.items()}
+            for key, v in counters.items():
+                out[f'{name}.{key}'] = float(v)
+            for key, (vals, n) in samples.items():
+                arr = np.asarray(vals, dtype=np.float64)
+                out[f'{name}.{key}.p50'] = float(np.quantile(arr, 0.5))
+                out[f'{name}.{key}.p99'] = float(np.quantile(arr, 0.99))
+                out[f'{name}.{key}.mean'] = float(arr.mean())
+                out[f'{name}.{key}.n'] = float(n)
+        out['obs.events_recorded'] = float(self._events_n)
+        out['obs.uptime_s'] = (time.monotonic_ns() - self._t0_ns) / 1e9
+        return out
+
     @staticmethod
     def _prom_name(set_name: str, key: str) -> Tuple[str, str]:
         """``('serve', 'latency_ms[b8]') -> ('cxxnet_serve_latency_ms',
@@ -452,6 +545,11 @@ class TelemetryHub:
             'stats': {name: counters for name, counters, _s in
                       self._refreshed_snapshots()},
         }
+        slos = self.slos_view()
+        if slos:
+            # the breaching window's samples + verdict history ride
+            # every postmortem (the SLO-drill acceptance contract)
+            payload['slos'] = slos
         os.makedirs(self._dump_dir, exist_ok=True)
         path = os.path.join(self._dump_dir,
                             f'flight_{os.getpid()}_{seq:03d}_{tag}.json')
@@ -469,9 +567,10 @@ class TelemetryHub:
 
     def arm_flight_recorder(self, dump_dir: str,
                             keep: int = DEFAULT_KEEP) -> None:
-        """Arm automatic postmortems: any ``TrainingFault`` subclass (or
-        supervisor give-up) reaching a ``FailureLog`` dumps the flight
-        record to ``dump_dir`` — every chaos drill and real incident
+        """Arm automatic postmortems: any ``TrainingFault`` or
+        ``SLOBreachError`` subclass kind (or a supervisor give-up)
+        reaching a ``FailureLog`` dumps the flight record to
+        ``dump_dir`` — every chaos drill, SLO breach, and real incident
         ships its own postmortem.  Idempotent; :meth:`disarm` removes
         the listener."""
         from ..runtime import faults
@@ -481,7 +580,8 @@ class TelemetryHub:
 
         def listener(rec, log):
             if rec.kind != 'giving_up' \
-                    and rec.kind not in faults.training_fault_kinds():
+                    and rec.kind not in faults.training_fault_kinds() \
+                    and rec.kind not in faults.slo_breach_kinds():
                 return
             try:
                 self.dump(rec.kind, log=log)

@@ -12,13 +12,18 @@ measures that lag per hot swap as THREE timestamps per checkpoint step:
   (``PredictEngine.on_serve``; only the FIRST request per version
   closes the measurement).
 
-``freshness_s`` = first-serve time − step time, observed per swap.  A
-sample above ``slo_s`` increments the breach counter, records a
-``freshness_slo_breach`` failure-log entry carrying the typed
-:class:`~cxxnet_tpu.runtime.faults.FreshnessSLOError`, and is surfaced
-on the eval line — breaching the SLO degrades *observability state*,
-never availability (the stale model keeps serving; strict callers raise
-the typed error at run boundaries via :meth:`check_strict`).
+``freshness_s`` = first-serve time − step time, observed per swap.
+Breach judgment runs through the generic SLO engine
+(:mod:`~cxxnet_tpu.obs.slo` — the tracker was its first consumer): a
+``window=0`` per-sample spec named ``freshness`` whose error factory
+builds the typed
+:class:`~cxxnet_tpu.runtime.faults.FreshnessSLOError` and whose breach
+records keep the historical ``freshness_slo_breach`` failure-log kind.
+Every sample above ``slo_s`` increments the breach counter and is
+surfaced on the eval line — breaching the SLO degrades *observability
+state*, never availability (the stale model keeps serving; strict
+callers raise the typed error at run boundaries via
+:meth:`check_strict`).
 """
 
 from __future__ import annotations
@@ -28,6 +33,7 @@ import time
 from typing import Dict, Optional
 
 from ..obs import format_report
+from ..obs.slo import SLOEngine, SLOSpec
 from ..runtime import faults
 from ..utils.metric import StatSet
 
@@ -54,8 +60,26 @@ class FreshnessTracker:
         self._served = set()          # versions whose first serve is in
         self.stats = StatSet()
         self.swaps = 0
-        self.breaches = 0
-        self.last_breach: Optional[faults.FreshnessSLOError] = None
+        # breach judgment is the generic engine's (obs/slo.py): one
+        # per-sample (window=0) spec, typed-error factory, historical
+        # log kind — the tracker only measures
+        self.slo = SLOEngine(log=self.log)
+        if self.slo_s > 0:
+            self.slo.add(
+                SLOSpec(name='freshness', key='online.freshness_s',
+                        op='<=', threshold=self.slo_s, window=0.0,
+                        kind='freshness_slo_breach'),
+                err_factory=lambda spec, value, n, ctx:
+                    faults.FreshnessSLOError(ctx.get('step', -1), value,
+                                             self.slo_s, n))
+
+    @property
+    def breaches(self) -> int:
+        return self.slo.breaches('freshness')
+
+    @property
+    def last_breach(self) -> Optional[faults.FreshnessSLOError]:
+        return self.slo.last_breach
 
     def _prune_locked(self) -> None:
         """Bound the per-version maps to the newest MAX_VERSIONS steps
@@ -106,13 +130,12 @@ class FreshnessTracker:
             return None
         fresh = now - t0
         self.stats.observe('freshness_s', fresh)
-        if self.slo_s > 0 and fresh > self.slo_s:
-            with self._lock:
-                self.breaches += 1
-                n = self.breaches
-            err = faults.FreshnessSLOError(version, fresh, self.slo_s, n)
-            self.last_breach = err
-            self.log.record('freshness_slo_breach', str(err), step=version)
+        if self.slo_s > 0:
+            # the generic engine judges the sample: a violation counts
+            # the breach, builds the typed FreshnessSLOError, and logs
+            # the historical kind — same observable behavior as the
+            # deleted bespoke path, one engine for every SLO
+            self.slo.observe('freshness', fresh, step=version)
         return fresh
 
     # -- reporting ---------------------------------------------------------
@@ -145,5 +168,4 @@ class FreshnessTracker:
 
     def check_strict(self) -> None:
         """Raise the last typed breach (strict mode, run boundaries)."""
-        if self.breaches and self.last_breach is not None:
-            raise self.last_breach
+        self.slo.check_strict()

@@ -239,11 +239,16 @@ class OnlinePipeline:
         # hub: /metrics serves the batcher/freshness/registry gauges and
         # /statusz the registry state machine while the process runs
         hub = get_hub()
-        hub.register_stats('serve', self.batcher.stats)
+        self.batcher.register_into(hub)
         hub.register_stats('online', self.tracker.stats,
                            refresh=self._refresh_online_gauges)
         self.registry.register_into(hub)
         hub.register_status('online', self.summary)
+        # the freshness SLO engine joins the hub roster: its verdict
+        # rides /slos + /metrics, and a breached freshness flips
+        # /healthz to degraded (the stale model keeps serving — the
+        # endpoint stays 200/alive)
+        self.tracker.slo.register_into(hub, name='online_slo')
         if self.request_source is not None:
             self._traffic_stop.clear()
             self._traffic_thread = threading.Thread(
@@ -441,6 +446,7 @@ class OnlinePipeline:
             hub.unregister_stats(name)
         for name in ('online', 'registry'):
             hub.unregister_status(name)
+        self.tracker.slo.close()
         self._traffic_stop.set()
         t = self._traffic_thread
         if t is not None:

@@ -1236,13 +1236,27 @@ class ElasticLauncher:
     the membership service.  A worker that dies (preemption drill,
     crash, kill -9) is respawned with an incremented
     ``CXXNET_ELASTIC_INCARNATION`` while the ``dist.rejoin`` budget
-    lasts; it rejoins the rendezvous and the run continues."""
+    lasts; it rejoins the rendezvous and the run continues.
+
+    Fleet observability (doc/observability.md "Fleet view"): with
+    ``fleet_port >= 0``, fleet-scoped ``slo_specs``, or a
+    ``trace_merge`` path, every worker gets an ephemeral ObsServer
+    (``obs.port=0``) announcing its port into a per-rank file; the
+    launcher scrapes each rank's ``/metrics`` into ONE rank-labeled
+    exposition (``obs.fleet_port=``), evaluates ``fleet.*`` SLOs across
+    ranks from its own supervision loop, and at run end merges the
+    per-rank Chrome traces into one Perfetto file with a lane per host.
+    The scrape survives any rank's mid-run death — a dead rank's rows
+    drop and ``cxxnet_fleet_ranks_alive`` dips until the respawn."""
 
     def __init__(self, argv: List[str], hosts: int, rejoin: int = 2,
                  heartbeat: float = 2.0, worker_cmd: Optional[List[str]]
                  = None, env: Optional[Dict[str, str]] = None,
                  cwd: Optional[str] = None, silent: bool = False,
-                 poll: float = 0.2):
+                 poll: float = 0.2, fleet_port: int = -1,
+                 sample_every: float = 0.5,
+                 slo_specs: Optional[List[Tuple[str, str]]] = None,
+                 trace_merge: str = ''):
         self.argv = list(argv)
         self.hosts = int(hosts)
         self.rejoin = int(rejoin)
@@ -1254,6 +1268,32 @@ class ElasticLauncher:
         self.poll = float(poll)
         self.coordinator: Optional[ElasticCoordinator] = None
         self.respawns: List[Tuple[int, int]] = []   # (rank, incarnation)
+        # fleet observability (None until the first worker announces)
+        self.fleet_port = int(fleet_port)
+        # <= 0 = "auto" (mirrors main._obs_start): the fleet default
+        # cadence, never a negative clamped into a 100 Hz scrape loop
+        self.sample_every = (float(sample_every)
+                             if float(sample_every) > 0 else 0.5)
+        self.slo_specs = list(slo_specs or [])
+        self.trace_merge = str(trace_merge or '')
+        self.fleet_server = None
+        self.fleet_scraper = None
+        self.fleet_slo = None
+        self.fleet_verdicts: Dict[str, dict] = {}
+        self.fleet_metrics = ''
+        self._sampler = None
+        self._obs_dir: Optional[str] = None
+        self._ports: Dict[int, int] = {}     # rank -> announced port
+
+    def _fleet_enabled(self) -> bool:
+        return (self.fleet_port >= 0 or bool(self.trace_merge)
+                or bool(self.slo_specs))
+
+    def _port_file(self, rank: int) -> str:
+        return os.path.join(self._obs_dir, f'rank{rank}.port')
+
+    def _trace_file(self, rank: int) -> str:
+        return os.path.join(self._obs_dir, f'trace_rank{rank}.json')
 
     def _spawn(self, rank: int, incarnation: int, addr: str):
         import subprocess
@@ -1267,15 +1307,98 @@ class ElasticLauncher:
         cmd = list(self.worker_cmd
                    or [sys.executable, '-m', 'cxxnet_tpu.main'])
         cmd += self.argv
+        if self._obs_dir is not None:
+            # ephemeral per-rank endpoint + port announce file; the
+            # respawned incarnation re-announces into the same path, so
+            # the scraper follows it to the new port
+            env['CXXNET_OBS_PORT_FILE'] = self._port_file(rank)
+            cmd += ['obs.port=0']
+            if self.trace_merge:
+                cmd += [f'obs.trace_export={self._trace_file(rank)}']
         cmd += [f'dist.hosts={self.hosts}', f'dist.rank={rank}',
                 f'dist.coordinator={addr}']
         return subprocess.Popen(cmd, env=env, cwd=self.cwd)
+
+    def _fleet_poll(self) -> None:
+        """One supervision-loop beat of the fleet leg: adopt newly
+        announced rank ports, stand the merged endpoint + SLO engine up
+        once the first rank answers, and pace the fleet sampler."""
+        if self._obs_dir is None:
+            return
+        from ..obs.fleet import FleetScraper, FleetServer
+        for rank in range(self.hosts):
+            try:
+                with open(self._port_file(rank), encoding='utf-8') as f:
+                    port = int(f.read().strip() or 0)
+            except (OSError, ValueError):
+                continue
+            if port and self._ports.get(rank) != port:
+                self._ports[rank] = port
+                if self.fleet_scraper is None:
+                    self.fleet_scraper = FleetScraper()
+                self.fleet_scraper.add_target(
+                    rank, f'http://127.0.0.1:{port}')
+        if self.fleet_scraper is None:
+            return
+        if self._sampler is None:
+            from ..obs.history import GaugeSampler
+            self._sampler = GaugeSampler(self.fleet_scraper.source,
+                                         period=self.sample_every)
+            if self.slo_specs:
+                from ..obs.slo import SLOEngine, SLOSpec
+                self.fleet_slo = SLOEngine(self._sampler.history)
+                for name, text in self.slo_specs:
+                    self.fleet_slo.add(SLOSpec.parse(name, text))
+                self._sampler.add_listener(self.fleet_slo.on_tick)
+        if self.fleet_server is None and self.fleet_port >= 0:
+            self.fleet_server = FleetServer(self.fleet_scraper,
+                                            engine=self.fleet_slo,
+                                            port=self.fleet_port)
+            if not self.silent:
+                print(f'obs: fleet telemetry on {self.fleet_server.url} '
+                      '(/metrics /statusz /healthz /slos, rank labels)',
+                      flush=True)
+        # ONE scrape per beat serves both consumers: the sampler's
+        # source() pass feeds the SLO history AND refreshes the
+        # scraper's per-rank snapshots behind last_merged() — a second
+        # scrape here would double every rank's GET (and double the
+        # stall window a hung rank can inflict on this loop)
+        self._sampler.maybe_tick()
+
+    def _fleet_close(self) -> None:
+        if self.fleet_scraper is not None:
+            self.fleet_metrics = self.fleet_scraper.last_merged()
+        if self.fleet_slo is not None:
+            self.fleet_verdicts = self.fleet_slo.status_view()
+            if not self.silent:
+                from ..obs.slo import summary_lines
+                for line in summary_lines(self.fleet_verdicts):
+                    print(f'[fleet] {line}', flush=True)
+        if self.fleet_server is not None:
+            self.fleet_server.close(timeout=5.0)
+        if self._sampler is not None:
+            self._sampler.close(timeout=5.0)
+        if self.trace_merge and self._obs_dir is not None:
+            from ..obs.fleet import merge_chrome_traces
+            out = merge_chrome_traces(
+                {r: self._trace_file(r) for r in range(self.hosts)},
+                self.trace_merge)
+            if out and not self.silent:
+                print(f'obs: merged fleet Chrome trace -> {out} '
+                      '(one lane per host; load in Perfetto)', flush=True)
+        if self._obs_dir is not None:
+            import shutil
+            shutil.rmtree(self._obs_dir, ignore_errors=True)
+            self._obs_dir = None
 
     def run(self) -> int:
         coord = ElasticCoordinator(self.hosts,
                                    heartbeat_timeout=self.heartbeat * 5)
         self.coordinator = coord
         addr = coord.start()
+        if self._fleet_enabled():
+            import tempfile
+            self._obs_dir = tempfile.mkdtemp(prefix='cxxnet-fleet-')
         incarn = {r: 0 for r in range(self.hosts)}
         procs = {r: self._spawn(r, 0, addr) for r in range(self.hosts)}
         done: Dict[int, int] = {}
@@ -1306,6 +1429,16 @@ class ElasticLauncher:
                         rc_final = rc
                         # lint: allow(fault-taxonomy): launcher-internal control flow, caught below
                         raise _LaunchAborted(rank, rc)
+                if not done:
+                    # sample only while NO rank has finished cleanly: a
+                    # crashed/killed rank never enters `done` (it gets
+                    # respawned), so every MID-run death still dips
+                    # ranks_alive and the SLOs see it — but once the
+                    # first rank completes, the fleet is winding down
+                    # and a staggered-exit beat would overwrite the
+                    # last full view with a partial one (and book a
+                    # bogus teardown breach)
+                    self._fleet_poll()
         except _LaunchAborted as e:
             if not self.silent:
                 print(f'elastic launcher: rank {e.rank} failed rc='
@@ -1317,6 +1450,12 @@ class ElasticLauncher:
             for p in procs.values():
                 p.wait()
         finally:
+            # NO parting scrape: the workers are (mostly) gone by now,
+            # and sampling the empty fleet would overwrite the last
+            # live snapshot with an all-dead window and book a bogus
+            # teardown breach — fleet_metrics/fleet_verdicts keep the
+            # newest state observed while ranks were answering
+            self._fleet_close()
             coord.stop()
         return rc_final
 

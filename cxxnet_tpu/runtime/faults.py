@@ -222,24 +222,68 @@ class PrefixIndexFullError(ServeError):
             f'(serve.prefix_share cap is {cap}): request served unshared')
 
 
-class FreshnessSLOError(ServeError):
+class SLOBreachError(RuntimeError):
+    """A declarative SLO (``slo.<name>=`` config grammar, evaluated by
+    the ``obs.slo`` engine; doc/observability.md "SLOs and burn rates")
+    transitioned to BREACHED: the watched gauge violated its threshold
+    over BOTH the long and the short burn-rate window.  An
+    *observability* outcome, never control flow inside the serving or
+    training path: the engine counts breaches, records this typed kind
+    into the failure log — which arms the flight-recorder postmortem —
+    and strict callers raise it at run boundaries via
+    ``SLOEngine.check_strict``.  Deliberately NOT a
+    :class:`TrainingFault`: a breached objective is a degraded state to
+    alarm on, not a fault a checkpoint restore could repair."""
+
+    def __init__(self, msg: str, name: str = '', measure=None,
+                 threshold=None, window: float = 0.0, ratio=None,
+                 breaches: int = 1):
+        self.name = str(name)
+        self.measure = measure
+        self.threshold = threshold
+        self.window = float(window)
+        self.ratio = ratio
+        self.breaches = int(breaches)
+        super().__init__(msg)
+
+
+def slo_breach_kinds() -> set:
+    """The ``record()`` kind strings denoting a typed
+    :class:`SLOBreachError` — the second family (after
+    :func:`training_fault_kinds`) that arms a flight-recorder dump."""
+    out = set()
+    stack = [SLOBreachError]
+    while stack:
+        cls = stack.pop()
+        out.add(cls.__name__)
+        stack.extend(cls.__subclasses__())
+    return out
+
+
+class FreshnessSLOError(SLOBreachError, ServeError):
     """The train-while-serve freshness SLO was breached: a hot-swapped
     model version took longer than ``online.freshness_slo`` seconds to
     travel from its optimizer step to the first request served on it
-    (doc/online.md).  An *observability* outcome, not a request error:
-    the pipeline counts breaches per swap and only raises (strict mode)
-    at run boundaries — a stale-but-correct model must keep serving."""
+    (doc/online.md).  The first consumer of the generic SLO engine (a
+    per-swap ``window=0`` spec) — and still a :class:`ServeError` for
+    embedders that route serving-side outcomes by that base.  The
+    pipeline counts breaches per swap and only raises (strict mode) at
+    run boundaries — a stale-but-correct model must keep serving; its
+    breach records keep the historical ``freshness_slo_breach`` kind,
+    which deliberately does NOT arm a postmortem dump."""
 
     def __init__(self, step: int, freshness_s: float, slo_s: float,
                  breaches: int = 1):
         self.step = int(step)
         self.freshness_s = float(freshness_s)
         self.slo_s = float(slo_s)
-        self.breaches = int(breaches)
-        super().__init__(
+        SLOBreachError.__init__(
+            self,
             f'freshness SLO breached: checkpoint step {step} first served '
             f'{freshness_s:.3f}s after its optimizer step '
-            f'(slo={slo_s:g}s, {breaches} breach(es) total)')
+            f'(slo={slo_s:g}s, {breaches} breach(es) total)',
+            name='freshness', measure=freshness_s, threshold=slo_s,
+            breaches=breaches)
 
 
 class MemoryBudgetExceededError(ServeError):

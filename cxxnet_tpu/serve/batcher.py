@@ -321,6 +321,24 @@ class DynamicBatcher:
         self._worker.join(timeout)
         return not self._worker.is_alive()
 
+    def depth(self) -> int:
+        """Requests pending admission right now — the pull-style gauge
+        :meth:`register_into` folds in per ``/metrics`` render, so
+        queue-pressure SLOs (``serve.queue_depth<=...``) see the live
+        queue, not just the event-time peaks."""
+        with self._cond:
+            return len(self._q)
+
+    def register_into(self, hub, name: str = 'serve') -> None:
+        """THE ``serve`` stat registration (task=serve and the online
+        pipeline share it, so the gauge spelling can't drift): the
+        batcher's StatSet plus a refresh folding the live queue depth
+        in per render."""
+        hub.register_stats(
+            name, self.stats,
+            refresh=lambda: self.stats.gauge('queue_depth',
+                                             self.depth()))
+
     def report(self, name: str = 'serve') -> str:
         """Eval-line-format stats snapshot (``utils.metric.StatSet``),
         with overall requests/sec appended — rendered by the hub's one

@@ -193,6 +193,19 @@ class StatSet:
             return (dict(self._counters),
                     {k: list(v) for k, v in self._samples.items() if v})
 
+    def tail_view(self, tail: int) -> tuple:
+        """Bounded read for high-frequency samplers (the obs gauge
+        history): counters copy plus, per distribution, ``(newest
+        `tail` samples, total retained count)`` — one lock hold,
+        O(tail) per distribution, so a 100k-sample serving latency
+        list never rides the sampler tick (a full :meth:`snapshot`
+        copy-and-sort at 20 Hz measurably taxed the decode hot path
+        through this very lock)."""
+        with self._lock:
+            return (dict(self._counters),
+                    {k: (v[-tail:], len(v))
+                     for k, v in self._samples.items() if v})
+
     def drain(self) -> tuple:
         """Atomic snapshot-and-reset (epoch swap): returns
         ``(counters, samples)`` and leaves the set empty, under ONE

@@ -443,6 +443,19 @@ class Net:
         from .obs import get_hub
         return json.dumps(get_hub().status(), sort_keys=True, default=str)
 
+    def obs_slos(self) -> str:
+        """The attached SLO engines' typed verdicts as one JSON object —
+        the same body the ``/slos`` endpoint serves (state, burn
+        ratios, breach counts, window samples, verdict history per
+        objective; ``{}`` when no engine is attached).  The embedder's
+        portless way to read health the way the future autoscaler will
+        (doc/observability.md "SLOs and burn rates")."""
+        import json
+
+        from .obs import get_hub
+        return json.dumps(get_hub().slos_view(), sort_keys=True,
+                          default=str)
+
     # --- weight access (visitor equivalent) -------------------------------
     def _resolve(self, layer_name: str):
         tr = self._require()

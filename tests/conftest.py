@@ -98,6 +98,15 @@ def pytest_configure(config):
         '(tier-1: runs under -m "not slow"; select with -m obs)')
     config.addinivalue_line(
         'markers',
+        'slo: graftwatch SLO suite — gauge-history rings + sampler, '
+        'the slo.<name>= grammar, multi-window burn-rate verdicts '
+        '(OK/AT_RISK/BREACHED), freshness-through-the-engine '
+        'equivalence, /slos + degraded /healthz endpoints, '
+        'breach-triggered postmortems, fleet scrape/merge units; '
+        'CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m slo)')
+    config.addinivalue_line(
+        'markers',
         'dist: elastic multi-host training suite — coordinator/client '
         'membership, host-sharded stream bitwise twins, and the '
         'multi-process chaos drills (real worker subprocesses over '

@@ -1,0 +1,24 @@
+"""Clean twin of history_unguarded.py: the ring declares its guard and
+both the sampler thread and the public reader hold it — the shape
+obs/history.py ships."""
+
+import threading
+import time
+
+
+class HistoryPump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.ring = []                   # guarded-by: _lock
+        self._thread = threading.Thread(target=self._sample, daemon=True)
+
+    def _sample(self):
+        while not self._stop.wait(0.05):
+            with self._lock:
+                self.ring = (self.ring
+                             + [(time.monotonic(), 1.0)])[-256:]
+
+    def window(self):
+        with self._lock:
+            return list(self.ring)

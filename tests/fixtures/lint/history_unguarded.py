@@ -1,0 +1,24 @@
+"""Seeded violation: a sampler thread rebinds a bounded history ring
+that a public window() reader walks, with no guard declared and no
+lock held — the torn-ring regression class the lock-discipline checker
+must catch on graftwatch-shaped code (a reader can observe the list
+mid-rebind and lose the tail).  Twin: history_clean.py."""
+
+import threading
+import time
+
+
+class HistoryPump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.ring = []
+        self._thread = threading.Thread(target=self._sample, daemon=True)
+
+    def _sample(self):
+        while not self._stop.wait(0.05):
+            # worker write, no lock: rebind-to-bound loses the race
+            self.ring = (self.ring + [(time.monotonic(), 1.0)])[-256:]
+
+    def window(self):
+        return list(self.ring)           # public read, no lock

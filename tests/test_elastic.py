@@ -457,6 +457,88 @@ def test_cli_launcher_end_to_end(workdir, twin):
     _assert_params_equal(_final_params(workdir, 'm_cli'), twin)
 
 
+# --- fleet observability (graftwatch, doc/observability.md "Fleet view") ---
+
+
+def test_fleet_obs_merged_metrics_slos_and_trace(workdir, twin, tmp_path):
+    """Acceptance: 2 REAL worker ranks under the launcher with fleet
+    observability on — the merged /metrics carries both ranks' gauges
+    under rank labels, a fleet-scoped SLO evaluates to a typed verdict,
+    the merged Chrome trace loads with one lane per host, and the
+    scrape survives rank 1's mid-run death (host_loss drill) — all
+    while the run stays bitwise-twin."""
+    import json
+    import time as _time
+    import urllib.request
+
+    trace_out = str(tmp_path / 'fleet_trace.json')
+    la = ElasticLauncher(
+        argv=['elastic.conf', 'model_dir=m_fleet',
+              'train.fault_plan=host_loss=5:1'],
+        hosts=2, rejoin=2, heartbeat=1.0, env=_sub_env(),
+        cwd=str(workdir), fleet_port=0, sample_every=0.3,
+        slo_specs=[('progress', 'fleet.elastic_steps.max.rate>=0.01@6'),
+                   ('membership', 'fleet.ranks_alive>=1@3:10')],
+        trace_merge=trace_out)
+    rc_box = {}
+    t = threading.Thread(target=lambda: rc_box.setdefault('rc', la.run()))
+    t.start()
+    try:
+        deadline = _time.monotonic() + 180
+        while la.fleet_server is None and t.is_alive() \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        assert la.fleet_server is not None, 'fleet endpoint never came up'
+        url = la.fleet_server.url
+        text = ''
+        while t.is_alive() and _time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(f'{url}/metrics',
+                                            timeout=5) as r:
+                    text = r.read().decode()
+            except OSError:
+                _time.sleep(0.1)
+                continue
+            if ('cxxnet_elastic_steps{rank="0"}' in text
+                    and 'cxxnet_elastic_steps{rank="1"}' in text):
+                break
+            _time.sleep(0.2)
+        assert 'cxxnet_elastic_steps{rank="0"}' in text, text[:2000]
+        assert 'cxxnet_elastic_steps{rank="1"}' in text, text[:2000]
+        assert 'cxxnet_fleet_ranks_alive' in text
+        # the live /slos serves the typed fleet verdicts mid-run
+        with urllib.request.urlopen(f'{url}/slos', timeout=5) as r:
+            slos = json.loads(r.read())
+        assert set(slos) == {'progress', 'membership'}
+    finally:
+        t.join(300)
+    assert rc_box.get('rc') == 0
+    # the drill killed rank 1 mid-run; the scrape survived it and the
+    # respawned incarnation re-announced into the same port file
+    assert (1, 1) in la.respawns
+    assert 'cxxnet_elastic_steps{rank="0"}' in la.fleet_metrics
+    assert 'cxxnet_elastic_steps{rank="1"}' in la.fleet_metrics
+    # fleet-scoped verdicts captured at run end, typed states only
+    assert set(la.fleet_verdicts) == {'progress', 'membership'}
+    for v in la.fleet_verdicts.values():
+        assert v['state'] in ('OK', 'AT_RISK', 'BREACHED')
+    # burn=10 demands a SUSTAINED membership hole; the drill's dip (and
+    # any shutdown-window sample) must never read as a breach
+    assert la.fleet_verdicts['membership']['state'] in ('OK', 'AT_RISK')
+    # merged Perfetto trace: pid = rank = one lane group per host
+    with open(trace_out) as f:
+        trace = json.load(f)
+    events = trace['traceEvents']
+    assert {e['pid'] for e in events} == {0, 1}
+    lanes = {(e['pid'], e['args']['name']) for e in events
+             if e.get('ph') == 'M' and e['name'] == 'process_name'}
+    assert lanes == {(0, 'host rank 0'), (1, 'host rank 1')}
+    assert any(e['name'].startswith('elastic.') for e in events
+               if e.get('ph') == 'X')
+    # fleet observability never perturbs training: still the twin
+    _assert_params_equal(_final_params(workdir, 'm_fleet'), twin)
+
+
 # --- hardened jax.distributed init (satellite) -----------------------------
 
 

@@ -1,0 +1,627 @@
+"""graftwatch SLO suite (``-m slo``, doc/observability.md "SLOs and
+burn rates" / "Fleet view").
+
+The load-bearing claims:
+
+* gauge history is bounded, windowed, and reduces (rate/quantiles)
+  deterministically over explicit monotonic timestamps,
+* the ``slo.<name>=`` grammar parses into typed specs, and the engine
+  evaluates multi-window burn rates into OK / AT_RISK / BREACHED with
+  the no-flap property (a blip is AT_RISK, only a sustained violation
+  BREACHES, an ongoing breach counts once),
+* a breach records the typed ``SLOBreachError`` kind and the armed
+  flight recorder ships a postmortem containing the breaching window's
+  samples and verdict history — proven through a real FaultPlan drill,
+* the freshness SLO runs through the generic engine behavior-equal
+  (typed ``FreshnessSLOError``, historical log kind, strict raise),
+* ``/slos`` serves typed verdicts and ``/healthz`` reports
+  ``degraded`` (still 200) while any SLO is BREACHED,
+* per-rank ObsServers bind ephemeral ports without collision and the
+  fleet scraper/merger survives a rank's death (unit level here; the
+  real ≥2-rank acceptance run lives in test_elastic.py, ``-m dist``).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.obs import TelemetryHub, get_hub, install_hub
+from cxxnet_tpu.obs.endpoints import ObsServer
+from cxxnet_tpu.obs.fleet import (FleetScraper, FleetServer,
+                                  merge_chrome_traces, merge_metrics,
+                                  parse_gauges)
+from cxxnet_tpu.obs.history import GaugeHistory, GaugeSampler
+from cxxnet_tpu.obs.slo import (AT_RISK, BREACHED, OK, SLOEngine,
+                                SLOSpec)
+from cxxnet_tpu.runtime import faults
+from cxxnet_tpu.utils.metric import StatSet
+from cxxnet_tpu.utils.thread_buffer import ThreadBuffer
+
+pytestmark = pytest.mark.slo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def hub():
+    h = TelemetryHub(ring_events=256)
+    prev = install_hub(h)
+    yield h
+    h.disarm()
+    install_hub(prev)
+
+
+def _get(url):
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+# --- gauge history ----------------------------------------------------------
+
+def test_history_rings_bounded_and_windowed():
+    h = GaugeHistory(maxlen=8)
+    for i in range(20):
+        h.record(100.0 + i, {'a.x': float(i)})
+    pts = h.window('a.x', 100.0, now=119.0)
+    assert len(pts) == 8                       # bounded, newest win
+    assert pts[-1] == (119.0, 19.0)
+    assert h.window('a.x', 3.0, now=119.0) == [
+        (116.0, 16.0), (117.0, 17.0), (118.0, 18.0), (119.0, 19.0)]
+    assert h.window('a.x', 0.0) == [(119.0, 19.0)]   # per-sample window
+    assert h.window('missing', 5.0) == []
+    assert h.latest('a.x') == (119.0, 19.0)
+    assert h.has('a.x') and not h.has('a.y')
+
+
+def test_history_rate_and_quantile_reductions():
+    h = GaugeHistory()
+    for i in range(11):
+        h.record(50.0 + i, {'c.steps': 10.0 * i, 'c.lat': float(i)})
+    # slope over the window: 10 units/sec
+    assert h.reduce('c.steps', 'rate', 10.0, now=60.0) \
+        == pytest.approx(10.0)
+    assert h.reduce('c.lat', 'max', 4.0, now=60.0) == 10.0
+    assert h.reduce('c.lat', 'min', 4.0, now=60.0) == 6.0
+    assert h.reduce('c.lat', 'mean', 4.0, now=60.0) == 8.0
+    assert h.reduce('c.lat', 'p50', 4.0, now=60.0) == 8.0
+    # a one-point window has no slope
+    assert h.reduce('c.steps', 'rate', 0.5, now=60.0) is None
+    assert h.reduce('missing', 'mean', 5.0) is None
+    with pytest.raises(ValueError):
+        h.reduce('c.lat', 'median', 5.0)
+
+
+def test_sampler_ticks_listeners_and_thread_lifecycle():
+    vals = {'s.x': 1.0}
+    sampler = GaugeSampler(lambda: dict(vals), period=0.01)
+    seen = []
+    sampler.add_listener(lambda now, hist: seen.append(now))
+    sampler.tick(now=7.0)
+    vals['s.x'] = 2.0
+    sampler.tick(now=8.0)
+    assert [v for _t, v in sampler.history.window('s.x', 10.0,
+                                                  now=8.0)] == [1.0, 2.0]
+    assert seen == [7.0, 8.0]
+    # maybe_tick paces by period
+    assert sampler.maybe_tick(now=9.0) is True
+    assert sampler.maybe_tick(now=9.001) is False
+    assert sampler.maybe_tick(now=9.02) is True
+    # the thread form starts/stops clean (leak fixture holds the line)
+    sampler.start()
+    deadline = time.monotonic() + 5
+    while sampler.stats()[0] < 8 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert sampler.close(timeout=5.0)
+    assert not any(t.name == 'cxxnet-obs-sampler'
+                   for t in threading.enumerate() if t.is_alive())
+
+
+def test_sampler_broken_source_degrades_not_raises():
+    sampler = GaugeSampler(lambda: 1 / 0, period=0.01)
+    sampler.tick(now=1.0)
+    ticks, errors = sampler.stats()
+    assert (ticks, errors) == (0, 1)
+
+
+def test_hub_gauge_snapshot_spells_like_metrics(hub):
+    s = StatSet()
+    s.inc('requests', 3)
+    s.inc('rows[b8]', 16)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        s.observe('latency_ms', v)
+    hub.register_stats('serve', s)
+    snap = hub.gauge_snapshot()
+    assert snap['serve.requests'] == 3.0
+    assert snap['serve.rows[b8]'] == 16.0
+    assert snap['serve.latency_ms.p50'] == 2.5
+    assert snap['serve.latency_ms.n'] == 4.0
+    assert snap['obs.uptime_s'] > 0
+
+
+def test_gauge_snapshot_reduces_newest_tail_only(hub):
+    """The sampler tick is O(SAMPLE_TAIL) per distribution: quantiles
+    reduce the NEWEST tail (recent behavior — what a time-series ring
+    wants) while ``.n`` keeps the true retained count, so an uncleared
+    100k-sample serving latency list never rides the 20 Hz tick."""
+    s = StatSet()
+    for v in range(10_000):                 # old regime: 0..9999
+        s.observe('lat', float(v))
+    for _ in range(hub.SAMPLE_TAIL):        # new regime: constant 1e6
+        s.observe('lat', 1e6)
+    hub.register_stats('serve', s)
+    snap = hub.gauge_snapshot()
+    assert snap['serve.lat.p50'] == 1e6     # newest tail only
+    assert snap['serve.lat.n'] == 10_000 + hub.SAMPLE_TAIL
+    counters, samples = s.tail_view(4)
+    assert samples['lat'] == ([1e6] * 4, 10_000 + hub.SAMPLE_TAIL)
+
+
+# --- spec grammar -----------------------------------------------------------
+
+def test_spec_grammar_parses_ops_window_burn():
+    sp = SLOSpec.parse('fresh', 'online.freshness_s.p99<=0.25@60')
+    assert (sp.key, sp.op, sp.threshold, sp.window, sp.burn) == \
+        ('online.freshness_s.p99', '<=', 0.25, 60.0, 1.0)
+    sp = SLOSpec.parse('floor', 'fleet.elastic_steps.max.rate>=2@30:2.5')
+    assert (sp.op, sp.threshold, sp.window, sp.burn) == \
+        ('>=', 2.0, 30.0, 2.5)
+    assert sp.describe() == 'fleet.elastic_steps.max.rate>=2@30:2.5'
+    assert SLOSpec.parse('d', 'serve.queue_depth<32@5').op == '<'
+    assert SLOSpec.parse('t', 'a.b>1e-3@0.5').threshold == 1e-3
+    assert not SLOSpec.parse('k', 'a.b<=5@1').violates(5.0)
+    assert SLOSpec.parse('k', 'a.b<=5@1').violates(5.1)
+
+
+@pytest.mark.parametrize('bad', [
+    'nodots<=1@5',          # key must be <set>.<key>
+    'a.b!=1@5',             # unknown op
+    'a.b<=1',               # window required
+    'a.b<=@5',              # threshold required
+    'a.b<=1@5:',            # dangling burn
+])
+def test_spec_grammar_rejects_malformed(bad):
+    with pytest.raises(ValueError, match='slo.x'):
+        SLOSpec.parse('x', bad)
+
+
+# --- windowed verdicts ------------------------------------------------------
+
+def _engine(spec_text, log=None):
+    hist = GaugeHistory()
+    # `is None`, not truthiness: an EMPTY FailureLog is falsy
+    eng = SLOEngine(hist,
+                    log=log if log is not None else faults.FailureLog())
+    eng.add(SLOSpec.parse('obj', spec_text))
+    return hist, eng
+
+
+def test_multi_window_verdict_transitions_no_flap():
+    """THE verdict contract: blip -> AT_RISK, sustained -> BREACHED
+    (counted once, typed record in the log), recovery -> OK."""
+    log = faults.FailureLog()
+    hist, eng = _engine('probe.err<=5@12:10', log=log)   # alarm at 100%
+    t = 1000.0
+    for i in range(12):
+        hist.record(t + i, {'probe.err': 1.0})
+    assert eng.evaluate(t + 11)['obj']['state'] == OK
+    # violation starts: the 1s short window fills with bad samples
+    # first (AT_RISK), the 12s long window only after it sustains
+    hist.record(t + 12, {'probe.err': 9.0})
+    assert eng.evaluate(t + 12)['obj']['state'] == OK      # 50% short
+    hist.record(t + 13, {'probe.err': 9.0})
+    assert eng.evaluate(t + 13)['obj']['state'] == AT_RISK
+    for i in range(14, 25):
+        hist.record(t + i, {'probe.err': 9.0})
+        eng.evaluate(t + i)
+    assert eng.state('obj') == BREACHED
+    assert eng.breached() and eng.breaches('obj') == 1
+    recs = log.records('SLOBreachError')
+    assert len(recs) == 1 and 'obj' in recs[0].detail
+    assert isinstance(eng.last_breach, faults.SLOBreachError)
+    # ongoing breach: no new count, no log flood
+    hist.record(t + 25, {'probe.err': 9.0})
+    eng.evaluate(t + 25)
+    assert eng.breaches('obj') == 1
+    assert len(log.records('SLOBreachError')) == 1
+    # recovery drains the windows back to OK
+    for i in range(26, 40):
+        hist.record(t + i, {'probe.err': 1.0})
+        eng.evaluate(t + i)
+    assert eng.state('obj') == OK and not eng.breached()
+    with pytest.raises(faults.SLOBreachError):
+        eng.check_strict()        # strict still reports the run's breach
+
+
+def test_default_burn_budget_spike_is_at_risk_only():
+    """With the default 10% budget a 2-sample spike alarms the short
+    window but not the 60-sample long one — AT_RISK, never BREACHED."""
+    hist, eng = _engine('probe.err<=5@60')
+    t = 500.0
+    for i in range(60):
+        hist.record(t + i, {'probe.err': 1.0})
+    hist.record(t + 60, {'probe.err': 9.0})
+    hist.record(t + 61, {'probe.err': 9.0})
+    rec = eng.evaluate(t + 61)['obj']
+    assert rec['state'] == AT_RISK
+    assert rec['ratio_short'] >= 0.1 > rec['ratio_long']
+    assert eng.breaches('obj') == 0
+
+
+def test_rate_reduction_spec_floors_throughput():
+    """A `.rate` suffix over a sampled counter reduces each window to
+    one slope — the steps/sec-floor shape: a stalled counter breaches,
+    a ramping one is OK."""
+    log = faults.FailureLog()
+    hist, eng = _engine('train.steps.rate>=5@6', log=log)
+    t = 100.0
+    now = t
+    for i in range(61):                       # 10 steps/sec ramp
+        now = t + 0.1 * i
+        hist.record(now, {'train.steps': float(i)})
+    assert eng.evaluate(now)['obj']['state'] == OK
+    for i in range(61, 181):                  # full stall: slope -> 0
+        now = t + 0.1 * i
+        hist.record(now, {'train.steps': 60.0})
+        eng.evaluate(now)
+    assert eng.state('obj') == BREACHED
+    assert log.records('SLOBreachError')
+
+
+def test_no_data_is_ok_but_flagged_watching_nothing():
+    """A spec whose key never matches a sampled gauge (typo, gauge
+    never registered) must not read as a reassuring plain OK: state
+    stays OK but no_data flags it on /slos and /metrics."""
+    hist, eng = _engine('ghost.gauge<=1@5')
+    rec = eng.evaluate(123.0)['obj']
+    assert rec['state'] == OK and rec['samples_n'] == 0
+    assert rec['value'] is None
+    assert rec['no_data'] is True
+    assert eng.status_view()['obj']['no_data'] is True
+    eng._refresh_gauges()
+    assert eng.stats.get('no_data[obj]') == 1
+    # data arriving clears the flag
+    hist.record(124.0, {'ghost.gauge': 0.5})
+    assert eng.evaluate(124.0)['obj']['no_data'] is False
+    eng._refresh_gauges()
+    assert eng.stats.get('no_data[obj]') == 0
+
+
+def test_cli_rejects_per_sample_spec():
+    """@0 specs are engine-API-only (SLOEngine.observe): from the CLI
+    nothing would ever feed one — a dead objective reading OK forever —
+    so config parse fails fast."""
+    from cxxnet_tpu.main import LearnTask
+    task = LearnTask()
+    task.set_param('slo.ok_spec', 'serve.queue_depth<=32@5')
+    with pytest.raises(ValueError, match='window > 0'):
+        task.set_param('slo.dead', 'online.freshness_s<=0.5@0')
+    with pytest.raises(ValueError, match='cannot parse'):
+        task.set_param('slo.bad', 'not-a-spec')
+
+
+def test_per_sample_spec_counts_every_violation():
+    """window=0 = the freshness shape: each violating observe() is its
+    own breach, judged the moment it is measured."""
+    log = faults.FailureLog()
+    eng = SLOEngine(log=log)
+    eng.add(SLOSpec.parse('cap', 'probe.v<=1@0'))
+    assert eng.observe('cap', 0.5) == OK
+    assert eng.observe('cap', 2.0, step=7) == BREACHED
+    assert eng.observe('cap', 3.0) == BREACHED
+    assert eng.breaches('cap') == 2
+    recs = log.records('SLOBreachError')
+    assert len(recs) == 2 and recs[0].step == 7
+    assert eng.observe('cap', 0.1) == OK       # state follows the sample
+    assert not eng.breached()
+
+
+# --- freshness through the generic engine -----------------------------------
+
+def test_freshness_is_an_engine_consumer_behavior_equal():
+    """The rebased tracker: breach judgment IS the generic engine —
+    typed FreshnessSLOError from the factory, historical log kind with
+    the version as step, per-sample breach counting, strict raise."""
+    from cxxnet_tpu.online.freshness import FreshnessTracker
+    log = faults.FailureLog()
+    tr = FreshnessTracker(slo_s=0.001, log=log)
+    assert isinstance(tr.slo, SLOEngine)
+    spec = tr.slo.specs()['freshness']
+    assert spec.window == 0.0 and spec.kind == 'freshness_slo_breach'
+    tr.record_step(20, time.monotonic() - 1.0)
+    tr.record_swap(20)
+    assert tr.note_served(20) > 0.5
+    assert tr.breaches == 1
+    err = tr.last_breach
+    assert isinstance(err, faults.FreshnessSLOError)
+    assert isinstance(err, faults.SLOBreachError)     # the new taxonomy
+    assert isinstance(err, faults.ServeError)         # embedder contract
+    assert err.step == 20
+    recs = log.records('freshness_slo_breach')
+    assert len(recs) == 1 and recs[0].step == 20
+    assert not log.records('SLOBreachError')          # historical kind
+    with pytest.raises(faults.FreshnessSLOError):
+        tr.check_strict()
+    # verdict history records the judged sample
+    view = tr.slo.status_view()['freshness']
+    assert view['state'] == BREACHED and view['breaches'] == 1
+
+
+def test_freshness_breach_kind_does_not_dump_postmortem(hub, tmp_path):
+    """freshness_slo_breach stays an eval-line concern: the armed
+    recorder must NOT ship a postmortem for it (behavior-equal to the
+    pre-engine path), while the generic SLOBreachError kind does."""
+    hub.arm_flight_recorder(str(tmp_path / 'flight'))
+    log = faults.FailureLog()
+    log.record('freshness_slo_breach', 'late swap', step=8)
+    assert not os.path.exists(tmp_path / 'flight')
+    log.record('SLOBreachError', 'queue depth over budget')
+    assert len(os.listdir(tmp_path / 'flight')) == 1
+
+
+# --- the FaultPlan drill: breach -> typed postmortem (acceptance) -----------
+
+def test_fault_plan_stall_breaches_slo_with_postmortem(hub, tmp_path):
+    """Acceptance: a FaultPlan drill (stall_batch) degrades a real
+    pipeline gauge, the sampled SLO transitions to BREACHED, and the
+    flight recorder ships a postmortem containing the breaching
+    window's samples and the verdict history — nobody calls dump()."""
+    hub.arm_flight_recorder(str(tmp_path / 'flight'))
+    stats = StatSet()
+    hub.register_stats('io', stats)
+    sampler = GaugeSampler(hub.gauge_snapshot, period=0.05)
+    eng = SLOEngine(sampler.history)
+    eng.add(SLOSpec.parse('pipeline', 'io.buffer.starved_ms.p99<=50@1:10'))
+    eng.register_into(hub)
+    sampler.add_listener(eng.on_tick)
+    plan = faults.FaultPlan(stall_batch=((2, 0.3),))
+    prev = faults.install_plan(plan)
+    tb = ThreadBuffer(lambda: iter(range(6)), buffer_size=1,
+                      fault_scope='batch')
+    tb.stats = stats
+    try:
+        consumed = list(tb)
+        assert consumed == list(range(6))
+        assert plan.fired() == ['stall_batch=2:0.3']
+        # the drill parked the consumer ~300ms: starved_ms.p99 >> 50
+        assert stats.quantile('buffer.starved_ms', 0.99) > 50
+        # drive the sampler deterministically through both windows
+        t0 = time.monotonic()
+        for i in range(16):
+            sampler.tick(t0 + 0.1 * i)
+    finally:
+        faults.install_plan(prev)
+        tb.close(5.0)
+        eng.close()
+    assert eng.state('pipeline') == BREACHED
+    dumps = sorted(os.listdir(tmp_path / 'flight'))
+    assert dumps and 'SLOBreachError' in dumps[0], dumps
+    with open(tmp_path / 'flight' / dumps[0]) as f:
+        d = json.load(f)
+    assert d['reason'] == 'SLOBreachError'
+    view = d['slos']['pipeline']
+    assert view['state'] == BREACHED
+    assert view['window_samples'], 'breaching window samples missing'
+    assert max(v for _t, v in view['window_samples']) > 50
+    assert any(h['state'] == BREACHED for h in view['history'])
+    assert any(r['kind'] == 'SLOBreachError' for r in d['failure_log'])
+
+
+# --- hub roster / endpoints -------------------------------------------------
+
+def test_register_into_hub_serves_verdict_rows_and_slos(hub):
+    eng = SLOEngine(log=faults.FailureLog())
+    eng.add(SLOSpec.parse('cap', 'probe.v<=1@0'))
+    eng.register_into(hub)
+    try:
+        eng.observe('cap', 5.0)
+        text = hub.metrics_text()
+        assert 'cxxnet_slo_verdict{tag="cap"} 2' in text
+        assert 'cxxnet_slo_breaches{tag="cap"} 1' in text
+        view = hub.slos_view()
+        assert view['cap']['state'] == BREACHED
+        assert view['cap']['spec'] == 'probe.v<=1@0'
+        # /statusz carries the same view through the status registry
+        assert hub.status()['status']['slo']['cap']['breaches'] == 1
+    finally:
+        eng.close()
+    assert hub.slos_view() == {} and hub.slo_engines() == []
+
+
+def test_healthz_degrades_while_breached_still_200(hub):
+    eng = SLOEngine(log=faults.FailureLog())
+    eng.add(SLOSpec.parse('cap', 'probe.v<=1@0'))
+    eng.register_into(hub)
+    srv = ObsServer(hub, port=0)
+    try:
+        assert _get(f'{srv.url}/healthz') == b'ok\n'
+        eng.observe('cap', 9.0)
+        assert _get(f'{srv.url}/healthz') == b'degraded\n'   # HTTP 200
+        slos = json.loads(_get(f'{srv.url}/slos'))
+        assert slos['cap']['state'] == BREACHED
+        assert slos['cap']['window_samples']
+        eng.observe('cap', 0.5)                              # recovers
+        assert _get(f'{srv.url}/healthz') == b'ok\n'
+    finally:
+        eng.close()
+        assert srv.close(timeout=10.0)
+
+
+def test_wrapper_and_capi_obs_slos(hub):
+    from cxxnet_tpu import capi, wrapper
+    eng = SLOEngine(log=faults.FailureLog())
+    eng.add(SLOSpec.parse('cap', 'probe.v<=1@0'))
+    eng.register_into(hub)
+    try:
+        eng.observe('cap', 9.0)
+        net = capi.net_create('cpu', '')
+        for payload in (wrapper.Net(dev='cpu').obs_slos(),
+                        capi.net_obs_slos(net)):
+            view = json.loads(payload)
+            assert view['cap']['state'] == BREACHED
+    finally:
+        eng.close()
+
+
+# --- per-rank endpoints + fleet units ---------------------------------------
+
+def test_obs_servers_ephemeral_ports_no_collision(hub, tmp_path):
+    """The elastic-rank shape: N ObsServers at obs.port=0 in one test
+    process bind N distinct ports, announce them into port files, and
+    shut down clean (the conftest leak fixture holds the line)."""
+    servers = [ObsServer(hub, port=0,
+                         port_file=str(tmp_path / f'rank{i}.port'))
+               for i in range(3)]
+    try:
+        ports = [s.port for s in servers]
+        assert len(set(ports)) == 3
+        for i, s in enumerate(servers):
+            announced = int((tmp_path / f'rank{i}.port').read_text())
+            assert announced == s.port
+            assert _get(f'{s.url}/healthz') == b'ok\n'
+    finally:
+        for s in servers:
+            assert s.close(timeout=10.0)
+    alive = {t.name for t in threading.enumerate() if t.is_alive()}
+    assert not any(n.startswith('cxxnet-obs-') for n in alive)
+
+
+def test_merge_metrics_injects_rank_labels():
+    texts = {
+        0: ('# TYPE cxxnet_x gauge\ncxxnet_x 1\n'
+            'cxxnet_serve_rows{tag="b8"} 4\n'),
+        1: 'cxxnet_x 2\n',
+        2: None,                       # dead rank: rows just drop
+    }
+    merged = merge_metrics(texts)
+    assert 'cxxnet_x{rank="0"} 1' in merged
+    assert 'cxxnet_x{rank="1"} 2' in merged
+    assert 'cxxnet_serve_rows{rank="0",tag="b8"} 4' in merged
+    assert merged.count('# TYPE cxxnet_x gauge') == 1
+    assert parse_gauges(texts[0]) == {'x': 1.0}   # labeled rows skipped
+
+
+def test_fleet_scraper_aggregates_and_survives_rank_death(hub):
+    """Two live per-rank hubs scraped into one rank-labeled exposition
+    + fleet.* aggregates; killing one rank degrades ranks_alive and
+    drops its rows — the scrape itself never fails."""
+    hubs = [TelemetryHub(ring_events=32) for _ in range(2)]
+    for rank, h in enumerate(hubs):
+        s = StatSet()
+        s.gauge('steps', 10.0 * (rank + 1))
+        h.register_stats('elastic', s)
+    servers = [ObsServer(h, port=0) for h in hubs]
+    scraper = FleetScraper()
+    try:
+        for rank, s in enumerate(servers):
+            scraper.add_target(rank, s.url)
+        src = scraper.source()
+        assert src['fleet.ranks_alive'] == 2.0
+        assert src['fleet.elastic_steps.min'] == 10.0
+        assert src['fleet.elastic_steps.max'] == 20.0
+        assert src['fleet.elastic_steps.sum'] == 30.0
+        merged = scraper.merged_metrics()
+        assert 'cxxnet_elastic_steps{rank="0"} 10' in merged
+        assert 'cxxnet_elastic_steps{rank="1"} 20' in merged
+        assert 'cxxnet_fleet_ranks_alive 2' in merged
+        # rank 1 dies mid-run: the next scrape survives and says so
+        servers[1].close(timeout=10.0)
+        src = scraper.source()
+        assert src['fleet.ranks_alive'] == 1.0
+        assert src['fleet.elastic_steps.max'] == 10.0
+        merged = scraper.merged_metrics()
+        assert 'rank="1"' not in merged
+        assert 'cxxnet_fleet_ranks_alive 1' in merged
+        assert scraper.alive() == {0: True, 1: False}
+        assert scraper.scrape_errors() >= 1
+        # the merged endpoint serves through the same scraper
+        fsrv = FleetServer(scraper, port=0)
+        try:
+            text = _get(f'{fsrv.url}/metrics').decode()
+            assert 'cxxnet_elastic_steps{rank="0"} 10' in text
+            st = json.loads(_get(f'{fsrv.url}/statusz'))
+            assert st['ranks']['0']['alive'] is True
+            assert st['ranks']['1']['alive'] is False
+            assert _get(f'{fsrv.url}/healthz') == b'ok\n'
+            assert json.loads(_get(f'{fsrv.url}/slos')) == {}
+        finally:
+            assert fsrv.close(timeout=10.0)
+    finally:
+        for s in servers:
+            s.close(timeout=10.0)
+
+
+def test_merge_chrome_traces_one_lane_per_host(tmp_path):
+    for rank in (0, 1):
+        with open(tmp_path / f'trace_rank{rank}.json', 'w') as f:
+            json.dump({'traceEvents': [
+                {'name': 'train.dispatch', 'cat': 'train', 'ph': 'X',
+                 'ts': 1.0, 'dur': 2.0, 'pid': 4242, 'tid': 1,
+                 'args': {}}]}, f)
+    out = merge_chrome_traces(
+        {0: str(tmp_path / 'trace_rank0.json'),
+         1: str(tmp_path / 'trace_rank1.json'),
+         2: str(tmp_path / 'trace_rank2.json')},   # never exported
+        str(tmp_path / 'merged.json'))
+    assert out is not None
+    with open(out) as f:
+        trace = json.load(f)
+    events = trace['traceEvents']
+    assert {e['pid'] for e in events} == {0, 1}    # pid = rank = lane
+    lanes = {(e['pid'], e['args']['name']) for e in events
+             if e.get('ph') == 'M' and e['name'] == 'process_name'}
+    assert lanes == {(0, 'host rank 0'), (1, 'host rank 1')}
+    assert merge_chrome_traces({0: str(tmp_path / 'nope.json')},
+                               str(tmp_path / 'empty.json')) is None
+
+
+# --- CLI e2e (in-process) ---------------------------------------------------
+
+def test_cli_slo_keys_sampler_lifecycle_and_verdict_summary(
+        tmp_path, capsys):
+    """slo.* + obs.sample_every through the real CLI: the sampler runs
+    for the whole task, the (deliberately impossible) SLO breaches, the
+    exit summary prints the typed verdict, a postmortem lands under
+    model_dir/flight, and every obs thread is gone afterwards (leak
+    fixture).  Exit stays 0 — an SLO is an alarm, not a kill switch."""
+    from cxxnet_tpu.main import main as cli_main
+    from tests.test_io import write_mnist
+    write_mnist(str(tmp_path), n=128, rows=8, cols=8, seed=4)
+    conf = tmp_path / 'train.conf'
+    conf.write_text(f"""
+data = train
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+  shuffle = 0
+iter = end
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,64
+batch_size = 16
+dev = cpu
+eta = 0.05
+metric[label] = error
+num_round = 1
+model_dir = {tmp_path}/models
+obs.sample_every = 0.05
+slo.smoke = "obs.uptime_s<=0.0001@0.3:10"
+""")
+    log_before = len(faults.global_failure_log().records('SLOBreachError'))
+    rc = cli_main([str(conf)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert 'obs: slo smoke: BREACHED' in out, out
+    assert len(faults.global_failure_log().records('SLOBreachError')) \
+        > log_before
+    flight = tmp_path / 'models' / 'flight'
+    assert any('SLOBreachError' in f for f in os.listdir(flight))
+    get_hub().disarm()

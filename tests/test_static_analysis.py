@@ -55,6 +55,22 @@ def test_lock_clean_twin_silent():
     assert lock_discipline.check_module(fixture('lock_clean.py')) == []
 
 
+def test_lock_history_ring_unguarded_caught():
+    """The graftwatch shape: a sampler thread rebinding a bounded
+    history ring that a public window() walks must be caught when
+    unguarded (torn-ring class) and silent when declared + locked."""
+    findings = lock_discipline.check_module(
+        fixture('history_unguarded.py'))
+    assert rules_of(findings) == ['lock-discipline']
+    assert 'HistoryPump.ring' in findings[0].message
+    assert 'window' in findings[0].message
+
+
+def test_lock_history_ring_clean_twin_silent():
+    assert lock_discipline.check_module(
+        fixture('history_clean.py')) == []
+
+
 def test_lock_declared_guard_violation_caught():
     src = '''\
 import threading
