@@ -83,10 +83,9 @@ BASELINE_TRANSFORMER_TOKENS_PER_SEC = 25000.0  # stand-in: GPT-2-small-class
 # fp16 training on a 2019 V100 (no reference number exists — the
 # reference framework has no attention; generous like the other stand-ins)
 
-# bf16 peak TFLOP/s by TPU generation (marketing peak; MFU denominators)
-_PEAK_BF16_TFLOPS = (
-    ('v6', 918.0), ('v5p', 459.0), ('v5', 197.0), ('v4', 275.0),
-)
+# bf16 peak TFLOP/s by TPU generation — THE table lives in
+# cxxnet_tpu/obs/programs.py (the MFU gauge on the train eval line
+# divides by the same numbers; _peak_flops below delegates to it)
 
 
 def _emit(obj: dict) -> None:
@@ -140,19 +139,23 @@ def _ensure_backend() -> None:
 
 
 def _peak_flops() -> float:
-    """Peak bf16 FLOP/s of one chip, for the MFU denominator."""
-    env = os.environ.get('CXXNET_PEAK_TFLOPS')
-    if env:
-        return float(env) * 1e12
-    import jax
-    dev = jax.devices()[0]
-    if dev.platform == 'cpu':
-        return 0.0
-    kind = getattr(dev, 'device_kind', '').lower().replace(' ', '')
-    for key, tflops in _PEAK_BF16_TFLOPS:
-        if key in kind:
-            return tflops * 1e12
-    return 197e12                        # v5e-class default
+    """Peak bf16 FLOP/s of one chip, for the MFU denominator — ONE
+    table (``obs/programs.py``) shared with the train eval line's MFU
+    gauge, ``CXXNET_PEAK_TFLOPS`` override included."""
+    from cxxnet_tpu.obs.programs import peak_flops
+    return peak_flops()
+
+
+def _program_summary() -> Optional[dict]:
+    """The ledger's compile summary for the receipt (programs /
+    compiles / compile-ms / recompiles) — None when nothing compiled
+    in-process (subprocess-driven modes)."""
+    from cxxnet_tpu.obs.programs import get_ledger
+    led = get_ledger()
+    led.entries()                 # force the lazy AOT analysis so the
+                                  # receipt's compile_ms_total is real
+    s = led.summary()
+    return s if s['compiles_total'] else None
 
 
 def _bench_steps(default: int) -> int:
@@ -209,6 +212,12 @@ def _emit_throughput(metric: str, work_per_step: float, unit: str,
         'vs_baseline': round(rate / baseline, 3),
         'tflops': round(achieved / 1e12, 2) if measured else None,
         'mfu': round(achieved / peak, 4) if measured and peak else None,
+        # compiler truth (obs/programs.py): the HLO flops the mfu/tflops
+        # figures divide, plus the run's compile ledger — a receipt now
+        # says what was compiled, how long compiles took, and whether
+        # the recompile sentinel fired during the measurement
+        'flops_per_step': round(step_flops) if measured else None,
+        'programs': _program_summary(),
         'step_ms': round(per_step * 1e3, 3),
         # wall time of a 1-step dispatch minus the step itself = the pure
         # link/dispatch overhead one un-pipelined update() pays per call
@@ -253,7 +262,6 @@ def _throughput(conf: str, batch_size: int, shape, metric: str,
     steps = _bench_steps(30)
     multi_1 = trainer.compile_multi_step(1)
     multi_k = trainer.compile_multi_step(steps)
-    step_flops = trainer.train_step_flops(dstack[0], lstack[0])
 
     def run(fn, n) -> float:
         # fetching the returned device scalar is the only reliable
@@ -263,6 +271,9 @@ def _throughput(conf: str, batch_size: int, shape, metric: str,
 
     per_step, t1s = _quotient_per_step(
         lambda: run(multi_1, 1), lambda: run(multi_k, steps), steps)
+    # AFTER the warm runs: the flops read the ledger entries the loops
+    # above just compiled — no throwaway probe program
+    step_flops = trainer.train_step_flops(dstack[0], lstack[0])
     _emit_throughput(metric, batch_size, 'images/sec', baseline,
                      step_flops, per_step, t1s)
     return 0
@@ -1003,10 +1014,100 @@ def bench_obs() -> int:
             svc.close(30.0)
             sup.close()
 
+    # --- graftprof leg: program-ledger + sentinel tax ----------------
+    # off = the ledger's trace-time hook suppressed (set_raw_jit — the
+    # dispatch is the plain jit C++ fast path either way), on = the
+    # shipped wrap.  Both paths are warmed before pairing so neither
+    # leg ever measures a compile.
+    from cxxnet_tpu.obs.programs import set_raw_jit
+    l_samples = {'train': {False: [], True: []},
+                 'decode': {False: [], True: []}}
+    l_pair_tax = {'train': [], 'decode': []}
+    with tempfile.TemporaryDirectory() as tmp:
+        train_epoch, sup = make_train(tmp)
+        decode_burst, svc = make_decode()
+        hub.enabled = True
+        try:
+            import gc
+            for leg, run in (('decode', decode_burst),
+                             ('train', train_epoch)):
+                set_raw_jit(True)        # warm the plain-jit twin cache
+                run()
+                set_raw_jit(False)
+                gc.collect()
+                for i in range(reps):
+                    order = (False, True) if i % 2 == 0 else (True, False)
+                    rate = {}
+                    for state in order:
+                        # state True = ledger wrap ON (the shipped path).
+                        # best-of-3 per slot (vs the other passes'
+                        # best-of-2): the ledger's true per-dispatch
+                        # cost is ~µs against a multi-ms step — an
+                        # order of magnitude under the recorder/sampler
+                        # taxes — so only the min-wall discipline of
+                        # _quotient_per_step keeps scheduler spikes
+                        # from swamping it
+                        set_raw_jit(not state)
+                        try:
+                            rate[state] = max(run(), run(), run())
+                        finally:
+                            set_raw_jit(False)
+                    l_samples[leg][False].append(rate[False])
+                    l_samples[leg][True].append(rate[True])
+                    l_pair_tax[leg].append(1.0 - rate[True] / rate[False])
+        finally:
+            set_raw_jit(False)
+            svc.close(30.0)
+            sup.close()
+
+    # direct per-dispatch wrapper cost: the A/B above runs minute-long
+    # loops whose run-to-run spread on a shared host is ±5-15% — it can
+    # corroborate "no systemic tax rides along" but cannot RESOLVE a
+    # µs-scale dispatch delta.  So measure the delta directly: a tiny
+    # program behind a conservatively deep pytree (the signature walk
+    # is the wrapper's only per-call work and scales with leaf count),
+    # wrapped vs raw, median of trials, then convert through each
+    # leg's measured step/token wall into the implied steady-state tax.
+    # A throwaway ledger keeps the micro program out of /programs.
+    import jax.numpy as jnp
+    from cxxnet_tpu.obs.programs import (ProgramLedger, get_ledger,
+                                         install_ledger)
+    micro_led = ProgramLedger()
+    prev_led = install_ledger(micro_led)
+    try:
+        mprog = micro_led.program('bench.micro')
+    finally:
+        install_ledger(prev_led)
+    mtree = {f'l{i}': {'w': jnp.ones((64, 64)), 'b': jnp.ones((64,))}
+             for i in range(50)}         # 100 leaves: deeper than any
+                                         # real step's dispatch tree
+    mwrap = mprog.jit(lambda tree, x: x + tree['l0']['b'][0])
+    set_raw_jit(True)
+    mwrap(mtree, 0.0).block_until_ready()
+    set_raw_jit(False)
+    mwrap(mtree, 0.0).block_until_ready()
+
+    def _per_call_us(raw: bool, n: int = 3000) -> float:
+        set_raw_jit(raw)
+        try:
+            t0 = time.perf_counter()
+            r = None
+            for _ in range(n):
+                r = mwrap(mtree, 0.0)
+            r.block_until_ready()
+            return (time.perf_counter() - t0) / n * 1e6
+        finally:
+            set_raw_jit(False)
+    deltas = sorted(_per_call_us(False) - _per_call_us(True)
+                    for _ in range(7))
+    wrap_delta_us = max(0.0, deltas[len(deltas) // 2])
+
     rates = {leg: {st: statistics.median(v) for st, v in legs.items()}
              for leg, legs in samples.items()}
     s_rates = {leg: {st: statistics.median(v) for st, v in legs.items()}
                for leg, legs in s_samples.items()}
+    l_rates = {leg: {st: statistics.median(v) for st, v in legs.items()}
+               for leg, legs in l_samples.items()}
 
     def tax(leg):
         return round(statistics.median(pair_tax[leg]), 4)
@@ -1014,12 +1115,72 @@ def bench_obs() -> int:
     def s_tax(leg):
         return round(statistics.median(s_pair_tax[leg]), 4)
 
+    def l_tax(leg):
+        return round(statistics.median(l_pair_tax[leg]), 4)
+
     import jax
     plat = jax.devices()[0].platform
     if plat == 'cpu' and os.environ.get('CXXNET_BENCH_FALLBACK') == '1':
         # the fallback wrapper only rewrites the LAST emitted payload;
         # stamping here keeps BOTH committed receipts self-describing
         plat = 'cpu-fallback'
+    # implied steady-state tax per leg: measured per-dispatch delta
+    # over each leg's measured per-step / per-token wall.  One dispatch
+    # per train step and per decode token is CONSERVATIVE (a K-scanned
+    # window dispatches once per K steps; one decode step emits up to
+    # `slots` tokens), so the true tax is at or below these
+    train_ms = 1e3 / max(l_rates['train'][True], 1e-9)
+    tok_ms = 1e3 / max(l_rates['decode'][True], 1e-9)
+    implied_train = wrap_delta_us / 1e3 / train_ms
+    implied_decode = wrap_delta_us / 1e3 / tok_ms
+    ledger_payload = {
+        'metric': 'obs_ledger_overhead',
+        'value': round(max(implied_train, implied_decode), 5),
+        'unit': 'fraction',
+        'platform': plat,
+        'vs_baseline': None,
+        'wrap_dispatch_delta_us': round(wrap_delta_us, 2),
+        'train_implied_tax': round(implied_train, 5),
+        'decode_implied_tax': round(implied_decode, 5),
+        'programs': _program_summary(),
+        'train_steps_per_sec_ledger_on': round(l_rates['train'][True], 1),
+        'train_steps_per_sec_ledger_off': round(l_rates['train'][False],
+                                                1),
+        'train_overhead': l_tax('train'),
+        'train_tax_pairs': [round(t, 4) for t in l_pair_tax['train']],
+        'decode_tokens_per_sec_ledger_on': round(
+            l_rates['decode'][True], 1),
+        'decode_tokens_per_sec_ledger_off': round(
+            l_rates['decode'][False], 1),
+        'decode_overhead': l_tax('decode'),
+        'decode_tax_pairs': [round(t, 4) for t in l_pair_tax['decode']],
+        'acceptance': 'implied steady-state tax < 0.002 on both legs; '
+                      'A/B pair medians within the host noise band the '
+                      'enclosed pairs demonstrate',
+        'receipt_file': 'BENCH_OBS_r03.json',
+        'timing': 'headline value = measured per-dispatch wrapper '
+                  'delta (tiny program behind a 100-leaf pytree — '
+                  'deeper than any real step\'s dispatch tree — the '
+                  'shipped wrap vs the hook-suppressed set_raw_jit '
+                  'twin; dispatch is the plain jit C++ fast path '
+                  'either way, so the delta is one Python frame + the '
+                  'flag check; median of 7 trials of 3000 calls) '
+                  'divided by each leg\'s measured per-step / '
+                  'per-token wall, one dispatch per step/token '
+                  'assumed (conservative: scanned windows and '
+                  'multi-slot decode dispatch less often).  '
+                  f'Corroboration: median of {reps} back-to-back '
+                  'off/on pair ratios per leg, best-of-3 runs per slot '
+                  '(min-wall), both paths warmed — the end-to-end A/B '
+                  'cannot resolve a µs-scale delta through minute-long '
+                  'loops on a shared host (the enclosed pairs span the '
+                  'noise band) but holds the line against any '
+                  'systemic tax.  Compiler truth is harvested at '
+                  'trace time + lazy AOT analysis on read, so '
+                  'steady-state tax is the wrapper frame alone',
+    }
+    _write_receipt_file(ledger_payload)
+    _emit(ledger_payload)
     sampler_payload = {
         'metric': 'obs_sampler_overhead',
         'value': max(0.0, s_tax('train'), s_tax('decode')),

@@ -124,16 +124,17 @@ def default_root() -> str:
 
 
 def _checkers():
-    from . import (config_keys, fault_taxonomy, lock_discipline,
-                   monotonic_clock, span_hygiene, tracer_hygiene)
+    from . import (config_keys, fault_taxonomy, jit_ledger,
+                   lock_discipline, monotonic_clock, span_hygiene,
+                   tracer_hygiene)
     return (lock_discipline, tracer_hygiene, fault_taxonomy, config_keys,
-            monotonic_clock, span_hygiene)
+            monotonic_clock, span_hygiene, jit_ledger)
 
 
 ALL_RULES: Tuple[str, ...] = ('lock-discipline', 'lock-order',
                               'tracer-hygiene', 'fault-taxonomy',
                               'config-key-drift', 'monotonic-clock',
-                              'span-hygiene')
+                              'span-hygiene', 'jit-ledger')
 
 
 def run_all(root: Optional[str] = None,

@@ -1,0 +1,98 @@
+"""Program-ledger discipline (rule ``jit-ledger``).
+
+graftprof's :class:`~cxxnet_tpu.obs.programs.ProgramLedger` is only the
+compiler's truth while every load-bearing executable actually routes
+through it: one direct ``jax.jit`` call site in the trainer or the
+serving stack and ``/programs`` silently under-reports flops, memory,
+and — worse — the recompile sentinel goes blind to exactly the storm
+it exists to catch.  So the rule is blunt: inside ``nnet/`` and
+``serve/``, no direct ``jax.jit(...)`` (any spelling — call,
+decorator, ``partial(jax.jit, ...)``) outside the ledger wrap.  The
+sanctioned spelling is ``get_ledger().program(name).jit(fn, ...)``
+(obs/programs.py), which never mentions ``jax.jit`` at the site.  A
+genuinely trivial program (a device-side restage, a two-op scatter)
+states itself with ``# lint: allow(jit-ledger): <reason>``.
+
+``models/`` and ``ops/`` stay out of scope deliberately: the
+transformer ``generate`` cache and the Pallas kernels are library
+surfaces with their own bounded caches, registered at the ENGINE call
+sites the ledger already rows.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, Module, Repo, dotted_name
+
+RULES = ('jit-ledger',)
+
+#: directories whose jit sites must be ledger-routed (or allowed)
+TARGET_DIRS = ('cxxnet_tpu/nnet/', 'cxxnet_tpu/serve/')
+
+
+def _jit_names(mod: Module) -> set:
+    """Every dotted spelling resolving to ``jax.jit`` in this module:
+    ``jax.jit``, ``import jax as j`` → ``j.jit``, and
+    ``from jax import jit [as jjit]``."""
+    out = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == 'jax':
+                    out.add(f'{a.asname or "jax"}.jit')
+        elif isinstance(node, ast.ImportFrom) and node.module == 'jax':
+            for a in node.names:
+                if a.name == 'jit':
+                    out.add(a.asname or 'jit')
+    return out
+
+
+def check_module(mod: Module) -> List[Finding]:
+    names = _jit_names(mod)
+    if not names:
+        return []
+    findings: List[Finding] = []
+
+    def hit(expr, lineno: int, how: str) -> None:
+        findings.append(Finding(
+            'jit-ledger', mod.rel, lineno,
+            f'direct jax.jit {how} — route through the ProgramLedger '
+            '(obs/programs.py: get_ledger().program(name).jit(fn, ...)) '
+            'so /programs, MFU and the recompile sentinel see this '
+            'executable, or carry an allow with a reason'))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # the bare decorator spelling: @jax.jit / @jjit with no
+            # call — an ast.Attribute/Name in decorator_list, never a
+            # Call (decorator factories like @partial(jax.jit, ...)
+            # fall through to the Call arm below)
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call) \
+                        and dotted_name(dec) in names:
+                    hit(dec, dec.lineno, 'bare decorator')
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name in names:
+            hit(node, node.lineno, 'call site')
+            continue
+        # partial(jax.jit, ...) — the decorator-factory spelling
+        if name is not None and name.split('.')[-1] == 'partial' \
+                and node.args:
+            first = dotted_name(node.args[0])
+            if first in names:
+                hit(node, node.lineno, 'via functools.partial')
+    return findings
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in repo.package_files():
+        if not rel.startswith(TARGET_DIRS):
+            continue
+        findings.extend(check_module(repo.module(rel)))
+    return findings

@@ -216,6 +216,14 @@ def net_obs_slos(net: Net) -> str:
     return net.obs_slos()
 
 
+def net_obs_programs(net: Net) -> str:
+    """The ``/programs`` JSON as one string: the compiler-truth program
+    ledger — per-executable compile wall-ms, HLO cost and memory rows
+    plus the recompile-sentinel totals (doc/observability.md "Programs,
+    memory, and MFU")."""
+    return net.obs_programs()
+
+
 # ---- train-while-serve surface (CXNNetOnline*) ----------------------------
 
 def net_online_start(net: Net, it: DataIter, cfg: str) -> None:

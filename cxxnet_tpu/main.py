@@ -125,10 +125,16 @@ class LearnTask:
                                        # endpoint: -1 off, 0 ephemeral
         self.obs_trace_merge = ''      # obs.trace_merge merged Perfetto
                                        # trace path (launcher role)
+        # graftprof: compiler-truth ledger + device memory + /profile
+        # (doc/observability.md "Programs, memory, and MFU")
+        self.obs_recompile = 'warn'    # obs.recompile: warn | raise | off
+        self.obs_profile = 1           # obs.profile: /profile?ms=N on
+        self.obs_hbm = 1               # obs.hbm: hbm.* device gauges on
         self.slo_specs: List[ConfigEntry] = []   # slo.<name> grammar
         self._obs_server = None
         self._obs_sampler = None
         self._obs_slo = None
+        self._train_stats = None       # train-mfu/steps_per_sec gauges
         self.cfg: List[ConfigEntry] = []
         self.net_trainer: Optional[NetTrainer] = None
         self.itr_train = None
@@ -201,6 +207,9 @@ class LearnTask:
             'obs.sample_every': ('obs_sample_every', float),
             'obs.fleet_port': ('obs_fleet_port', int),
             'obs.trace_merge': ('obs_trace_merge', str),
+            'obs.recompile': ('obs_recompile', str),
+            'obs.profile': ('obs_profile', int),
+            'obs.hbm': ('obs_hbm', int),
             'online.save_every': ('online_save_every', int),
             'online.freshness_slo': ('online_freshness_slo', float),
             'online.freshness_strict': ('online_freshness_strict', int),
@@ -210,6 +219,10 @@ class LearnTask:
         if name in simple:
             attr, typ = simple[name]
             setattr(self, attr, typ(val))
+        if name == 'obs.recompile' and val not in ('warn', 'raise', 'off'):
+            # fail at config parse, like a malformed slo.* spec
+            raise ValueError(
+                f'obs.recompile must be warn|raise|off, got {val!r}')
         if name.startswith('slo.') and len(name) > 4:
             # declarative SLO grammar (doc/observability.md):
             # slo.<name> = <set>.<key><op><threshold>@<window>[:burn];
@@ -639,6 +652,7 @@ class LearnTask:
             if not self.silent:
                 print(f'update round {self.start_counter - 1}', flush=True)
             self.net_trainer.start_round(self.start_counter)
+            t_round = time.monotonic()
             if sup is not None:
                 n = self._supervised_round(sup, plan, tracer, batch_counter,
                                            start)
@@ -646,6 +660,7 @@ class LearnTask:
             else:
                 n, _ = self._round(plan, tracer, batch_counter, start)
                 batch_counter += n
+            dt_round = time.monotonic() - t_round
             # settle the one-step-deferred divergence gate (no-op unless
             # nan_action=halt / nan_breaker armed the check)
             self.net_trainer.flush_divergence_check()
@@ -657,6 +672,7 @@ class LearnTask:
                     sys.stderr.write(self.net_trainer.evaluate(it, name))
                 self._write_io_stats()
                 sys.stderr.write('\n')
+                self._write_train_speed(n, dt_round)
                 sys.stderr.flush()
             self._save_model()
         if not self.silent:
@@ -678,6 +694,38 @@ class LearnTask:
         line = stats.print_and_clear('io')
         if line:
             sys.stderr.write(line)
+
+    def _write_train_speed(self, n: int, dt: float) -> None:
+        """The MFU gauge rides the train eval block
+        (doc/observability.md "Programs, memory, and MFU"): measured
+        steps/sec for the round × ledger flops/step over the
+        per-platform peak-FLOPs table.  Deliberately its OWN stderr
+        line right under the ``[N]`` eval line: eval lines are a
+        bitwise-compared surface (the scan/supervise CLI twins assert
+        them equal across runs) and wall-clock numbers may never ride
+        one.  ``train-mfu`` only prints when a peak is known (real
+        chip or ``CXXNET_PEAK_TFLOPS``) — an unknown denominator
+        reports nothing, never a fake 0.  The same gauges serve on
+        ``/metrics`` (registered StatSet), so they are SLO-able for
+        free."""
+        if n <= 0 or dt <= 0:
+            return
+        from .obs import get_hub
+        from .obs.programs import mfu
+        if self._train_stats is None:
+            from .utils.metric import StatSet
+            self._train_stats = StatSet()
+            get_hub().register_stats('train', self._train_stats)
+        st = self._train_stats
+        sps = n / dt
+        st.gauge('steps_per_sec', round(sps, 3))
+        flops = self.net_trainer.train_step_flops()
+        if flops > 0:
+            st.gauge('flops_per_step', flops)
+        m = mfu(flops, sps)
+        if m is not None:
+            st.gauge('mfu', round(m, 5))
+        sys.stderr.write(st.print('train').lstrip('\t') + '\n')
 
     # --- telemetry (graftscope, doc/observability.md) ----------------------
     def _obs_start(self) -> None:
@@ -701,6 +749,15 @@ class LearnTask:
                                                      'flight')
         hub.arm_flight_recorder(dump_dir)
         hub.arm_signal_dump()
+        # graftprof: the compiler-truth ledger joins the hub (programs.*
+        # gauges + /statusz summary; /programs serves it raw), device
+        # memory gauges ride the same sampler/fleet machinery
+        from .obs import programs as obs_programs
+        ledger = obs_programs.get_ledger()
+        ledger.set_recompile(self.obs_recompile)
+        ledger.register_into(hub)
+        if self.obs_hbm:
+            obs_programs.register_hbm(hub)
         # fleet.-scoped specs belong to the launcher's cross-rank view;
         # a worker evaluating one would only ever see "no data"
         local_specs = [(n, v) for n, v in self.slo_specs
@@ -738,10 +795,15 @@ class LearnTask:
             from .obs.endpoints import ObsServer
             self._obs_server = ObsServer(
                 hub, port=self.obs_port,
-                port_file=os.environ.get('CXXNET_OBS_PORT_FILE'))
+                port_file=os.environ.get('CXXNET_OBS_PORT_FILE'),
+                profile_dir=(os.path.join(dump_dir, 'profile')
+                             if self.obs_profile else None))
+            routes = '/metrics /statusz /healthz /slos /programs'
+            if self.obs_profile:
+                routes += ' /profile'
             print(f'obs: telemetry on http://127.0.0.1:'
-                  f'{self._obs_server.port} (/metrics /statusz /healthz '
-                  f'/slos), flight dumps in {dump_dir}', flush=True)
+                  f'{self._obs_server.port} ({routes}), flight dumps in '
+                  f'{dump_dir}', flush=True)
 
     def _obs_register_iterators(self) -> None:
         """Instrumented io chains join the hub so their per-stage stats

@@ -100,12 +100,28 @@ class ExecutionPlan:
             'execution_plan',
             lambda p=plan: {'requested_k': p.requested_k, 'k': p.k,
                             'scanned': p.scanned,
-                            'demotions': sorted(p._noted)})
+                            'demotions': sorted(p._noted),
+                            # compiler truth (obs/programs.py): the
+                            # per-step HLO flops of whatever program
+                            # this plan is actually dispatching
+                            'flops_per_step': p.flops_per_step()})
         return plan
 
     @property
     def scanned(self) -> bool:
         return self.k > 1
+
+    def flops_per_step(self) -> float:
+        """Ledger flops/step of the trainer this plan last built a
+        stepper for (0.0 before the first round or first compile).
+        analyzed_only: this renders on the /statusz endpoint thread,
+        which must never block on a lazy AOT analysis probe — it
+        reports 0.0 until the MFU line (or /programs) fills the
+        entry."""
+        trainer = getattr(self, '_trainer', None)
+        if trainer is None:
+            return 0.0
+        return trainer.train_step_flops(analyzed_only=True)
 
     def demote(self, reason: str) -> None:
         """Register a demotion: typed error under ``scan_strict=1``,
@@ -142,6 +158,7 @@ class ExecutionPlan:
         immediately (the supervised loop, whose recovery re-winds by
         DISPATCHED steps and simply discards staged-but-undispatched
         work)."""
+        self._trainer = trainer        # /statusz flops_per_step source
         scan = None
         if self.scanned:
             armed = bool(trainer.eval_train and len(trainer.train_metric))

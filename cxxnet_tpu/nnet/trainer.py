@@ -27,7 +27,6 @@ from __future__ import annotations
 import os
 import re
 import struct
-from functools import partial
 from typing import BinaryIO, Dict, List, Optional, Tuple
 
 import jax
@@ -319,14 +318,28 @@ class NetTrainer:
 
         return loss_fn
 
+    def _claim_programs(self) -> None:
+        """Claim this trainer's ledger program names (obs/programs.py):
+        every compiled executable registers its compile wall-ms + HLO
+        cost/memory into the process-wide ProgramLedger, served on
+        ``/programs`` and read back by :meth:`train_step_flops`."""
+        from ..obs.programs import get_ledger
+        led = get_ledger()
+        self._prog_step = led.program('train.step')
+        self._prog_forward = led.program('train.forward')
+        self._prog_multi = led.program('train.multi_step')
+        self._prog_multi_fwd = led.program('train.multi_forward')
+        self._prog_grad = None        # claimed on first compile_grad_step
+        self._prog_apply = None       # claimed on first compile_apply_grad
+
     def _compile_steps(self) -> None:
         updater_type = self.net_cfg.updater_type
         hypers = self.hypers
         loss_fn = self._make_loss_fn()
+        self._claim_programs()
 
         nan_skip = self.nan_action == 'skip'
 
-        @partial(jax.jit, static_argnames=('do_update',), donate_argnums=(0, 1, 2))
         def train_step(params, opt_state, grad_acc, data, label, extra, mask,
                        rng, epoch, rnd, do_update, norm=()):
             (loss, evals), grads = jax.value_and_grad(
@@ -355,7 +368,6 @@ class NetTrainer:
 
         spmd = self._mesh.devices.size
 
-        @jax.jit
         def forward_step(params, data, extra, rnd, norm=()):
             data = _apply_input_norm(data, norm)
             ctx = ForwardContext(is_train=False, rng=None, round=rnd,
@@ -365,8 +377,12 @@ class NetTrainer:
             values, _ = net.forward(params, data, ctx, extra_data=extra)
             return values
 
-        self._train_step_fn = train_step
-        self._forward_fn = forward_step
+        # ledger-routed jit (obs/programs.py): the plain jax.jit C++
+        # dispatch, plus a /programs row per compiled signature
+        self._train_step_fn = self._prog_step.jit(
+            train_step, static_argnames=('do_update',),
+            donate_argnums=(0, 1, 2))
+        self._forward_fn = self._prog_forward.jit(forward_step)
         self._stack_jit = None     # mesh may have changed: rebuild lazily
 
     def compile_multi_step(self, n_steps: int, train_eval: bool = False):
@@ -421,7 +437,6 @@ class NetTrainer:
         nan_skip = self.nan_action == 'skip'
         period = max(1, int(self.update_period))
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
         def multi_step(params, opt_state, grad_acc, data_stack, label_stack,
                        base_rng, epoch0, sc0, mask_stack, rnd, norm=()):
             nstack = data_stack.shape[0]
@@ -474,11 +489,19 @@ class NetTrainer:
                 jnp.arange(n_steps))
             return params, opt_state, grad_acc, losses, evals
 
+        # one ledger entry per (K, train_eval) window shape.  steps=1,
+        # NOT n_steps: the window is a lax.scan and XLA cost analysis
+        # counts a While body ONCE, so the reported flops already ARE
+        # one step's — dividing by K would under-report MFU K-fold
+        wrapped = self._prog_multi.jit(
+            multi_step, donate_argnums=(0, 1, 2),
+            key=f'k{n_steps}{"e" if train_eval else ""}')
+
         def multi_fn(params, opt_state, grad_acc, data_stack, label_stack,
                      base_rng, epoch0, sc0, mask_stack, rnd, norm=()):
-            return multi_step(params, opt_state, grad_acc, data_stack,
-                              label_stack, base_rng, epoch0, sc0,
-                              mask_stack, rnd, norm)
+            return wrapped(params, opt_state, grad_acc, data_stack,
+                           label_stack, base_rng, epoch0, sc0,
+                           mask_stack, rnd, norm)
 
         multi_fn.n_steps = n_steps
         multi_fn.train_eval = train_eval
@@ -502,7 +525,6 @@ class NetTrainer:
         spmd = self._mesh.devices.size
         top = net.cfg.layers[-1].nindex_out[-1]
 
-        @jax.jit
         def multi_fwd(params, data_stack, rnd, norm=()):
             nstack = data_stack.shape[0]
 
@@ -520,8 +542,12 @@ class NetTrainer:
             acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(n_steps))
             return acc
 
+        # steps=1 for the same reason as compile_multi_step: the scan
+        # body is counted once by XLA cost analysis
+        wrapped = self._prog_multi_fwd.jit(multi_fwd, key=f'k{n_steps}')
+
         def fwd_fn(params, data_stack, rnd=0, norm=()):
-            return multi_fwd(params, data_stack, rnd, norm)
+            return wrapped(params, data_stack, rnd, norm)
 
         fwd_fn.n_steps = n_steps
         return fwd_fn
@@ -537,7 +563,6 @@ class NetTrainer:
         donated: params are reused across every shard of a step."""
         loss_fn = self._make_loss_fn()
 
-        @jax.jit
         def grad_step(params, data, label, extra, mask, rng, rnd,
                       norm=()):
             (loss, _evals), grads = jax.value_and_grad(
@@ -545,7 +570,10 @@ class NetTrainer:
                                        rng, rnd, norm)
             return loss, grads
 
-        return grad_step
+        if self._prog_grad is None:
+            from ..obs.programs import get_ledger
+            self._prog_grad = get_ledger().program('train.grad_step')
+        return self._prog_grad.jit(grad_step)
 
     def compile_apply_grad(self):
         """Jitted ``(params, opt_state, grads, epoch) -> (params,
@@ -556,13 +584,15 @@ class NetTrainer:
         updater_type = self.net_cfg.updater_type
         hypers = self.hypers
 
-        @partial(jax.jit, donate_argnums=(0, 1))
         def apply_grad(params, opt_state, grads, epoch):
             params, opt_state = apply_updates(
                 updater_type, hypers, params, grads, opt_state, epoch)
             return params, opt_state
 
-        return apply_grad
+        if self._prog_apply is None:
+            from ..obs.programs import get_ledger
+            self._prog_apply = get_ledger().program('train.apply_grad')
+        return self._prog_apply.jit(apply_grad, donate_argnums=(0, 1))
 
     def shard_batch_stack(self, stack: np.ndarray, cast: bool = True):
         """Stage a (nstack, batch, ...) stack of batches on device with the
@@ -677,6 +707,7 @@ class NetTrainer:
         never re-shipped over the host link."""
         if self._stack_jit is None:
             sh = NamedSharding(self._mesh, P(None, 'data'))
+            # lint: allow(jit-ledger): trivial on-device restage (one stack op, no flops worth a ledger row); shapes bounded by the K ladder
             self._stack_jit = jax.jit(lambda *xs: jnp.stack(xs),
                                       out_shardings=sh)
         return self._stack_jit(*arrays)
@@ -960,21 +991,42 @@ class NetTrainer:
             self.epoch_counter += 1
         self.sample_counter += 1
 
-    def train_step_flops(self, data, label) -> float:
+    def train_step_flops(self, data=None, label=None,
+                         analyzed_only=False) -> float:
         """HLO-estimated FLOPs of one full optimizer step (fwd + bwd +
-        update), from the compiled executable's cost analysis.  Used by
-        bench.py to report MFU; returns 0.0 when the backend exposes no
+        update).  Reads the LIVE program ledger first (obs/programs.py):
+        any step this trainer already compiled — per-step or scanned
+        window, whose While body XLA cost analysis counts once, so
+        its flops are already per-step — answers for free,
+        instead of lowering+compiling a throwaway program per call.
+        Only when nothing has compiled yet (and ``data``/``label`` are
+        given — the bench-facing signature) does it compile one probe,
+        through the same ledger wrap so even the probe gets a
+        ``/programs`` row.  ``analyzed_only=True`` never triggers the
+        lazy AOT analysis — the render-thread spelling (/statusz
+        providers), which reports 0.0 until some detailed reader has
+        filled the entries.  Returns 0.0 when the backend exposes no
         cost model."""
+        best = 0.0
+        for prog in (self._prog_multi, self._prog_step):
+            for e in prog.entries(analyze=not analyzed_only):
+                if e.flops > 0:
+                    # prefer the biggest per-step figure: the do_update
+                    # (full optimizer) step dominates its no-update twin
+                    best = max(best, e.flops / e.steps)
+        if analyzed_only:
+            return best
+        if best > 0:
+            return best
+        if data is None or label is None:
+            return 0.0
         rng = jax.random.fold_in(self._rng, 0)
         try:
-            lowered = self._train_step_fn.lower(
+            entry = self._train_step_fn.ensure_compiled(
                 self.params, self.opt_state, self.grad_acc, data, label,
                 (), None, rng, self.epoch_counter, self.round,
                 do_update=True)
-            cost = lowered.compile().cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0] if cost else None
-            return float(cost.get('flops', 0.0)) if cost else 0.0
+            return float(entry.flops) if entry is not None else 0.0
         except (AttributeError, KeyError, TypeError, ValueError,
                 NotImplementedError, RuntimeError) as e:
             # backends without a cost model surface it many ways; record

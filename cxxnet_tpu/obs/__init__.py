@@ -14,7 +14,12 @@ Three legs, one hub:
   gauge-history sampler), :mod:`~cxxnet_tpu.obs.slo` (the declarative
   ``slo.<name>=`` burn-rate engine with typed OK/AT_RISK/BREACHED
   verdicts), and :mod:`~cxxnet_tpu.obs.fleet` (the elastic launcher's
-  merged rank-labeled scrape + per-host-lane trace merge).
+  merged rank-labeled scrape + per-host-lane trace merge),
+* graftprof — :mod:`~cxxnet_tpu.obs.programs` (the compiler-truth
+  :class:`ProgramLedger`: per-executable HLO cost/memory rows on
+  ``/programs``, the recompile sentinel, ``hbm.*`` device-memory
+  gauges, the MFU peak-FLOPs table, and the on-demand
+  ``/profile?ms=N`` session).
 """
 
 from .hub import (TelemetryHub, format_report, get_hub, install_hub,
@@ -22,12 +27,13 @@ from .hub import (TelemetryHub, format_report, get_hub, install_hub,
 
 __all__ = ['TelemetryHub', 'format_report', 'get_hub', 'install_hub',
            'next_trace_id', 'record_event', 'span', 'ObsServer',
-           'GaugeHistory', 'GaugeSampler', 'SLOEngine', 'SLOSpec']
+           'GaugeHistory', 'GaugeSampler', 'SLOEngine', 'SLOSpec',
+           'ProgramLedger', 'get_ledger', 'install_ledger']
 
 
 def __getattr__(name):
-    # endpoints/history/slo import lazily — embedders that never serve
-    # telemetry or evaluate SLOs pay nothing for them
+    # endpoints/history/slo/programs import lazily — embedders that
+    # never serve telemetry or evaluate SLOs pay nothing for them
     if name == 'ObsServer':
         from .endpoints import ObsServer
         return ObsServer
@@ -37,4 +43,7 @@ def __getattr__(name):
     if name in ('SLOEngine', 'SLOSpec'):
         from . import slo
         return getattr(slo, name)
+    if name in ('ProgramLedger', 'get_ledger', 'install_ledger'):
+        from . import programs
+        return getattr(programs, name)
     raise AttributeError(name)

@@ -69,7 +69,7 @@ class _RoutedHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 — stdlib naming
         routes: Routes = self.server.routes
-        path = self.path.split('?', 1)[0]
+        path, _, query = self.path.partition('?')
         try:
             route = routes.get(path)
             if route is None:
@@ -78,7 +78,12 @@ class _RoutedHandler(BaseHTTPRequestHandler):
                             f'not found: {known}\n'.encode('utf-8'))
             else:
                 ctype, render = route
-                self._reply(200, ctype, render())
+                if getattr(render, 'wants_query', False):
+                    from urllib.parse import parse_qs
+                    body = render(parse_qs(query))
+                else:
+                    body = render()
+                self._reply(200, ctype, body)
         # lint: allow(fault-taxonomy): an endpoint render error must answer 500 to the scraper, never kill the serving thread
         except Exception as e:
             try:
@@ -128,6 +133,24 @@ class EndpointThread:
         return not self._thread.is_alive()
 
 
+def _programs_body() -> bytes:
+    from .programs import get_ledger
+    return json_body(get_ledger().view())
+
+
+def _profile_render(out_dir: str):
+    """``/profile?ms=N`` — start one single-flight on-demand
+    ``jax.profiler`` window into ``out_dir`` (obs/programs.py
+    ProfilerSession); answers ``busy`` while one (or a config-driven
+    TraceWindow) is running."""
+    def render(query: dict) -> bytes:
+        from .programs import profile_session
+        ms = float(query.get('ms', ['1000'])[0])
+        return json_body(profile_session().start(out_dir, ms=ms))
+    render.wants_query = True
+    return render
+
+
 class ObsServer(EndpointThread):
     """The per-process telemetry endpoint thread over a
     :class:`~cxxnet_tpu.obs.hub.TelemetryHub`.  ``port=0`` = ephemeral
@@ -136,16 +159,23 @@ class ObsServer(EndpointThread):
     launcher reads one per rank)."""
 
     def __init__(self, hub, port: int = 0, host: str = '127.0.0.1',
-                 port_file: Optional[str] = None):
+                 port_file: Optional[str] = None, profile_dir:
+                 Optional[str] = None):
         self.hub = hub
-        super().__init__({
+        routes = {
             '/healthz': (TEXT_CTYPE,
                          lambda: f'{hub.health()}\n'.encode('utf-8')),
             '/metrics': (PROM_CTYPE,
                          lambda: hub.metrics_text().encode('utf-8')),
             '/statusz': (JSON_CTYPE, lambda: json_body(hub.status())),
             '/slos': (JSON_CTYPE, lambda: json_body(hub.slos_view())),
-        }, port=port, host=host)
+            # compiler-truth ledger (obs/programs.py): every compiled
+            # executable's cost/memory row, live
+            '/programs': (JSON_CTYPE, _programs_body),
+        }
+        if profile_dir:
+            routes['/profile'] = (JSON_CTYPE, _profile_render(profile_dir))
+        super().__init__(routes, port=port, host=host)
         if port_file:
             # temp+rename: a concurrent reader sees the whole port or
             # no file, never a partial write

@@ -133,6 +133,29 @@ class ScanStrictError(RuntimeError):
             f'[{reason}]: {detail}')
 
 
+class RecompileStormError(RuntimeError):
+    """A ledger-registered program (``obs/programs.py``) compiled more
+    times than its declared shape-key bound: some caller is feeding the
+    jitted function novel shapes — the classic recompile storm that
+    silently turns a served fleet into a compile farm.  Raised only
+    under ``obs.recompile=raise``; the default ``warn`` mode records
+    this typed kind into the failure log and bumps the
+    ``recompiles_total`` gauge instead.  Deliberately NOT a
+    :class:`TrainingFault` (a restore replays the same shapes) and not
+    a :class:`ServeError` (the trainer's programs are bounded too)."""
+
+    def __init__(self, name: str, shape_key, bound: int, compiles: int):
+        self.name = str(name)
+        self.shape_key = shape_key
+        self.bound = int(bound)
+        self.compiles = int(compiles)
+        super().__init__(
+            f'program {name!r} compiled {compiles} times (shape-key '
+            f'{shape_key!r}) but declared a bound of {bound}: recompile '
+            'storm — fix the caller\'s shape bucketing, raise the '
+            'declared bound, or set obs.recompile=warn to observe only')
+
+
 class ServeError(RuntimeError):
     """Base of the online-serving failure taxonomy (doc/serving.md).
     Deliberately NOT a :class:`TrainingFault`: serving errors are

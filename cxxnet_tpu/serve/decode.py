@@ -284,7 +284,19 @@ class DecodeEngine:
         self._spec_fns: dict = {}
         self._write_fns: dict = {}
         self._dwrite_fns: dict = {}
+        # compiler-truth ledger rows (obs/programs.py): the decode step
+        # is ONE program by construction (preallocated pools), declared
+        # bound=1 so any shape drift trips the recompile sentinel;
+        # prefill/tail/spec are LRU-bucketed ladders (unbounded
+        # declaration — the gen-cache LRU is their own churn policy)
+        from ..obs.programs import get_ledger
+        _led = get_ledger()
+        self._prog_step = _led.program('decode.step', bound=1)
+        self._prog_prefill = _led.program('decode.prefill')
+        self._prog_tail = _led.program('decode.tail_prefill')
+        self._prog_spec = _led.program('decode.spec')
         self._step = self._build_step()
+        # lint: allow(jit-ledger): one scalar-pick program ever (traced temperature); nothing a ledger row would say
         self._pick1 = jax.jit(self._pick_one)
         self._loop = threading.Thread(target=self._run, daemon=True,
                                       name=f'cxxnet-decode-{name}')
@@ -331,7 +343,8 @@ class DecodeEngine:
                 nxt = self._pick_slots(logits, r, temp)
                 return kpool, vpool, nxt
 
-            return jax.jit(step, donate_argnums=(1, 2))
+            return self._prog_step.jit(step, donate_argnums=(1, 2),
+                                       key='flash', fixed=True)
 
         def step(params, kpool, vpool, table, pos, w, tok, r, temp):
             # gather each slot's pages into the dense cache layout the
@@ -351,7 +364,8 @@ class DecodeEngine:
             nxt = self._pick_slots(logits, r, temp)
             return kpool, vpool, nxt
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return self._prog_step.jit(step, donate_argnums=(1, 2),
+                                   key='gather', fixed=True)
 
     def _prefill_fn(self, s0b: int, draft: bool = False):
         key = ('draft', s0b) if draft else s0b
@@ -359,8 +373,10 @@ class DecodeEngine:
         if fn is None:
             self.stats.inc('prefill_programs')   # retrace visibility
             cfg = self._draft_cfg if draft else self.cfg
-            fn = jax.jit(lambda params, prompt, w:
-                         T.prefill_kv(params, prompt, w, cfg))
+            fn = self._prog_prefill.jit(
+                lambda params, prompt, w:
+                T.prefill_kv(params, prompt, w, cfg),
+                key=f'{"draft_" if draft else ""}s{s0b}', fixed=True)
             self._prefill_fns[key] = fn
             # same LRU bound (and env knob) as generate's program cache
             while len(self._prefill_fns) > T._gen_cache_max():
@@ -376,8 +392,10 @@ class DecodeEngine:
         if fn is None:
             self.stats.inc('prefill_programs')
             cfg = self.cfg
-            fn = jax.jit(lambda params, pk, pv, tail, w:
-                         T.prefill_tail_kv(params, pk, pv, tail, w, cfg))
+            fn = self._prog_tail.jit(
+                lambda params, pk, pv, tail, w:
+                T.prefill_tail_kv(params, pk, pv, tail, w, cfg),
+                key=f't{t0}+{tt}', fixed=True)
             self._tail_fns[(t0, tt)] = fn
             while len(self._tail_fns) > T._gen_cache_max():
                 self._tail_fns.popitem(last=False)
@@ -397,6 +415,7 @@ class DecodeEngine:
                 vdc = jax.lax.dynamic_update_slice(
                     vdc, dvs, (0, sid, 0, 0, 0))
                 return kdc, vdc
+            # lint: allow(jit-ledger): two dynamic-update-slices — cache keyed by the same prompt buckets the ledgered prefill already rows
             fn = self._dwrite_fns[s0b] = jax.jit(dwrite,
                                                  donate_argnums=(0, 1))
         return fn
@@ -447,8 +466,9 @@ class DecodeEngine:
                 tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return kpool, vpool, kdc, vdc, toks, tgt
 
-            fn = self._spec_fns[K] = jax.jit(spec,
-                                             donate_argnums=(2, 3, 4, 5))
+            fn = self._spec_fns[K] = self._prog_spec.jit(
+                spec, donate_argnums=(2, 3, 4, 5), key=f'k{K}',
+                steps=K, fixed=True)
         return fn
 
     def _write_fn(self, n_pages: int, nrows: int):
@@ -473,6 +493,7 @@ class DecodeEngine:
                 vpool = vpool.at[:, pages].set(shaped[1])
                 return kpool, vpool
 
+            # lint: allow(jit-ledger): pure pad+scatter of already-prefilled rows; the compute it stores was rowed by decode.prefill
             fn = self._write_fns[key] = jax.jit(write,
                                                 donate_argnums=(0, 1))
         return fn
@@ -1303,7 +1324,28 @@ class DecodeEngine:
         if proposed:
             self.stats.gauge('spec_accept_rate',
                              self.stats.get('spec_accepted') / proposed)
+        drift = self.budget_drift()
+        if drift is not None:
+            self.stats.gauge('budget_drift', round(drift, 4))
         return format_report(name or self.name, self.stats)
+
+    def budget_drift(self) -> Optional[float]:
+        """Signed relative drift of the closed-form
+        :meth:`resident_bytes` ledger vs the compiled step's
+        ``memory_analysis`` argument bytes (obs/programs.py) — the
+        cross-check that keeps the MemoryBudgeter's arithmetic honest.
+        The step's arguments are params + both pools + O(slots) scalars,
+        so the comparison excludes the draft side (its programs are
+        separate); None before the first step compiles or when the
+        backend has no memory analysis."""
+        truth = self._prog_step.argument_bytes()
+        if truth <= 0:
+            return None
+        with self._cond:
+            params = self._params
+            pool = self._kpool.nbytes + self._vpool.nbytes
+        closed = pool + sum(l.nbytes for l in jax.tree.leaves(params))
+        return closed / truth - 1.0
 
 
 # -- on-disk format for transformer param trees ----------------------------

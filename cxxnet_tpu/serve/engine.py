@@ -25,7 +25,7 @@ in three load-bearing ways:
 from __future__ import annotations
 
 import threading
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -86,7 +86,12 @@ class PredictEngine:
             raise ValueError(
                 f'buckets {bad} do not divide the mesh data axis ({ddim} '
                 f'devices); pick multiples so padded batches shard evenly')
-        self.compile_count = 0
+        # compiler-truth ledger row per bucket (obs/programs.py): the
+        # declared bound IS the bucket-ladder contract, so a caller
+        # bypassing the pad path trips the recompile sentinel
+        from ..obs.programs import get_ledger
+        self._program = get_ledger().program('serve.predict',
+                                             bound=len(self.buckets))
         self.swap_count = 0
         self.version: object = 0
         # observability hook: called as on_serve(version) after every
@@ -121,14 +126,8 @@ class PredictEngine:
         max_round = tr.max_round
         spmd = tr._mesh.devices.size
         quantized = self.serve_dtype != 'f32'
-        engine = self
 
-        @jax.jit
         def fwd(params, data):
-            # trace-time hook: this Python line runs once per XLA
-            # compilation (per distinct data shape) and never inside the
-            # compiled program — the compile-cache bound is asserted on it
-            engine.compile_count += 1
             if quantized:
                 # weight-only expansion: int8/bf16 storage -> f32 math;
                 # XLA frees the expanded copies after the forward, so
@@ -141,7 +140,32 @@ class PredictEngine:
             values, _ = net.forward(params, data, ctx)
             return values[top]
 
-        return fwd
+        # the ledger wrap compiles once per distinct signature — the
+        # bucket key names the /programs row; its compile count IS the
+        # provably-bounded cache the tests assert (compile_count below)
+        return self._program.jit(
+            fwd, key_fn=lambda a, _k: f'b{a[1].shape[0]}')
+
+    @property
+    def compile_count(self) -> int:
+        """XLA compilations of the serving forward so far — re-based on
+        the program ledger (one per distinct signature; the bucket
+        ladder bounds it at ``len(buckets)``, and the ledger's
+        recompile sentinel now enforces that bound as well)."""
+        return self._program.compiles
+
+    def ledger_bytes(self) -> Optional[int]:
+        """The compiled forward's param bytes per ``memory_analysis``
+        truth: newest entry's argument bytes minus its input batch —
+        what ``budget_drift`` cross-checks :meth:`resident_bytes`
+        against.  None before the first compile (or when the backend
+        has no memory analysis)."""
+        e = self._program.newest_entry()
+        if e is None or e.argument_bytes <= 0:
+            return None
+        b = int(e.shape_key[1:]) if e.shape_key.startswith('b') else 0
+        c, y, x = self.trainer.net_cfg.input_shape
+        return int(e.argument_bytes) - b * c * y * x * 4
 
     # -- parameters --------------------------------------------------------
     @property

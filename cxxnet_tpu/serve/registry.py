@@ -655,4 +655,28 @@ class MultiModelRegistry:
         stats.gauge('evictions', self.evictions)
         for mid, nb in sorted(self.budgeter.resident().items()):
             stats.gauge(f'bytes[{mid}]', nb)
+        drift = self.budget_drift()
+        if drift is not None:
+            stats.gauge('budget_drift', round(drift, 4))
         return format_report(name, stats)
+
+    def budget_drift(self) -> Optional[float]:
+        """Signed relative drift of the budgeter's closed-form resident
+        ledger vs the compiled forwards' ``memory_analysis`` truth
+        (``engine.ledger_bytes()``, obs/programs.py) summed over every
+        loaded engine that has compiled — the fleet-level cross-check
+        behind the ``fleet.budget_drift`` gauge.  None until at least
+        one loaded engine carries a ledger row."""
+        closed = truth = 0
+        with self._lock:
+            engines = [e.engine for e in self._models.values()
+                       if e.engine is not None]
+        for eng in engines:
+            lb = getattr(eng, 'ledger_bytes', lambda: None)()
+            if lb is None or lb <= 0:
+                continue
+            closed += eng.resident_bytes()
+            truth += lb
+        if truth <= 0:
+            return None
+        return closed / truth - 1.0

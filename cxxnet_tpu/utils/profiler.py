@@ -18,7 +18,42 @@ default.
 
 from __future__ import annotations
 
-from typing import List, Tuple
+import threading
+from typing import List, Optional, Tuple
+
+# --- single-flight arbitration ---------------------------------------------
+# jax.profiler holds ONE global trace per process: the config-driven
+# TraceWindow and the on-demand /profile session (obs/programs.py
+# ProfilerSession) must never both start one.  Whoever acquires the
+# slot owns the profiler until release; the loser observes busy.
+_TRACE_LOCK = threading.Lock()
+_TRACE_OWNER: Optional[str] = None        # guarded-by: _TRACE_LOCK
+
+
+def acquire_trace(owner: str) -> bool:
+    """Claim the process-wide profiler slot for ``owner``; False when
+    ANY owner holds it — deliberately non-reentrant, so a stop racing
+    a fresh start can never hand two sessions the same slot (the
+    caller must not start a trace on False)."""
+    global _TRACE_OWNER
+    with _TRACE_LOCK:
+        if _TRACE_OWNER is not None:
+            return False
+        _TRACE_OWNER = owner
+        return True
+
+
+def release_trace(owner: str) -> None:
+    """Release the slot (no-op unless ``owner`` holds it)."""
+    global _TRACE_OWNER
+    with _TRACE_LOCK:
+        if _TRACE_OWNER == owner:
+            _TRACE_OWNER = None
+
+
+def trace_owner() -> Optional[str]:
+    with _TRACE_LOCK:
+        return _TRACE_OWNER
 
 
 class TraceWindow:
@@ -52,6 +87,11 @@ class TraceWindow:
         if not self.enabled or self._done:
             return
         if not self._active and batch_counter >= self.start_batch:
+            # single-flight vs the on-demand /profile session: if one
+            # is mid-trace, retry at the next batch instead of stacking
+            # a second global trace on the jax profiler
+            if not acquire_trace('profile_dir'):
+                return
             import jax
             jax.profiler.start_trace(self.profile_dir)
             self._active = True
@@ -65,3 +105,4 @@ class TraceWindow:
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
+            release_trace('profile_dir')

@@ -456,6 +456,19 @@ class Net:
         return json.dumps(get_hub().slos_view(), sort_keys=True,
                           default=str)
 
+    def obs_programs(self) -> str:
+        """The compiler-truth program ledger as one JSON object — the
+        same body the ``/programs`` endpoint serves: every compiled
+        executable's (name, shape-key) row with compile wall-ms, HLO
+        flops / bytes-accessed, and argument/output/temp/peak memory,
+        plus the recompile-sentinel totals (doc/observability.md
+        "Programs, memory, and MFU")."""
+        import json
+
+        from .obs.programs import get_ledger
+        return json.dumps(get_ledger().view(), sort_keys=True,
+                          default=str)
+
     # --- weight access (visitor equivalent) -------------------------------
     def _resolve(self, layer_name: str):
         tr = self._require()

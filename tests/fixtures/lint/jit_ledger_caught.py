@@ -1,0 +1,31 @@
+"""Seeded violation: direct ``jax.jit`` call sites in a trainer/serve
+module — four spellings (call, decorator-factory via partial, aliased
+from-import, bare decorator), all invisible to the program ledger.
+Twin: jit_ledger_clean.py."""
+
+from functools import partial
+
+import jax
+from jax import jit as jjit
+
+
+def build_forward(net):
+    # plain call spelling
+    return jax.jit(lambda p, x: net(p, x))
+
+
+@partial(jax.jit, static_argnames=('k',))
+def windowed(x, k):
+    # decorator-factory spelling
+    return x * k
+
+
+def build_step():
+    # aliased from-import spelling
+    return jjit(lambda x: x + 1)
+
+
+@jax.jit
+def forward_step(params, data):
+    # bare decorator spelling — an ast.Attribute, not a Call
+    return params @ data

@@ -1,0 +1,23 @@
+"""Clean twin of jit_ledger_caught.py: the same programs routed
+through the ProgramLedger wrap (the /programs + sentinel surface),
+plus one lawful allowed direct jit for a trivial restage helper."""
+
+import jax
+
+from cxxnet_tpu.obs.programs import get_ledger
+
+
+def build_forward(net, buckets):
+    prog = get_ledger().program('serve.predict', bound=len(buckets))
+    return prog.jit(lambda p, x: net(p, x),
+                    key_fn=lambda a, _k: f'b{a[1].shape[0]}')
+
+
+def build_step():
+    prog = get_ledger().program('decode.step', bound=1)
+    return prog.jit(lambda x: x + 1, fixed=True)
+
+
+def build_stacker():
+    # a two-op device-side restage: nothing a ledger row would say
+    return jax.jit(lambda *xs: jax.numpy.stack(xs))  # lint: allow(jit-ledger): trivial restage helper, no flops worth a row

@@ -1,0 +1,508 @@
+"""graftprof — compiler-truth observability suite (``-m obs``,
+doc/observability.md "Programs, memory, and MFU").
+
+The load-bearing claims:
+
+* every ledger-routed program registers ONE entry per distinct
+  signature with nonzero flops and memory_analysis fields (on CPU —
+  the acceptance platform), and re-dispatch never recompiles,
+* the recompile sentinel: a program past its declared bound bumps
+  ``recompiles_total`` and records the typed ``RecompileStormError``
+  kind under ``warn``, raises it under ``raise`` — including the
+  PredictEngine bucket-mismatch drill (a caller bypassing the pad
+  path),
+* ``hbm.*`` gauges degrade to the live-array fallback on CPU
+  (``supported=0``) instead of vanishing,
+* ``budget_drift`` cross-checks the closed-form ``resident_bytes``
+  ledgers against ``memory_analysis`` truth within a few percent,
+* ``train_step_flops`` reads the live ledger (no throwaway compile),
+* ``/programs`` serves the ledger live mid-run from the CLI, with the
+  MFU gauge riding the eval line,
+* the ``/profile`` session is single-flight and mutually exclusive
+  with a config-driven TraceWindow,
+* ``tools/bench_guard.py`` holds the receipt-ledger line (strict
+  JSON, platform stamps, regression flags) over the committed repo.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.obs import TelemetryHub, install_hub
+from cxxnet_tpu.obs.programs import (DeviceMemory, ProgramLedger,
+                                     install_ledger, mfu, peak_flops,
+                                     register_hbm)
+from cxxnet_tpu.runtime import faults
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GUARD = os.path.join(REPO, 'tools', 'bench_guard.py')
+
+
+@pytest.fixture
+def ledger():
+    led = ProgramLedger()
+    prev = install_ledger(led)
+    yield led
+    install_ledger(prev)
+
+
+@pytest.fixture
+def hub():
+    h = TelemetryHub(ring_events=256)
+    prev = install_hub(h)
+    yield h
+    h.disarm()
+    install_hub(prev)
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+# --- ledger units -----------------------------------------------------------
+
+def test_entry_has_cost_and_memory_truth(ledger):
+    import jax.numpy as jnp
+    prog = ledger.program('t.mm')
+    fn = prog.jit(lambda a, b: a @ b,
+                  key_fn=lambda a, _k: f'n{a[0].shape[0]}')
+    x = jnp.ones((64, 32))
+    y = jnp.ones((32, 16))
+    out = fn(x, y)
+    assert out.shape == (64, 16)
+    fn(x, y)                             # cached: no recompile
+    e = prog.newest_entry()
+    assert e.name == 't.mm' and e.shape_key == 'n64'
+    assert e.compiles == 1 and prog.compiles == 1
+    assert e.compile_ms > 0
+    assert e.flops > 0                   # cost_analysis truth (CPU too)
+    assert e.argument_bytes == (64 * 32 + 32 * 16) * 4
+    assert e.output_bytes == 64 * 16 * 4
+    assert e.peak_bytes >= e.argument_bytes + e.output_bytes
+    assert 'float32[64,32]' in e.signature
+    assert prog.flops_per_step() == e.flops
+
+
+def test_distinct_signatures_row_separately(ledger):
+    import jax.numpy as jnp
+    prog = ledger.program('t.sq')
+    fn = prog.jit(lambda a: a * a)       # auto shape keys
+    fn(jnp.ones((8,)))
+    fn(jnp.ones((16,)))
+    fn(jnp.ones((8,)))                   # cached
+    assert prog.compiles == 2
+    assert [e.shape_key for e in prog.entries()] == ['v0', 'v1']
+    assert ledger.summary()['compiles_total'] == 2
+
+
+def test_reclaimed_name_gets_suffix(ledger):
+    a = ledger.program('serve.predict')
+    b = ledger.program('serve.predict')
+    assert a.name == 'serve.predict'
+    assert b.name == 'serve.predict#2'
+
+
+def test_sentinel_warn_records_typed_kind(ledger, capsys):
+    import jax.numpy as jnp
+    log = faults.global_failure_log()
+    before = sum(1 for r in log.records()
+                 if r.kind == 'RecompileStormError')
+    prog = ledger.program('t.bounded', bound=1)
+    fn = prog.jit(lambda a: a + 1)
+    fn(jnp.ones((4,)))
+    fn(jnp.ones((5,)))                   # second compile: past the bound
+    assert ledger.recompiles_total == 1
+    after = sum(1 for r in log.records()
+                if r.kind == 'RecompileStormError')
+    assert after == before + 1
+    assert 'recompile storm' in capsys.readouterr().err
+
+
+def test_sentinel_raise_leg(ledger):
+    import jax.numpy as jnp
+    ledger.set_recompile('raise')
+    prog = ledger.program('t.bounded', bound=1)
+    fn = prog.jit(lambda a: a + 1)
+    fn(jnp.ones((4,)))
+    with pytest.raises(faults.RecompileStormError) as ei:
+        fn(jnp.ones((5,)))
+    assert ei.value.bound == 1 and ei.value.compiles == 2
+    ledger.set_recompile('off')
+    fn(jnp.ones((6,)))                   # off: counted nowhere, no raise
+    assert ledger.recompiles_total == 1
+
+
+def test_bad_recompile_mode_rejected(ledger):
+    with pytest.raises(ValueError, match='warn|raise|off'):
+        ledger.set_recompile('maybe')
+
+
+# --- PredictEngine: the bucket-mismatch recompile-storm drill ---------------
+
+def _mlp_engine(ledger, buckets=(4, 8)):
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.serve.engine import PredictEngine
+    from cxxnet_tpu.utils.config import parse_config_string
+    from tests.test_net_mnist import MLP_CONF
+    tr = NetTrainer(parse_config_string(
+        MLP_CONF + 'inference_only = 1\n'))
+    tr.init_model()
+    return PredictEngine(tr, buckets)
+
+
+def test_predict_engine_rebased_compile_count_and_drill(ledger):
+    eng = _mlp_engine(ledger)
+    assert eng.compile_count == 0
+    assert eng.warm() == 2               # one program per bucket
+    ks = sorted(e.shape_key for e in eng._program.entries())
+    assert ks == ['b4', 'b8']
+    rng = np.random.RandomState(0)
+    out = eng.predict_scores(rng.randn(5, 1, 1, 16).astype(np.float32))
+    assert out.shape[0] == 5
+    assert eng.compile_count == 2        # padded onto the ladder: no growth
+    # the drill: a buggy caller bypasses the pad path with a novel
+    # shape — the sentinel sees the third compile against bound=2
+    bad = eng._put(rng.randn(3, 1, 1, 16).astype(np.float32))
+    eng._fwd(eng.params, bad)
+    assert ledger.recompiles_total == 1
+    ledger.set_recompile('raise')
+    with pytest.raises(faults.RecompileStormError, match='serve.predict'):
+        eng._fwd(eng.params, eng._put(
+            rng.randn(7, 1, 1, 16).astype(np.float32)))
+
+
+def test_predict_engine_ledger_bytes_close_to_resident(ledger):
+    eng = _mlp_engine(ledger)
+    eng.warm()
+    truth = eng.ledger_bytes()
+    assert truth is not None and truth > 0
+    closed = eng.resident_bytes()
+    assert abs(closed / truth - 1.0) < 0.05, (closed, truth)
+
+
+# --- decode engine: /programs rows + budget_drift ---------------------------
+
+def test_decode_programs_and_budget_drift(ledger):
+    from cxxnet_tpu.models import transformer as T
+    from cxxnet_tpu.serve.decode import DecodeService
+    cfg = T.TransformerConfig(vocab_size=64, d_model=32, num_heads=4,
+                              d_ff=64, num_stages=2, seq_len=32,
+                              attn='local')
+    params = T.init_params(np.random.RandomState(0), cfg)
+    svc = DecodeService(params, cfg, slots=2, pages=24, page_size=4,
+                        max_prompt=8, max_new_bound=6, deadline=60.0)
+    try:
+        prompt = np.arange(5, dtype=np.int32).reshape(1, 5)
+        toks = svc.generate(prompt, 6)
+        assert len(toks) == 6
+        names = {e.name: e for e in ledger.entries()}
+        assert 'decode.step' in names and names['decode.step'].flops > 0
+        assert any(n.startswith('decode.prefill') for n in names)
+        assert names['decode.step'].argument_bytes > 0
+        drift = svc.engine.budget_drift()
+        assert drift is not None
+        # closed-form resident vs memory_analysis argument bytes: the
+        # step's non-pool operands are O(slots) scalars, so the two
+        # ledgers must agree within a few percent
+        assert abs(drift) < 0.05, drift
+        assert 'budget_drift' in svc.report('decode')
+    finally:
+        svc.close(30.0)
+
+
+# --- hbm gauges -------------------------------------------------------------
+
+def test_hbm_cpu_fallback_reports_live_bytes(hub):
+    import jax
+    import jax.numpy as jnp
+    keep = jnp.ones((256, 256))          # something live to account
+    register_hbm(hub)
+    snap = hub.gauge_snapshot()
+    assert snap.get('hbm.supported') == 0.0   # cpu: no memory_stats()
+    in_use = snap.get('hbm.bytes_in_use[d0]')
+    assert in_use is not None and in_use >= keep.nbytes
+    assert snap.get('hbm.peak_bytes[d0]') >= in_use
+    # the fallback's peak is an in-process monotone max
+    dm = DeviceMemory()
+    from cxxnet_tpu.utils.metric import StatSet
+    st = StatSet()
+    dm.fill(st)
+    first = st.get('peak_bytes[d0]')
+    del keep
+    dm.fill(st)
+    assert st.get('peak_bytes[d0]') >= 0
+    assert max(dm._peak_seen.values()) >= first
+    assert jax is not None
+
+
+# --- MFU table --------------------------------------------------------------
+
+def test_peak_flops_env_override_and_mfu(monkeypatch):
+    monkeypatch.delenv('CXXNET_PEAK_TFLOPS', raising=False)
+    assert peak_flops() == 0.0           # cpu: unknown denominator
+    assert mfu(1e9, 10.0) is None        # ...so MFU is unreported
+    monkeypatch.setenv('CXXNET_PEAK_TFLOPS', '0.5')
+    assert peak_flops() == 0.5e12
+    assert mfu(1e9, 10.0) == pytest.approx(1e10 / 0.5e12)
+    assert mfu(0.0, 10.0) is None        # no flops -> no claim
+
+
+def test_train_step_flops_reads_live_ledger(ledger):
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config_string
+    from tests.test_net_mnist import MLP_CONF, synth_batches
+    tr = NetTrainer(parse_config_string(MLP_CONF))
+    tr.init_model()
+    assert tr.train_step_flops() == 0.0  # nothing compiled yet
+    for batch in synth_batches(1, 16, seed=0):
+        tr.update(batch)
+    flops = tr.train_step_flops()        # no args: ledger-only read
+    assert flops > 0
+    e = tr._prog_step.newest_entry()
+    assert flops == e.flops
+    compiles = ledger.summary()['compiles_total']
+    assert tr.train_step_flops() == flops
+    # the read is free: no probe program was compiled for it
+    assert ledger.summary()['compiles_total'] == compiles
+
+
+# --- endpoints: /programs + /profile ----------------------------------------
+
+def test_programs_endpoint_and_statusz(ledger, hub, tmp_path):
+    import jax.numpy as jnp
+    from cxxnet_tpu.obs.endpoints import ObsServer
+    ledger.register_into(hub)
+    prog = ledger.program('t.mm')
+    prog.jit(lambda a: a @ a)(jnp.ones((16, 16)))
+    srv = ObsServer(hub, port=0, profile_dir=str(tmp_path / 'prof'))
+    try:
+        body = json.loads(_get(f'{srv.url}/programs'))
+        assert body['compiles_total'] == 1
+        (entry,) = body['programs']
+        assert entry['name'] == 't.mm' and entry['flops'] > 0
+        assert entry['argument_bytes'] > 0
+        status = json.loads(_get(f'{srv.url}/statusz'))
+        assert status['status']['programs']['compiles_total'] == 1
+        metrics = _get(f'{srv.url}/metrics').decode()
+        assert 'cxxnet_programs_compiles_total 1' in metrics
+        assert 'cxxnet_programs_flops{tag="t.mm"}' in metrics
+    finally:
+        srv.close(timeout=5.0)
+
+
+def test_profile_endpoint_single_flight(ledger, hub, tmp_path):
+    from cxxnet_tpu.obs.endpoints import ObsServer
+    from cxxnet_tpu.obs.programs import ProfilerSession, profile_session
+    import cxxnet_tpu.obs.programs as programs_mod
+    prev = programs_mod._PROFILE
+    programs_mod._PROFILE = ProfilerSession()  # fresh single-flight state
+    srv = ObsServer(hub, port=0, profile_dir=str(tmp_path / 'prof'))
+    try:
+        # generous timeout: the FIRST start_trace initializes the
+        # profiler backend, which can take seconds on a loaded host
+        with urllib.request.urlopen(f'{srv.url}/profile?ms=200',
+                                    timeout=60) as r:
+            first = json.loads(r.read())
+        assert first['started'] is True
+        assert os.path.isdir(first['path'])
+        second = json.loads(_get(f'{srv.url}/profile?ms=200'))
+        assert second['started'] is False and 'busy' in second
+        # stop_trace serializes metadata for EVERY compiled program in
+        # the process — seconds when this runs late in a full suite
+        # that compiled hundreds of executables, so wait generously
+        deadline = time.monotonic() + 60
+        while profile_session().status()['active'] \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        st = profile_session().status()
+        assert st['active'] is None and st['sessions'] == 1
+    finally:
+        srv.close(timeout=5.0)
+        deadline = time.monotonic() + 60
+        while programs_mod._PROFILE.status()['active'] \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        programs_mod._PROFILE = prev
+
+
+def test_profile_excluded_while_tracewindow_active(tmp_path):
+    from cxxnet_tpu.obs.programs import ProfilerSession
+    from cxxnet_tpu.utils import profiler as prof
+    assert prof.acquire_trace('profile_dir')   # a TraceWindow is live
+    try:
+        res = ProfilerSession().start(str(tmp_path), ms=100)
+        assert res['started'] is False
+        assert res['busy'] == 'profile_dir'
+    finally:
+        prof.release_trace('profile_dir')
+    assert prof.trace_owner() is None
+
+
+# --- CLI e2e: /programs live mid-run, MFU on the eval line ------------------
+
+def test_cli_train_programs_live_and_mfu_line(tmp_path):
+    """task=train with obs.port=0: /programs answers mid-run with the
+    trainer's compiled programs (nonzero flops + memory fields), and —
+    with a declared peak — the MFU gauge rides the eval line."""
+    from tests.test_io import write_mnist
+    write_mnist(str(tmp_path), n=512, rows=8, cols=8, seed=4)
+    conf = tmp_path / 'train.conf'
+    conf.write_text(f"""
+data = train
+iter = mnist
+  path_img = {tmp_path}/img.gz
+  path_label = {tmp_path}/lbl.gz
+  shuffle = 0
+iter = end
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.05
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 3
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,64
+batch_size = 16
+dev = cpu
+eta = 0.05
+metric[label] = error
+num_round = 6
+obs.port = 0
+""")
+    env = dict(os.environ, JAX_PLATFORMS='cpu', CXXNET_PEAK_TFLOPS='0.001',
+               PYTHONPATH=REPO + os.pathsep + os.environ.get('PYTHONPATH',
+                                                             ''))
+    out_path = tmp_path / 'stdout.txt'
+    got = None
+    with open(out_path, 'w') as out_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'cxxnet_tpu.main', str(conf)],
+            cwd=str(tmp_path), env=env, stdout=out_f,
+            stderr=subprocess.STDOUT, text=True)
+        try:
+            port = None
+            deadline = time.monotonic() + 120
+            while port is None and time.monotonic() < deadline:
+                for line in out_path.read_text().splitlines():
+                    if line.startswith('obs: telemetry on http://'):
+                        assert '/programs' in line and '/profile' in line
+                        port = int(line.split(':')[3].split('/')[0]
+                                   .split()[0])
+                        break
+                if port is None:
+                    assert proc.poll() is None, out_path.read_text()
+                    time.sleep(0.05)
+            assert port is not None, out_path.read_text()
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline and proc.poll() is None:
+                try:
+                    v = json.loads(_get(
+                        f'http://127.0.0.1:{port}/programs'))
+                except OSError:
+                    time.sleep(0.05)
+                    continue
+                if v['programs']:
+                    got = v              # LIVE mid-run snapshot
+                    break
+                time.sleep(0.05)
+            proc.wait(timeout=180)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+    assert proc.returncode == 0, out_path.read_text()
+    assert got is not None, out_path.read_text()
+    by_name = {e['name']: e for e in got['programs']}
+    step = by_name.get('train.step') or by_name.get('train.multi_step')
+    assert step is not None, by_name
+    assert step['flops'] > 0 and step['compile_ms'] > 0
+    assert step['argument_bytes'] > 0 and step['peak_bytes'] > 0
+    out = out_path.read_text()
+    eval_lines = [ln for ln in out.splitlines() if 'train-mfu:' in ln]
+    assert eval_lines, out
+    assert 'train-flops_per_step:' in eval_lines[0]
+    assert 'train-steps_per_sec:' in eval_lines[0]
+    mfu_val = float(eval_lines[0].split('train-mfu:')[1].split('\t')[0])
+    assert mfu_val > 0
+
+
+def test_wrapper_and_capi_obs_programs_surface(ledger):
+    """Embedders read /programs without a port: Net.obs_programs /
+    net_obs_programs return the ledger view as JSON."""
+    from cxxnet_tpu import capi, wrapper
+    eng = _mlp_engine(ledger)
+    eng.warm()
+    net = wrapper.Net(dev='cpu')
+    body = json.loads(net.obs_programs())
+    assert body['compiles_total'] == 2
+    assert {e['shape_key'] for e in body['programs']} == {'b4', 'b8'}
+    assert capi.net_obs_programs(net) == net.obs_programs()
+
+
+# --- bench_guard ------------------------------------------------------------
+
+def _run_guard(*args):
+    return subprocess.run([sys.executable, GUARD, *args],
+                          capture_output=True, text=True)
+
+
+def test_bench_guard_repo_ledger_clean():
+    r = _run_guard()
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'error(s)' in r.stdout
+
+
+def test_bench_guard_rejects_nan_and_missing_platform(tmp_path):
+    (tmp_path / 'BENCH_X_r01.json').write_text(
+        '{"metric": "m", "value": NaN, "unit": "ms"}\n')
+    r = _run_guard(str(tmp_path))
+    assert r.returncode == 1
+    assert 'null-not-NaN' in r.stdout
+    (tmp_path / 'BENCH_X_r01.json').write_text(
+        '{"metric": "m", "value": 1.0, "unit": "ms"}\n')
+    r = _run_guard(str(tmp_path))
+    assert r.returncode == 1
+    assert 'platform' in r.stdout
+    # a measured payload WITH a stamp (and an unmeasured one without)
+    (tmp_path / 'BENCH_X_r01.json').write_text(json.dumps(
+        {'metric': 'm', 'value': 1.0, 'unit': 'ms', 'platform': 'cpu'}))
+    (tmp_path / 'BENCH_X_r02.json').write_text(json.dumps(
+        {'metric': 'm', 'value': None, 'unit': None, 'error': 'down'}))
+    r = _run_guard(str(tmp_path))
+    assert r.returncode == 0, r.stdout
+
+
+def test_bench_guard_flags_regressions_by_direction(tmp_path):
+    mk = lambda **kw: json.dumps(dict(platform='cpu', **kw))  # noqa: E731
+    (tmp_path / 'BENCH_S_r01.json').write_text(mk(
+        metric='tok', value=1000.0, unit='tokens/sec'))
+    (tmp_path / 'BENCH_S_r02.json').write_text(mk(
+        metric='tok', value=500.0, unit='tokens/sec'))   # fell 50%
+    (tmp_path / 'BENCH_L_r01.json').write_text(mk(
+        metric='p99_ms', value=10.0, unit='ms'))
+    (tmp_path / 'BENCH_L_r02.json').write_text(mk(
+        metric='p99_ms', value=20.0, unit='ms'))          # rose 100%
+    r = _run_guard(str(tmp_path))
+    assert r.returncode == 0                 # flags warn by default
+    assert 'BENCH_S: tok fell 50%' in r.stdout
+    assert 'BENCH_L: p99_ms rose 100%' in r.stdout
+    assert _run_guard(str(tmp_path), '--strict').returncode == 1
+    # within tolerance: silent
+    (tmp_path / 'BENCH_S_r02.json').write_text(mk(
+        metric='tok', value=900.0, unit='tokens/sec'))
+    (tmp_path / 'BENCH_L_r02.json').write_text(mk(
+        metric='p99_ms', value=11.0, unit='ms'))
+    r = _run_guard(str(tmp_path), '--strict')
+    assert r.returncode == 0, r.stdout

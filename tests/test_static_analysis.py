@@ -22,8 +22,9 @@ import sys
 import pytest
 
 from cxxnet_tpu.analysis import (config_keys, core, fault_taxonomy,
-                                 lock_discipline, monotonic_clock,
-                                 span_hygiene, tracer_hygiene)
+                                 jit_ledger, lock_discipline,
+                                 monotonic_clock, span_hygiene,
+                                 tracer_hygiene)
 from cxxnet_tpu.analysis.core import (Finding, Repo, apply_suppressions,
                                       diff_against_baseline, load_baseline,
                                       run_all)
@@ -428,6 +429,40 @@ def bad(xs):
     assert 'jitted/scanned scope' in findings[0].message
 
 
+# --- jit-ledger: fixtures ----------------------------------------------------
+
+def test_jit_ledger_direct_sites_caught():
+    """All four spellings fire: plain call, partial(jax.jit, ...)
+    decorator factory, an aliased ``from jax import jit``, and the
+    bare ``@jax.jit`` decorator (an Attribute, not a Call — the
+    spelling this PR removed from trainer.py, so the most natural
+    regression)."""
+    findings = jit_ledger.check_module(fixture('jit_ledger_caught.py'))
+    assert rules_of(findings) == ['jit-ledger'] * 4
+    msgs = ' | '.join(f.message for f in findings)
+    assert 'ProgramLedger' in msgs
+    assert 'functools.partial' in msgs
+    assert 'bare decorator' in msgs
+
+
+def test_jit_ledger_clean_twin_and_allow_silent():
+    """The ledger-routed spelling never mentions jax.jit at the site;
+    the one trivial direct jit is detected but explicitly allowed."""
+    mod = fixture('jit_ledger_clean.py')
+    raw = jit_ledger.check_module(mod)
+    assert len(raw) == 1                      # the restage helper IS seen...
+    assert apply_suppressions(raw, mod) == []  # ...and allowed with a reason
+
+
+def test_jit_ledger_scoped_to_nnet_and_serve():
+    """A direct jit in models/ (the generate cache's home) is out of
+    scope — its programs register at the engine call sites."""
+    repo = Repo(REPO)
+    scoped = {f.path for f in jit_ledger.run(repo)}
+    assert all(p.startswith(('cxxnet_tpu/nnet/', 'cxxnet_tpu/serve/'))
+               for p in scoped)
+
+
 # --- live repo: clean or exactly baselined -----------------------------------
 
 def test_live_repo_clean_or_baselined():
@@ -457,6 +492,10 @@ def test_live_config_keys_documented():
 
 def test_live_span_hygiene_clean():
     assert run_all(root=REPO, rules=['span-hygiene']) == []
+
+
+def test_live_jit_ledger_clean():
+    assert run_all(root=REPO, rules=['jit-ledger']) == []
 
 
 def test_live_threaded_classes_declare_guards():

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""bench_guard — validate the committed BENCH_*.json receipt ledger.
+
+Usage::
+
+    python tools/bench_guard.py [--strict] [--tolerance F] [root]
+
+Every committed receipt is a measurement the trajectory's claims stand
+on, so the guard enforces the rules the bench modes promise
+(doc/benchmarks.md):
+
+* **strict JSON** — ``NaN``/``Infinity`` are not JSON; an unmeasured
+  quantity must be ``null`` (the null-not-NaN rule every receipt
+  writer follows since PR 8).  A receipt that fails to parse strictly
+  fails the guard.
+* **platform stamp** — a measured payload (``value`` not null) must
+  say what it was measured ON (``"platform"``: ``tpu`` /
+  ``cpu-fallback`` / ...), or a host number could pass as a per-chip
+  one.  Receipts committed before the stamp rule are grandfathered in
+  ``LEGACY_NO_PLATFORM`` — a shrink-only list: entries may be removed
+  as old rounds are re-measured, never added.
+* **regression flags** — within a receipt family (``BENCH_SERVE_r03``
+  → family ``BENCH_SERVE``), the same metric re-measured in a later
+  round is compared: a throughput (``*/sec``) drop or a latency
+  (``*ms``) rise beyond ``--tolerance`` (default 30%) is flagged.
+  Flags are warnings (exit 0) unless ``--strict`` — cross-round
+  hardware may legitimately differ; the stamp says so.
+
+Exit codes: ``0`` clean (or warnings only), ``1`` validation failure
+(or flagged regressions under ``--strict``), ``2`` internal error.
+``pytest -m obs`` runs the guard over the repo ledger, so a bad
+receipt fails tier-1 before it is ever cited.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: receipts committed before the platform-stamp rule (PR 5) existed —
+#: shrink-only: remove entries as rounds are re-measured, NEVER add
+LEGACY_NO_PLATFORM = frozenset({
+    'BENCH_IO_r01.json',       # PR 5 host-only io sweep (no device leg)
+    'BENCH_r02.json',          # pre-rule driver envelopes
+    'BENCH_r03.json',
+})
+
+_ROUND_RE = re.compile(r'^(.*)_r(\d+)\.json$')
+
+
+def _reject_const(tok: str):
+    raise ValueError(f'non-strict JSON constant {tok!r} (the '
+                     'null-not-NaN rule: unmeasured must be null)')
+
+
+def load_strict(path: str):
+    with open(path, encoding='utf-8') as f:
+        return json.load(f, parse_constant=_reject_const)
+
+
+def payloads(doc) -> List[dict]:
+    """Metric payloads inside a receipt file: the file may be one
+    payload, a list of payloads, or a driver envelope carrying them
+    under ``parsed``."""
+    if isinstance(doc, list):
+        return [p for p in doc if isinstance(p, dict) and 'metric' in p]
+    if not isinstance(doc, dict):
+        return []
+    if 'metric' in doc:
+        return [doc]
+    parsed = doc.get('parsed')
+    return payloads(parsed) if parsed is not None else []
+
+
+def check_file(path: str) -> Tuple[List[str], List[dict]]:
+    """(errors, payloads) for one receipt file."""
+    name = os.path.basename(path)
+    try:
+        doc = load_strict(path)
+    except ValueError as e:
+        return [f'{name}: invalid strict JSON: {e}'], []
+    errs = []
+    loads = payloads(doc)
+    for p in loads:
+        if p.get('value') is None:
+            continue                     # unmeasured/error payload
+        if 'platform' not in p and name not in LEGACY_NO_PLATFORM:
+            errs.append(
+                f'{name}: measured payload {p.get("metric")!r} carries '
+                'no "platform" stamp (tpu / cpu-fallback / ...)')
+    return errs, loads
+
+
+def _direction(unit: Optional[str], metric: str) -> int:
+    """+1 = higher is better (throughput), -1 = lower is better
+    (latency), 0 = not comparable."""
+    u = (unit or '').lower()
+    if '/sec' in u:
+        return 1
+    if u == 'ms' or metric.endswith('_ms') or '_ms_' in metric:
+        return -1
+    return 0
+
+
+def flag_regressions(rounds: Dict[str, Dict[int, List[dict]]],
+                     tolerance: float) -> List[str]:
+    """Compare each metric against its most recent PRIOR round within
+    the same receipt family; returns human-readable flags."""
+    flags = []
+    for family, per_round in sorted(rounds.items()):
+        seen: Dict[str, Tuple[int, float, Optional[str]]] = {}
+        for rnd in sorted(per_round):
+            for p in per_round[rnd]:
+                metric, value = p.get('metric'), p.get('value')
+                if not metric or not isinstance(value, (int, float)):
+                    continue
+                prior = seen.get(metric)
+                if prior is not None:
+                    prnd, pval, punit = prior
+                    d = _direction(p.get('unit'), metric)
+                    if d and punit == p.get('unit') and pval > 0:
+                        change = (value - pval) / pval
+                        if change * d < -tolerance:
+                            flags.append(
+                                f'{family}: {metric} '
+                                f'{"fell" if d > 0 else "rose"} '
+                                f'{abs(change):.0%} from r{prnd:02d} '
+                                f'({pval:g}) to r{rnd:02d} ({value:g})')
+                seen[metric] = (rnd, float(value), p.get('unit'))
+    return flags
+
+
+def run(root: str, tolerance: float = 0.30,
+        strict: bool = False) -> int:
+    files = sorted(glob.glob(os.path.join(root, 'BENCH_*.json')))
+    if not files:
+        print(f'bench_guard: no BENCH_*.json under {root}',
+              file=sys.stderr)
+        return 1
+    errors: List[str] = []
+    rounds: Dict[str, Dict[int, List[dict]]] = {}
+    for path in files:
+        errs, loads = check_file(path)
+        errors.extend(errs)
+        m = _ROUND_RE.match(os.path.basename(path))
+        if m and loads:
+            rounds.setdefault(m.group(1), {})[int(m.group(2))] = loads
+    flags = flag_regressions(rounds, tolerance)
+    for e in errors:
+        print(f'ERROR {e}')
+    for f in flags:
+        print(f'FLAG  {f}')
+    ok = len(files) - len({e.split(':')[0] for e in errors})
+    print(f'bench_guard: {len(files)} receipts, {ok} clean, '
+          f'{len(errors)} error(s), {len(flags)} regression flag(s)')
+    if errors:
+        return 1
+    if flags and strict:
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument('root', nargs='?',
+                   default=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+    p.add_argument('--strict', action='store_true',
+                   help='regression flags fail (exit 1), not just warn')
+    p.add_argument('--tolerance', type=float, default=0.30,
+                   help='relative change beyond which a re-measured '
+                        'metric is flagged (default 0.30)')
+    args = p.parse_args(argv)
+    try:
+        return run(os.path.abspath(args.root), tolerance=args.tolerance,
+                   strict=args.strict)
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        print('bench_guard: internal error (no verdict)', file=sys.stderr)
+        return 2
+
+
+if __name__ == '__main__':
+    sys.exit(main())
