@@ -320,5 +320,23 @@ def lm_serve_stats(svc) -> str:
     return svc.report()
 
 
+def lm_serve_scenario(svc, spec: str, time_scale: float = 1.0) -> str:
+    """Drive a seeded adversarial traffic scenario (``serve.scenario=``
+    grammar — doc/serving.md "Scenarios and autoscaling") against the
+    service and return the reconciled ledger summary as a JSON string
+    (submitted / per-bucket terminal counts / p50 / p99 seconds).
+    Deterministic: the same spec replays the same storm bit for bit."""
+    import json
+    return json.dumps(svc.run_scenario(spec, time_scale=float(time_scale)),
+                      sort_keys=True)
+
+
+def lm_serve_autoscale(svc, policy: str):
+    """Attach an SLO-driven autoscaler (``serve.autoscale=`` grammar)
+    over the service's live admission caps; returns the scaler handle
+    (its ``close()`` detaches — call before ``lm_serve_stop``)."""
+    return svc.autoscale(policy)
+
+
 def lm_serve_stop(svc) -> None:
     svc.close()

@@ -95,6 +95,9 @@ class LearnTask:
         self.serve_prefix_share = 0    # serve.prefix_share index pages (0=off)
         self.serve_spec_k = 0          # serve.spec_k window width (0/1=off)
         self.serve_draft = ''          # serve.draft spec (k=v;... like serve.lm)
+        # graftstorm: adversarial traffic + SLO-driven autoscaling
+        self.serve_scenario = ''       # serve.scenario spec (shape=...;seed=...)
+        self.serve_autoscale = ''      # serve.autoscale policy (min_slots=...;...)
         # train-while-serve (task=online, doc/online.md); batcher shape
         # comes from the serve.* keys above
         self.online_save_every = 8     # online.save_every steps/checkpoint
@@ -192,6 +195,8 @@ class LearnTask:
             'serve.prefix_share': ('serve_prefix_share', int),
             'serve.spec_k': ('serve_spec_k', int),
             'serve.draft': ('serve_draft', str),
+            'serve.scenario': ('serve_scenario', str),
+            'serve.autoscale': ('serve_autoscale', str),
             'dist.hosts': ('dist_hosts', int),
             'dist.rank': ('dist_rank', int),
             'dist.coordinator': ('dist_coordinator', str),
@@ -1055,18 +1060,43 @@ class LearnTask:
         pipe = OnlinePipeline(self.net_trainer, self.itr_train,
                               serve_factory, cfg,
                               request_source=request_source)
+        scaler = None
+        if self.serve_autoscale:
+            # SLO-driven autoscaling over the online stack: the batcher
+            # queue and the train/serve split are the bound knobs; with
+            # interval=0 the evaluation rides the before_step hook so
+            # the loop stays deterministic
+            from .obs import get_hub
+            from .serve.autoscale import AutoscalePolicy, Autoscaler
+            pol = AutoscalePolicy.parse(self.serve_autoscale)
+            scaler = Autoscaler(pol, name='online_scale')
+            pipe.start()
+            if pipe.batcher is not None:
+                scaler.bind_batcher(pipe.batcher)
+            scaler.bind_online(pipe)
+            scaler.register_into(get_hub())
         print('start online training-while-serving...')
         start = time.monotonic()
+
+        def before_step(i):
+            self._progress(i + 1, start)
+            if scaler is not None and scaler.policy.interval <= 0:
+                scaler.evaluate()
+
         try:
             summary = pipe.run(
                 num_rounds=self.num_round,
                 evals=list(zip(self.itr_evals, self.eval_names)),
-                before_step=lambda i: self._progress(i + 1, start))
+                before_step=before_step)
             sys.stderr.write(f'[online]{pipe.serve_report()}\n')
+            if scaler is not None:
+                sys.stderr.write(f'[online]{scaler.report()}\n')
             sys.stderr.flush()
             print(f'online summary: {json.dumps(summary, sort_keys=True)}',
                   flush=True)
         finally:
+            if scaler is not None:
+                scaler.close()
             pipe.close(timeout=30.0)
         print(f'finished online run, {int(time.monotonic() - start)} sec in all')
 
@@ -1164,6 +1194,9 @@ class LearnTask:
                   f', prefix_share={self.serve_prefix_share}'
                   f', spec_k={svc.engine._spec_k}'
                   f')', flush=True)
+        if self.serve_scenario:
+            self._serve_decode_scenario(svc, cfg)
+            return
         print('start serving (decode)...')
         rng = np.random.RandomState(self.serve_seed)
         n_req = max(1, self.serve_requests)
@@ -1214,6 +1247,72 @@ class LearnTask:
             svc.close(30.0)
         print(f'finished serving {served} decode streams, token ids in '
               f'{self.name_pred}')
+
+    def _serve_decode_scenario(self, svc, cfg) -> None:
+        """``serve.scenario=``: drive the decode stack through a seeded
+        adversarial traffic scenario (doc/serving.md "Scenarios and
+        autoscaling") instead of the fixed bulk prompts; with
+        ``serve.autoscale=`` an SLO-driven autoscaler retunes the live
+        admission caps while the storm runs.  Served streams land in
+        ``pred=``'s file (one line per request index); the ledger must
+        reconcile exactly against the service counters and the first
+        served streams are twin-checked against offline generate."""
+        import numpy as np
+
+        from .models import transformer as TT
+        from .obs import get_hub
+        from .serve.autoscale import AutoscalePolicy, Autoscaler
+        from .serve.scenario import ScenarioSpec, drive
+
+        spec = ScenarioSpec.parse(self.serve_scenario)
+        scaler = None
+        on_tick = None
+        if self.serve_autoscale:
+            pol = AutoscalePolicy.parse(self.serve_autoscale)
+            scaler = Autoscaler(pol)
+            scaler.bind_engine(svc.engine)
+            scaler.bind_batcher(svc.batcher)
+            scaler.register_into(get_hub())
+            if pol.interval <= 0:
+                on_tick = lambda _t: scaler.evaluate()
+        print(f'start serving (decode, scenario {spec.shape})...')
+        try:
+            led = drive(svc, spec, vocab=cfg.vocab_size, on_tick=on_tick)
+            led.reconcile(svc.engine.stats)
+            with open(self.name_pred, 'w') as fo:
+                for i in sorted(led.streams):
+                    fo.write(' '.join(str(int(t))
+                                      for t in led.streams[i]) + '\n')
+            checked = 0
+            for i in sorted(led.streams)[:3]:
+                rec = spec.schedule()[i]
+                prompt = spec.prompt_for(i, rec.prompt_len,
+                                         cfg.vocab_size)
+                off = np.asarray(TT.generate(
+                    svc.engine.params, prompt, rec.max_new,
+                    svc.engine.cfg))[0]
+                got = np.asarray(led.streams[i])
+                if not (got == off[:len(got)]).all():
+                    raise AssertionError(
+                        f'scenario stream {i} diverged from its offline '
+                        f'generate twin: {got} vs {off}')
+                checked += 1
+            if not self.silent:
+                print(f'scenario twin check: {checked} streams equal '
+                      'their offline generate calls', flush=True)
+            print(f'scenario summary: {led.summary()}')
+            if scaler is not None:
+                print(f'autoscale actions: {len(scaler.history())}, '
+                      f'degraded={scaler.degraded}')
+        finally:
+            if scaler is not None:
+                sys.stderr.write(f'[serve]{scaler.report()}\n')
+                scaler.close()
+            sys.stderr.write(f'[serve]{svc.report("decode")}\n')
+            sys.stderr.flush()
+            svc.close(30.0)
+        print(f'finished scenario ({led.counts["served"]} streams '
+              f'served), token ids in {self.name_pred}')
 
     def _serve_fleet(self, engine):
         """``serve.models=id=dir;id=dir``: register sibling checkpoints

@@ -145,6 +145,8 @@ class OnlinePipeline:
         self._client_errors = 0    # guarded-by: _served_lock
         self._traffic_stop = threading.Event()
         self._traffic_thread: Optional[threading.Thread] = None
+        self._qps = float(cfg.qps)        # guarded-by: _served_lock
+        self._train_throttle = 0.0        # guarded-by: _served_lock
         self._started = False
         self._closed = False
 
@@ -284,8 +286,13 @@ class OnlinePipeline:
         over ``request_source`` rows — the CLI/bench stand-in for a
         fronting server.  Client-visible errors are counted, never
         raised: the drill's zero-drop assertion reads the counter."""
-        period = 1.0 / max(self.cfg.qps, 1e-6)
-        while not self._traffic_stop.wait(period):
+        while True:
+            # re-read the rate every tick: the autoscaler retunes it
+            # live through set_qps (the train/serve split surface)
+            with self._served_lock:
+                period = 1.0 / max(self._qps, 1e-6)
+            if self._traffic_stop.wait(period):
+                return
             try:
                 self.submit(self.request_source())
             except faults.ServeError:
@@ -293,6 +300,32 @@ class OnlinePipeline:
                     self._client_errors += 1
             except RuntimeError:
                 return                       # batcher closed under us
+
+    def set_qps(self, qps: float) -> float:
+        """Live-retune the built-in traffic driver's rate (autoscaler /
+        operator surface); returns the previous rate.  Takes effect on
+        the next tick — no thread restart, no request dropped."""
+        qps = float(qps)
+        if qps <= 0:
+            raise ValueError(f'qps must be > 0, got {qps}')
+        with self._served_lock:
+            prev, self._qps = self._qps, qps
+        return prev
+
+    def set_train_throttle(self, seconds: float) -> float:
+        """Per-step training slowdown in seconds (0 = full speed) — the
+        autoscaler's train/serve split knob: under serving pressure the
+        train half yields device time; on sustained OK it is released.
+        Bounded (capped at 1s), reversible, takes effect on the next
+        step via the ``before_step`` hook.  Returns the previous value."""
+        seconds = min(1.0, max(0.0, float(seconds)))
+        with self._served_lock:
+            prev, self._train_throttle = self._train_throttle, seconds
+        return prev
+
+    def train_throttle(self) -> float:
+        with self._served_lock:
+            return self._train_throttle
 
     # -- the training loop --------------------------------------------------
     def _make_supervisor(self) -> TrainSupervisor:
@@ -340,10 +373,20 @@ class OnlinePipeline:
         def factory(k):
             return itertools.islice(iter(it), k, None)
 
+        def throttled(step: int) -> None:
+            # the autoscaler's train/serve split: yield device time to
+            # serving under pressure (sleep OFF any lock, between
+            # dispatches — training math is unchanged, only its pace)
+            t = self.train_throttle()
+            if t > 0:
+                time.sleep(t)
+            if before_step is not None:
+                before_step(step)
+
         try:
             for r in range(start_round, start_round + int(num_rounds)):
                 tr.start_round(r)
-                sup.run(factory, before_step=before_step,
+                sup.run(factory, before_step=throttled,
                         make_stepper=lambda: self._plan.round_stepper(
                             tr, lookahead=0))
                 tr.flush_divergence_check()
@@ -373,14 +416,15 @@ class OnlinePipeline:
 
     def dropped(self) -> int:
         """Requests that got an error instead of scores — the zero-drop
-        acceptance counter (batcher sheds + engine faults + client-side
-        typed errors from the built-in driver)."""
+        acceptance counter (batcher sheds + engine faults + client
+        abandonment + client-side typed errors from the built-in
+        driver)."""
         if self.batcher is None:
             with self._served_lock:
                 return self._client_errors
         s = self.batcher.stats
         return int(s.get('expired') + s.get('rejected')
-                   + s.get('engine_errors'))
+                   + s.get('engine_errors') + s.get('abandoned'))
 
     def eval_line(self, name: str = 'online') -> str:
         """Freshness + swap gauges in eval-line format — what rides the

@@ -325,6 +325,44 @@ class MemoryBudgetExceededError(ServeError):
             'evictable (every loaded model is serving)')
 
 
+class RequestAbandonedError(ServeError):
+    """The client stopped waiting (slow-client abandonment) before the
+    engine ran the request, so the worker dropped it without executing.
+    Typed so the scenario ledger reconciles abandoned work exactly: the
+    drop is counted once, by the worker/engine that discards the
+    request, never by the abandoning client (doc/serving.md)."""
+
+    def __init__(self, waited: float = 0.0):
+        self.waited = float(waited)
+        super().__init__(
+            f'request abandoned by client after {waited:.3f}s')
+
+
+class AutoscaleError(ServeError):
+    """Base of the autoscaler taxonomy (doc/serving.md "Scenarios and
+    autoscaling").  A :class:`ServeError`: autoscaling outcomes are
+    serving-side conditions an operator alarms on, not process faults a
+    checkpoint restore could repair."""
+
+
+class AutoscaleDegradedError(AutoscaleError):
+    """The autoscaler reached its declared ceiling with the objective
+    still AT_RISK/BREACHED and degraded *explicitly*: admission was
+    clamped to the declared floor so further overload sheds are typed
+    (:class:`ServeOverloadError`), never silent.  The autoscaler records
+    this kind into the failure log when it enters the degraded rung;
+    strict callers may raise it at run boundaries."""
+
+    def __init__(self, objective: str, verdict: str, actions: int):
+        self.objective = str(objective)
+        self.verdict = str(verdict)
+        self.actions = int(actions)
+        super().__init__(
+            f'autoscaler exhausted its declared bounds: objective '
+            f'{objective!r} still {verdict} after {actions} action(s) — '
+            'degrading explicitly (admission clamped, sheds typed)')
+
+
 class FaultInjected(OSError):
     """Deterministic injected fault.  Subclasses ``OSError`` so the
     storage retry policies treat it exactly like a real transient I/O
@@ -548,6 +586,14 @@ class FaultPlan:
       (default 30): a deterministic network partition.  Outliving the
       coordinator's heartbeat timeout makes the worker a declared host
       loss; a short blip just stalls the step.
+    * ``slow_step=N[:secs]`` — the N-th decode engine loop iteration
+      (1-based, counted across the process) sleeps ``secs`` (default
+      0.05) before stepping: deterministic serve-path latency injection.
+      The sleep lands on the decode loop thread *between* token
+      boundaries, so token streams stay bitwise identical to the
+      fault-free twin — only timing (deadlines, queue depth, autoscaler
+      pressure) shifts.  The serve half of a chaos drill composes this
+      with a ``serve.scenario=`` traffic shape (doc/serving.md).
 
     Any event kind also accepts the RECURRING form ``kind@every=K``
     (e.g. ``raise_on_write@every=3``, ``stall_batch@every=50:0.2``):
@@ -580,6 +626,9 @@ class FaultPlan:
                  host_loss_every: Tuple[Tuple[int, Optional[float]],
                                         ...] = (),
                  partition_every: Tuple[Tuple[int, Optional[float]],
+                                        ...] = (),
+                 slow_step: Tuple[Tuple[int, Optional[float]], ...] = (),
+                 slow_step_every: Tuple[Tuple[int, Optional[float]],
                                         ...] = ()):
         def _periods(vals):
             out = set()
@@ -617,9 +666,15 @@ class FaultPlan:
                                  for k, r in host_loss_every}
         self._partition_every = {int(k): (30.0 if s is None else s)
                                  for k, s in partition_every}
+        self._slow_step = {n: (0.05 if s is None else s)
+                           for n, s in slow_step}
+        self._slow_step_every = {int(k): (0.05 if s is None else s)
+                                 for k, s in slow_step_every}
         if 0 in self._host_loss_every or 0 in self._partition_every:
             raise ValueError('@every period must be > 0')
         if 0 in self._stall_every or 0 in self._stall_write_every:
+            raise ValueError('@every period must be > 0')
+        if 0 in self._slow_step_every:
             raise ValueError('@every period must be > 0')
         # step-keyed recurring events fire once per DISTINCT step: a
         # supervised restore replays step numbers, and re-firing on the
@@ -630,24 +685,32 @@ class FaultPlan:
         self._partition_fired_steps: set = set()
         self._write_count = 0
         self._model_count = 0
+        self._decode_count = 0
         self._fired: List[str] = []
         self._lock = threading.Lock()
+
+    #: every grammar kind :meth:`parse` accepts (each also takes the
+    #: recurring ``@every`` form) — the doc/fault_tolerance.md grammar
+    #: table is drift-tested against :meth:`registered_kinds`
+    KINDS = ('raise_on_write', 'stall_batch', 'stall_write',
+             'corrupt_shard', 'nan_at_step', 'corrupt_model',
+             'host_loss', 'partition', 'slow_step')
+
+    @classmethod
+    def registered_kinds(cls) -> Tuple[str, ...]:
+        """Grammar keys the parser accepts, ``seed`` included — the
+        code-side truth the doc-table drift test compares against."""
+        return ('seed',) + cls.KINDS
 
     @classmethod
     def parse(cls, text: str) -> 'FaultPlan':
         from ..utils.config import parse_kv_list
         seed = 0
-        kw: Dict[str, list] = {
-            'raise_on_write': [], 'stall_batch': [], 'stall_write': [],
-            'corrupt_shard': [], 'nan_at_step': [], 'corrupt_model': [],
-            'host_loss': [], 'partition': [],
-            'raise_on_write_every': [], 'stall_batch_every': [],
-            'stall_write_every': [], 'corrupt_shard_every': [],
-            'nan_at_step_every': [], 'corrupt_model_every': [],
-            'host_loss_every': [], 'partition_every': []}
+        kw: Dict[str, list] = {k: [] for k in cls.KINDS}
+        kw.update({f'{k}_every': [] for k in cls.KINDS})
         timed = ('stall_batch', 'stall_write', 'host_loss', 'partition',
-                 'stall_batch_every', 'stall_write_every',
-                 'host_loss_every', 'partition_every')
+                 'slow_step', 'stall_batch_every', 'stall_write_every',
+                 'host_loss_every', 'partition_every', 'slow_step_every')
         for key, val in parse_kv_list(text):
             if key == 'seed':
                 seed = int(val)
@@ -701,6 +764,10 @@ class FaultPlan:
                   for n, s in sorted(self._partition.items())]
         parts += [f'partition@every={k}:{s:g}'
                   for k, s in sorted(self._partition_every.items())]
+        parts += [f'slow_step={n}:{s:g}'
+                  for n, s in sorted(self._slow_step.items())]
+        parts += [f'slow_step@every={k}:{s:g}'
+                  for k, s in sorted(self._slow_step_every.items())]
         return ';'.join(parts)
 
     @staticmethod
@@ -848,6 +915,26 @@ class FaultPlan:
             os._exit(self.HOST_LOSS_EXIT)
         return secs
 
+    def on_decode_step(self) -> None:
+        """Every decode engine loop iteration calls this first (via the
+        ambient :func:`decode_step`).  The N-th iteration (1-based,
+        counted per plan) sleeps its configured seconds on the loop
+        thread, *between* token boundaries — streams stay bitwise
+        identical to the fault-free twin; only latency shifts."""
+        with self._lock:
+            self._decode_count += 1
+            n = self._decode_count
+            secs = self._slow_step.pop(n, None)
+            if secs is not None:
+                self._fired.append(f'slow_step={n}:{secs:g}')
+            else:
+                k = self._periodic_hit(n, self._slow_step_every)
+                if k is not None:
+                    secs = self._slow_step_every[k]
+                    self._fired.append(f'slow_step@every={k}#{n}')
+        if secs is not None:
+            time.sleep(secs)
+
     def on_model_committed(self, path: str) -> None:
         """After the N-th model-file commit (file + digest sidecar both
         durable), truncate the model file: the digest no longer matches,
@@ -960,6 +1047,14 @@ def elastic_step(step: int, rank: int, nhosts: int,
     if plan is None:
         return None
     return plan.on_elastic_step(step, rank, nhosts, allow_kill=allow_kill)
+
+
+def decode_step() -> None:
+    """Call once at the top of every decode engine loop iteration (see
+    :meth:`FaultPlan.on_decode_step`); a no-op when no plan is active."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.on_decode_step()
 
 
 def model_committed(path: str, staged: Optional[str] = None) -> None:

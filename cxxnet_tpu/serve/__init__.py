@@ -19,29 +19,45 @@ sit behind heavy live traffic.
   bitwise-twin offline ``transformer.generate``,
 * :class:`~cxxnet_tpu.serve.registry.MultiModelRegistry` — N models on
   one chip under a :class:`~cxxnet_tpu.serve.registry.MemoryBudgeter`
-  (evict-cold, never the serving model; per-model reload machinery).
+  (evict-cold, never the serving model; per-model reload machinery),
+* :mod:`~cxxnet_tpu.serve.scenario` — graftstorm: seeded, replayable
+  adversarial traffic scenarios (``serve.scenario=``) with an exactly
+  reconciling :class:`~cxxnet_tpu.serve.scenario.ScenarioLedger`,
+* :class:`~cxxnet_tpu.serve.autoscale.Autoscaler` — SLO-verdict-driven
+  scaling over declared-safe surfaces (``serve.autoscale=``), bounded,
+  hysteresis-damped, reversible; explicit typed degradation at the
+  ceiling.
 
 Entry points: ``task=serve`` (+ ``serve.mode=decode``) in the CLI
 (``main.py``), ``Net.serve_*`` in the Python wrapper, ``net_serve_*`` /
 ``lm_serve_*`` in the C ABI glue (``capi.py``).
 """
 
-from ..runtime.faults import (DeadlineExceededError,
+from ..runtime.faults import (AutoscaleDegradedError, AutoscaleError,
+                              DeadlineExceededError,
                               DecodePagesExhaustedError,
                               DecodeSlotsExhaustedError,
-                              MemoryBudgetExceededError, ServeError,
+                              MemoryBudgetExceededError,
+                              RequestAbandonedError, ServeError,
                               ServeOverloadError, TokenDeadlineExceededError)
+from .autoscale import AutoscalePolicy, Autoscaler
 from .batcher import DynamicBatcher, ServeRequest
 from .decode import (DecodeEngine, DecodeService, lm_loader,
                      load_lm_params, save_lm_params)
 from .engine import PredictEngine
 from .registry import (MemoryBudgeter, ModelRegistry, MultiModelRegistry,
                        load_model_params)
+from .scenario import (ScenarioLedger, ScenarioRequest, ScenarioSpec,
+                       drive_scenario)
 
 __all__ = ['PredictEngine', 'DynamicBatcher', 'ServeRequest',
            'ModelRegistry', 'MultiModelRegistry', 'MemoryBudgeter',
            'load_model_params', 'DecodeEngine', 'DecodeService',
-           'save_lm_params', 'load_lm_params', 'lm_loader', 'ServeError',
+           'save_lm_params', 'load_lm_params', 'lm_loader',
+           'ScenarioSpec', 'ScenarioRequest', 'ScenarioLedger',
+           'drive_scenario', 'AutoscalePolicy', 'Autoscaler', 'ServeError',
            'ServeOverloadError', 'DeadlineExceededError',
            'TokenDeadlineExceededError', 'DecodeSlotsExhaustedError',
-           'DecodePagesExhaustedError', 'MemoryBudgetExceededError']
+           'DecodePagesExhaustedError', 'MemoryBudgetExceededError',
+           'RequestAbandonedError', 'AutoscaleError',
+           'AutoscaleDegradedError']

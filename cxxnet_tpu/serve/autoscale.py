@@ -1,0 +1,433 @@
+"""SLO-driven autoscaling with graceful degradation
+(doc/serving.md "Scenarios and autoscaling").
+
+The observability stack opened a loop — gauges (PR 13), typed SLO
+verdicts (PR 14), compiler/HBM truth (PR 15) — and this module closes
+it, μ-cuDNN-style: *measure, then adapt within declared-safe bounds*.
+The :class:`Autoscaler` reads ``hub.slos_view()`` verdicts and
+``hub.gauge_snapshot()`` and acts ONLY through surfaces the serving
+stack already proves safe:
+
+* ``DecodeEngine.set_live_limits`` — grow/shrink decode slot and KV
+  page ADMISSION caps (the physical pool is baked into the compiled
+  step; clamping admission is token-boundary safe by construction and
+  never frees a referenced page),
+* ``DynamicBatcher.set_max_queue`` — admission queue capacity,
+* ``MemoryBudgeter.set_budget`` / fleet eviction — device-memory
+  pressure relief in multi-model serving,
+* ``OnlinePipeline.set_qps`` / ``set_train_throttle`` — the
+  train/serve split in ``task=online``.
+
+Every action is bounded by the policy's declared min/max, rate-limited
+per knob (``cooldown``), damped by consecutive-verdict hysteresis
+(``hysteresis`` — an OK↔AT_RISK flap at a burn-rate boundary produces
+ZERO actions), span-logged, and reversible (sustained OK drifts every
+knob back to its bound baseline).  A verdict the autoscaler cannot
+repair — still BREACHED with every knob at its ceiling — degrades
+*explicitly*: admission clamps to the declared floor so sheds stay
+typed (``ServeOverloadError``), and a typed
+:class:`~cxxnet_tpu.runtime.faults.AutoscaleDegradedError` lands in the
+failure log.  Silence is never an outcome.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..obs import format_report, get_hub, record_event
+from ..runtime import faults
+from ..utils.config import parse_kv_list
+from ..utils.metric import StatSet
+
+__all__ = ['AutoscalePolicy', 'Autoscaler', 'OK', 'AT_RISK', 'BREACHED']
+
+OK, AT_RISK, BREACHED = 'OK', 'AT_RISK', 'BREACHED'
+_SEVERITY = {OK: 0, AT_RISK: 1, BREACHED: 2}
+
+
+@dataclass(frozen=True)
+class AutoscalePolicy:
+    """Declared bounds and damping for one autoscaler
+    (``serve.autoscale=`` config grammar, ``k=v;k=v...``).
+
+    ``min_*``/``max_*`` bound each knob (slots/pages clamp further to
+    the engine's physical capacity at bind time); ``cooldown`` is the
+    per-knob action rate limit in seconds; ``hysteresis`` is how many
+    CONSECUTIVE same-direction evaluations must agree before anything
+    moves; ``step`` is the multiplicative grow/shrink factor;
+    ``interval`` > 0 starts a ``cxxnet-scale-*`` evaluation thread
+    (0 = manual :meth:`Autoscaler.evaluate` ticks — tests and the
+    scenario bench drive it deterministically)."""
+
+    min_slots: int = 1
+    max_slots: int = 0          # 0 = engine physical capacity
+    min_pages: int = 1
+    max_pages: int = 0          # 0 = engine physical capacity
+    min_queue: int = 1
+    max_queue: int = 0          # 0 = the batcher's bound at bind time
+    cooldown: float = 0.25
+    hysteresis: int = 2
+    step: float = 1.5
+    interval: float = 0.0
+
+    #: grammar keys :meth:`parse` accepts — the doc/serving.md
+    #: autoscale table is drift-tested against this tuple
+    KEYS = ('min_slots', 'max_slots', 'min_pages', 'max_pages',
+            'min_queue', 'max_queue', 'cooldown', 'hysteresis', 'step',
+            'interval')
+
+    @classmethod
+    def registered_keys(cls) -> Tuple[str, ...]:
+        return cls.KEYS
+
+    @classmethod
+    def parse(cls, text: str) -> 'AutoscalePolicy':
+        ints = {'min_slots', 'max_slots', 'min_pages', 'max_pages',
+                'min_queue', 'max_queue', 'hysteresis'}
+        kw: Dict[str, object] = {}
+        for key, val in parse_kv_list(text):
+            if key not in cls.KEYS:
+                raise ValueError(f'unknown autoscale option: {key!r}')
+            kw[key] = int(val) if key in ints else float(val)
+        pol = cls(**kw)
+        if pol.hysteresis < 1:
+            raise ValueError('hysteresis must be >= 1')
+        if pol.step <= 1.0:
+            raise ValueError('step must be > 1.0')
+        for lo, hi in (('min_slots', 'max_slots'),
+                       ('min_pages', 'max_pages'),
+                       ('min_queue', 'max_queue')):
+            lo_v, hi_v = getattr(pol, lo), getattr(pol, hi)
+            if lo_v < 1 or (hi_v and hi_v < lo_v):
+                raise ValueError(f'need 1 <= {lo} <= {hi} (0 = unbounded '
+                                 f'ceiling), got {lo_v}..{hi_v}')
+        return pol
+
+    def describe(self) -> str:
+        """Round-trips through :meth:`parse`."""
+        return (f'min_slots={self.min_slots};max_slots={self.max_slots};'
+                f'min_pages={self.min_pages};max_pages={self.max_pages};'
+                f'min_queue={self.min_queue};max_queue={self.max_queue};'
+                f'cooldown={self.cooldown:g};'
+                f'hysteresis={self.hysteresis};step={self.step:g};'
+                f'interval={self.interval:g}')
+
+
+class _Knob:
+    """One bounded, reversible control surface: a current value moved
+    multiplicatively between [lo, hi], restored toward its baseline on
+    sustained OK.  The setter is the ONLY side effect."""
+
+    def __init__(self, name: str, lo: int, hi: int, value: int,
+                 setter: Callable[[int], object]):
+        if not lo <= value <= hi:
+            value = max(lo, min(hi, value))
+            setter(value)
+        self.name = name
+        self.lo = int(lo)
+        self.hi = int(hi)
+        self.baseline = int(value)
+        self.value = int(value)
+        self.setter = setter
+        self.last_action = -math.inf    # monotonic secs
+
+    def target(self, direction: int, step: float) -> int:
+        if direction > 0:
+            return min(self.hi, max(self.value + 1,
+                                    int(math.ceil(self.value * step))))
+        # downward drift is always TOWARD the baseline, never past it:
+        # reversibility means returning to the declared resting point
+        if self.value <= self.baseline:
+            return self.value
+        return max(self.baseline, int(self.value / step))
+
+
+class Autoscaler:
+    """Closes the verdict loop over bound serving components.
+
+    ``verdicts``/``gauges`` are injectable zero-arg callables (default:
+    the hub's ``slos_view``/``gauge_snapshot``) so tests and the bench
+    drive scaling decisions deterministically.  :meth:`evaluate` is the
+    whole control law — one call per tick, manual unless
+    ``policy.interval`` > 0."""
+
+    def __init__(self, policy: AutoscalePolicy, hub=None,
+                 verdicts: Optional[Callable[[], dict]] = None,
+                 gauges: Optional[Callable[[], dict]] = None,
+                 failure_log=None, name: str = 'autoscale'):
+        self.policy = policy
+        self.name = name
+        self._hub = hub
+        self._verdicts = verdicts
+        self._gauges = gauges
+        self._log = failure_log
+        self.stats = StatSet()
+        self._lock = threading.Lock()
+        self._knobs: Dict[str, _Knob] = {}       # guarded-by: _lock
+        self._engine = None                      # guarded-by: _lock
+        self._fleet = None                       # guarded-by: _lock
+        self._online = None                      # guarded-by: _lock
+        self._streak = 0                         # guarded-by: _lock
+        self._streak_dir = 0                     # guarded-by: _lock
+        self._degraded = False                   # guarded-by: _lock
+        self._last_verdict = OK                  # guarded-by: _lock
+        self._history: collections.deque = (
+            collections.deque(maxlen=256))       # guarded-by: _lock
+        self._closed = False                     # guarded-by: _lock
+        self._ticker: Optional[threading.Thread] = None
+        if policy.interval > 0:
+            self._ticker = threading.Thread(
+                target=self._tick_loop, daemon=True,
+                name=f'cxxnet-scale-{name}')
+            self._ticker.start()
+
+    # -- binding safe surfaces ---------------------------------------------
+    def bind_engine(self, engine) -> None:
+        """Bind a ``DecodeEngine``: its live slot/page admission caps
+        become the ``slots``/``pages`` knobs, bounded by policy ∩
+        physical capacity.  The CURRENT caps are the baseline the
+        autoscaler returns to on sustained OK."""
+        pol = self.policy
+        slot_cap, page_cap = engine.live_limits()
+        phys_slots, phys_pages = engine.slots, engine.n_pages - 1
+        with self._lock:
+            self._engine = engine
+            self._knobs['slots'] = _Knob(
+                'slots', max(1, pol.min_slots),
+                min(phys_slots, pol.max_slots or phys_slots), slot_cap,
+                lambda v: engine.set_live_limits(max_slots=v))
+            self._knobs['pages'] = _Knob(
+                'pages', max(1, pol.min_pages),
+                min(phys_pages, pol.max_pages or phys_pages), page_cap,
+                lambda v: engine.set_live_limits(max_pages=v))
+
+    def bind_batcher(self, batcher) -> None:
+        """Bind a ``DynamicBatcher``: admission queue capacity becomes
+        the ``queue`` knob — also the degradation rung's clamp."""
+        pol = self.policy
+        with self._lock:
+            self._knobs['queue'] = _Knob(
+                'queue', max(1, pol.min_queue),
+                max(pol.max_queue or batcher.max_queue,
+                    batcher.max_queue),
+                batcher.max_queue, batcher.set_max_queue)
+
+    def bind_fleet(self, fleet) -> None:
+        """Bind a multi-model fleet (``MultiModelRegistry`` or a bare
+        ``MemoryBudgeter``): under sustained pressure the autoscaler
+        relieves device memory by evicting through the registry's own
+        never-busy/never-pinned eviction policy."""
+        with self._lock:
+            self._fleet = fleet
+
+    def bind_online(self, pipeline) -> None:
+        """Bind an ``OnlinePipeline``: the train/serve split becomes a
+        control surface (throttle training under serving pressure,
+        release it on sustained OK)."""
+        with self._lock:
+            self._online = pipeline
+
+    # -- verdict + gauge sources -------------------------------------------
+    def _read_verdict(self) -> str:
+        """Worst state across every SLO spec (no specs / no data = OK)."""
+        src = self._verdicts
+        if src is None:
+            hub = self._hub if self._hub is not None else get_hub()
+            src = hub.slos_view
+        worst = OK
+        view = src() or {}
+        for entry in view.values():
+            state = entry.get('state', OK) if isinstance(entry, dict) \
+                else str(entry)
+            if _SEVERITY.get(state, 0) > _SEVERITY[worst]:
+                worst = state
+        return worst
+
+    def gauge_view(self) -> dict:
+        src = self._gauges
+        if src is None:
+            hub = self._hub if self._hub is not None else get_hub()
+            src = hub.gauge_snapshot
+        return src() or {}
+
+    # -- the control law ---------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """One tick: read the verdict, update the hysteresis streak,
+        act at most once per knob (cooldown-bounded, min/max-bounded).
+        Returns the actions taken (possibly empty) — each a dict
+        ``{knob, from, to, verdict}`` also kept in :attr:`history`."""
+        now = time.monotonic() if now is None else float(now)
+        verdict = self._read_verdict()
+        direction = 1 if verdict in (AT_RISK, BREACHED) else -1
+        with self._lock:
+            if self._closed:
+                return []
+            self._last_verdict = verdict
+            if direction != self._streak_dir:
+                # direction change resets the streak: a verdict flapping
+                # at a burn-rate boundary never accumulates enough
+                # agreement to act — zero oscillating actions
+                self._streak_dir = direction
+                self._streak = 1
+            else:
+                self._streak += 1
+            self.stats.gauge('verdict', _SEVERITY[verdict])
+            self.stats.gauge('streak', self._streak * direction)
+            if self._streak < self.policy.hysteresis:
+                return []
+            actions = self._act(direction, verdict, now)
+            if actions:
+                self.stats.inc('actions', len(actions))
+            return actions
+
+    def _act(self, direction, verdict, now):  # requires-lock: _lock
+        actions: List[dict] = []
+
+        def move(knob, target, kind):  # requires-lock: _lock
+            frm, knob.value = knob.value, int(target)
+            knob.last_action = now
+            knob.setter(knob.value)
+            act = {'knob': knob.name, 'from': frm, 'to': knob.value,
+                   'verdict': verdict, 'kind': kind}
+            actions.append(act)
+            self._history.append(act)
+            record_event(f'autoscale.{kind}', 'autoscale',
+                         knob=knob.name, frm=frm, to=knob.value,
+                         verdict=verdict)
+
+        headroom = False
+        for knob in self._knobs.values():
+            if self._degraded and knob.name == 'queue':
+                # the degraded rung clamped admission explicitly; only
+                # sustained recovery re-opens it — growing it back under
+                # the same pressure that degraded us would oscillate
+                continue
+            tgt = knob.target(direction, self.policy.step)
+            if direction > 0 and knob.value < knob.hi:
+                headroom = True
+            if tgt == knob.value:
+                continue
+            if now - knob.last_action < self.policy.cooldown:
+                continue
+            move(knob, tgt, 'grow' if direction > 0 else 'shrink')
+        if direction > 0:
+            self._act_pressure(verdict, headroom, bool(actions), now,
+                               move)
+        else:
+            self._act_recovered(now, move)
+        return actions
+
+    def _act_pressure(self, verdict, headroom, acted, now, move):  # requires-lock: _lock
+        """Degradation ladder under sustained AT_RISK/BREACHED:
+        (1) the knob moves above already grew toward declared ceilings;
+        (2) relieve shared pressure — throttle the train half, evict
+        cold fleet models; (3) at the ceiling with the objective still
+        BREACHED, degrade explicitly: clamp admission to the floor so
+        sheds stay typed, and record the typed kind."""
+        if self._online is not None:
+            try:
+                self._online.set_train_throttle(0.01 * _SEVERITY[verdict])
+            # lint: allow(fault-taxonomy): a detached pipeline must not kill the control loop
+            except Exception:
+                pass
+        if verdict != BREACHED or headroom or acted:
+            return
+        if self._fleet is not None:
+            evict = getattr(self._fleet, 'evict_coldest', None)
+            if evict is not None:
+                try:
+                    if evict():
+                        self.stats.inc('fleet_evictions')
+                # lint: allow(fault-taxonomy): eviction is best-effort relief; failure falls through to explicit degradation
+                except Exception:
+                    pass
+        if not self._degraded:
+            q = self._knobs.get('queue')
+            if q is not None and q.value > q.lo:
+                move(q, q.lo, 'degrade')
+            self._degraded = True
+            self.stats.gauge('degraded', 1)
+            err = faults.AutoscaleDegradedError(
+                self.name, verdict, len(self._history))
+            log = self._log if self._log is not None \
+                else faults.global_failure_log()
+            log.record(type(err).__name__, str(err))
+
+    def _act_recovered(self, now, move):  # requires-lock: _lock
+        if self._online is not None:
+            try:
+                self._online.set_train_throttle(0.0)
+            # lint: allow(fault-taxonomy): a detached pipeline must not kill the control loop
+            except Exception:
+                pass
+        if self._degraded:
+            # leave the degraded rung the same way we entered it:
+            # explicitly, back to the queue baseline
+            q = self._knobs.get('queue')
+            if q is not None and q.value < q.baseline:
+                move(q, q.baseline, 'recover')
+            self._degraded = False
+            self.stats.gauge('degraded', 0)
+
+    # -- introspection / lifecycle -----------------------------------------
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return self._degraded
+
+    def history(self) -> List[dict]:
+        with self._lock:
+            return list(self._history)
+
+    def knob_values(self) -> Dict[str, int]:
+        with self._lock:
+            return {k: kn.value for k, kn in self._knobs.items()}
+
+    def status_view(self) -> dict:
+        """The ``/statusz`` provider body: policy, verdict, streak,
+        knob state, recent actions, bound-engine capacity truth."""
+        with self._lock:
+            out = {
+                'policy': self.policy.describe(),
+                'verdict': self._last_verdict,
+                'streak': self._streak * self._streak_dir,
+                'degraded': self._degraded,
+                'knobs': {k: {'value': kn.value, 'lo': kn.lo,
+                              'hi': kn.hi, 'baseline': kn.baseline}
+                          for k, kn in self._knobs.items()},
+                'actions': list(self._history)[-16:],
+            }
+            engine = self._engine
+        if engine is not None:
+            out['engine'] = engine.capacity_view()
+        return out
+
+    def register_into(self, hub, name: Optional[str] = None):
+        """Register stats + the ``/statusz`` provider under ``name``."""
+        name = name or self.name
+        hub.register_stats(name, self.stats)
+        hub.register_status(name, self.status_view)
+        return self
+
+    def report(self, name: Optional[str] = None) -> str:
+        return format_report(name or self.name, self.stats)
+
+    def _tick_loop(self) -> None:
+        while True:
+            time.sleep(self.policy.interval)
+            with self._lock:
+                if self._closed:
+                    return
+            self.evaluate()
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        with self._lock:
+            self._closed = True
+        if self._ticker is not None:
+            self._ticker.join(timeout if timeout is not None
+                              else self.policy.interval + 1.0)

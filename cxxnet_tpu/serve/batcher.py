@@ -37,8 +37,8 @@ from typing import Deque, List, Optional
 import numpy as np
 
 from ..obs import format_report, next_trace_id, record_event, span
-from ..runtime.faults import (DeadlineExceededError, ServeError,
-                              ServeOverloadError)
+from ..runtime.faults import (DeadlineExceededError, RequestAbandonedError,
+                              ServeError, ServeOverloadError)
 from ..utils.bucketing import bucket_for
 from ..utils.metric import StatSet
 
@@ -75,10 +75,13 @@ class ServeRequest:
         self.meta = meta or {}
         self.tokens: list = []          # incremental decode emissions
         self.token_times: list = []
-        # set by wait() when the caller gave up: the worker drops the
-        # request at pop time (best-effort — a request already mid-batch
-        # still executes) instead of burning a forward nobody reads, and
-        # the shed is counted once, on the caller side
+        # set by wait() on caller timeout or by abandon() when a slow
+        # client walks away: the worker drops the request at pop time
+        # (best-effort — a request already mid-batch still executes)
+        # instead of burning a forward nobody reads.  The DROP side owns
+        # the count (single-owner counting: every submitted request
+        # lands in exactly one terminal counter, always worker/engine
+        # side, so `submitted` reconciles exactly — doc/serving.md)
         self.abandoned = False
 
 
@@ -97,6 +100,7 @@ class DynamicBatcher:
         if max_queue <= 0:
             raise ValueError('max_queue must be positive')
         self.engine = engine
+        # guarded-by: _cond (live-retunable via set_max_queue)
         self.max_queue = int(max_queue)
         self.max_wait = float(max_wait)
         self.deadline = float(deadline)
@@ -138,6 +142,11 @@ class DynamicBatcher:
         with self._cond:
             if self._closed:
                 raise ServeError('batcher is closed')
+            # every admission attempt is a submission — `submitted`
+            # minus the terminal counters is exactly the in-flight
+            # count, which is how the scenario ledger proves no request
+            # is ever dropped or double-counted (serve/scenario.py)
+            self.stats.inc('submitted')
             if len(self._q) >= self.max_queue:
                 self.stats.inc('rejected')
                 raise ServeOverloadError(len(self._q), self.max_queue)
@@ -156,14 +165,29 @@ class DynamicBatcher:
         remaining = req.deadline_abs - time.monotonic()
         if not req.event.wait(timeout=max(0.0, remaining) + 0.05):
             # grace covers the set()-after-deadline race; a still-unset
-            # event past it means the batch never ran for us
+            # event past it means the batch never ran for us.  Mark the
+            # walk-away but do NOT count here: the worker counts the
+            # drop when it pops the request (single-owner counting —
+            # caller-side counting double-counts when the worker later
+            # expires or completes the same request)
             req.abandoned = True
-            self.stats.inc('expired')
             raise DeadlineExceededError(
                 req.deadline, time.monotonic() - req.t_submit, req.n)
         if req.error is not None:
             raise req.error
         return req.result
+
+    def abandon(self, req: ServeRequest) -> bool:
+        """Slow-client walk-away: mark ``req`` abandoned so the worker
+        drops it at pop time with a typed
+        :class:`~cxxnet_tpu.runtime.faults.RequestAbandonedError`
+        (counted once, on the drop side).  Best-effort by design: a
+        request already past admission completes normally.  Returns
+        False when the request has already finished."""
+        if req.event.is_set():
+            return False
+        req.abandoned = True
+        return True
 
     def submit(self, data: np.ndarray,
                deadline: Optional[float] = None) -> np.ndarray:
@@ -177,6 +201,21 @@ class DynamicBatcher:
         self.stats.inc('expired')
         record_event('serve.finish', 'serve', req.trace_id, rows=req.n,
                      error='DeadlineExceededError')
+        req.event.set()
+
+    def _drop_abandoned(self, req: ServeRequest) -> None:
+        """The single worker-side drop path for an abandoned request:
+        past its deadline it is a deadline miss (the caller's wait()
+        already raised that), otherwise a typed client walk-away.
+        Either way the drop is counted exactly once, here."""
+        now = time.monotonic()
+        if now >= req.deadline_abs:
+            self._expire(req, now)
+            return
+        req.error = RequestAbandonedError(now - req.t_submit)
+        self.stats.inc('abandoned')
+        record_event('serve.finish', 'serve', req.trace_id, rows=req.n,
+                     error='RequestAbandonedError')
         req.event.set()
 
     def _gather(self, first: ServeRequest) -> List[ServeRequest]:
@@ -207,8 +246,8 @@ class DynamicBatcher:
                         break      # preserve order: don't skip ahead
                     cost += nxt_cost
                 nxt = self._q.popleft()
-            if nxt.abandoned:      # caller gave up and counted the shed
-                nxt.event.set()
+            if nxt.abandoned:      # caller gave up: typed drop, counted here
+                self._drop_abandoned(nxt)
                 continue
             now = time.monotonic()
             if now >= nxt.deadline_abs:
@@ -222,13 +261,12 @@ class DynamicBatcher:
         # the coalescing window just closed: a request whose deadline
         # already passed while it waited must not ride the batch — a
         # stale answer wastes a forward (or a decode slot) nobody will
-        # read.  Shed it here, counted as a deadline miss, not forwarded
-        # (an abandoned request was already counted on the caller side).
+        # read.  Shed it here (typed, counted once), not forwarded.
         now = time.monotonic()
         live = []
         for r in batch:
             if r.abandoned:
-                r.event.set()
+                self._drop_abandoned(r)
             elif now >= r.deadline_abs:
                 self._expire(r, now)
             else:
@@ -251,9 +289,12 @@ class DynamicBatcher:
                 self._exec(batch)
                 self.stats.observe('coalesced', len(batch))
             except BaseException as e:
-                self.stats.inc('engine_errors')
+                # per-REQUEST counting: the engine already finished (and
+                # counted) some of the batch; only the strays land here,
+                # one count each, so the ledger reconciles exactly
                 for r in batch:
                     if not r.event.is_set():
+                        self.stats.inc('engine_errors')
                         r.error = e
                         r.event.set()
             return
@@ -268,8 +309,8 @@ class DynamicBatcher:
                       coalesced=len(batch)):
                 scores = self.engine.predict_scores(data)
         except BaseException as e:  # surface engine faults per-request
-            self.stats.inc('engine_errors')
             for r in batch:
+                self.stats.inc('engine_errors')
                 r.error = e
                 r.event.set()
             return
@@ -299,8 +340,8 @@ class DynamicBatcher:
                 if not self._q:   # closed and drained
                     return
                 first = self._q.popleft()
-            if first.abandoned:    # caller gave up and counted the shed
-                first.event.set()
+            if first.abandoned:    # caller gave up: typed drop, counted here
+                self._drop_abandoned(first)
                 continue
             now = time.monotonic()
             if now >= first.deadline_abs:
@@ -320,6 +361,18 @@ class DynamicBatcher:
             return False   # re-entrant close from a request callback
         self._worker.join(timeout)
         return not self._worker.is_alive()
+
+    def set_max_queue(self, n: int) -> int:
+        """Retune admission capacity live (the autoscaler's queue knob,
+        serve/autoscale.py — always bounded by the caller's declared
+        min/max).  Shrinking never drops queued requests: only future
+        admissions see the new bound.  Returns the previous value."""
+        n = int(n)
+        if n <= 0:
+            raise ValueError('max_queue must be positive')
+        with self._cond:
+            prev, self.max_queue = self.max_queue, n
+        return prev
 
     def depth(self) -> int:
         """Requests pending admission right now — the pull-style gauge

@@ -85,10 +85,11 @@ from ..models import transformer as T
 from ..nnet import quantize
 from ..obs import format_report, record_event, span
 from ..ops import pallas_kernels as PK
+from ..runtime import faults as _faults
 from ..runtime.faults import (DeadlineExceededError, DecodePagesExhaustedError,
                               DecodeSlotsExhaustedError,
-                              PrefixIndexFullError, ServeError,
-                              TokenDeadlineExceededError)
+                              PrefixIndexFullError, RequestAbandonedError,
+                              ServeError, TokenDeadlineExceededError)
 from ..utils.metric import StatSet
 
 __all__ = ['DecodeEngine', 'DecodeService', 'save_lm_params',
@@ -235,6 +236,15 @@ class DecodeEngine:
         self._admitting = 0   # guarded-by: _cond (admit..join window)
         self._join_seq = 0    # guarded-by: _cond
         self._closed = False  # guarded-by: _cond
+        # LOGICAL capacity caps — the autoscaler's grow/shrink surface
+        # (serve/autoscale.py).  The PHYSICAL slots/pages are baked into
+        # the compiled step (``decode.step`` declares bound=1, so a
+        # resize would be a retrace the recompile sentinel rightly
+        # flags); scaling therefore clamps ADMISSION only.  Shrinking
+        # never touches a live stream: in-flight page growth stays
+        # uncapped and a referenced page can never be freed (refcounts).
+        self._live_slot_cap = self.slots           # guarded-by: _cond
+        self._live_page_cap = self.n_pages - 1     # guarded-by: _cond
         # the ORIGINAL (pre-quantization) structure is the hot-swap
         # contract: .lm files always carry the f32 tree, place_params
         # validates against it and re-quantizes into the serving tier
@@ -787,6 +797,42 @@ class DecodeEngine:
             return (any(s is not None for s in self._slots)
                     or bool(self._joinq) or self._admitting > 0)
 
+    def set_live_limits(self, max_slots: Optional[int] = None,
+                        max_pages: Optional[int] = None):
+        """Clamp admission capacity live (the autoscaler's decode knob).
+
+        Caps clamp to [1, physical]; a shrink takes effect at the next
+        admission attempt — streams already past admission keep every
+        page they grow into (the cap gates entry, not survival), so no
+        autoscale action can ever corrupt or preempt a live stream.
+        Returns the effective ``(slot_cap, page_cap)``."""
+        with self._cond:
+            if max_slots is not None:
+                self._live_slot_cap = max(1, min(int(max_slots),
+                                                 self.slots))
+            if max_pages is not None:
+                self._live_page_cap = max(1, min(int(max_pages),
+                                                 self.n_pages - 1))
+            self._cond.notify_all()
+            return (self._live_slot_cap, self._live_page_cap)
+
+    def live_limits(self):
+        """Current logical ``(slot_cap, page_cap)`` admission clamps."""
+        with self._cond:
+            return (self._live_slot_cap, self._live_page_cap)
+
+    def capacity_view(self) -> dict:
+        """Physical vs live capacity in one snapshot — the autoscaler's
+        ``/statusz`` provider surfaces this per bound engine."""
+        with self._cond:
+            return {'slots': self.slots,
+                    'pages': self.n_pages - 1,
+                    'live_slot_cap': self._live_slot_cap,
+                    'live_page_cap': self._live_page_cap,
+                    'free_pages': len(self._free_pages),
+                    'occupied': sum(1 for s in self._slots
+                                    if s is not None)}
+
     # -- admission ---------------------------------------------------------
     @property
     def buckets(self):
@@ -805,6 +851,8 @@ class DecodeEngine:
             except BaseException as e:  # typed per-request outcome
                 if isinstance(e, DeadlineExceededError):
                     self.stats.inc('expired')
+                elif isinstance(e, RequestAbandonedError):
+                    self.stats.inc('abandoned')
                 elif isinstance(e, (DecodeSlotsExhaustedError,
                                     DecodePagesExhaustedError)):
                     self.stats.inc('shed_inadmissible')
@@ -869,21 +917,40 @@ class DecodeEngine:
             while True:
                 if self._closed:
                     raise ServeError('decode engine is closed')
+                if getattr(req, 'abandoned', False):
+                    # the client walked away while we waited for
+                    # capacity: a typed drop, never a burned slot
+                    raise RequestAbandonedError(
+                        time.monotonic() - req.t_submit)
+                if total_pages > self._live_page_cap:
+                    # autoscaler-clamped pool: shed fast and typed
+                    # instead of waiting out a deadline the clamp
+                    # guarantees we'd miss (the cap may grow back —
+                    # the CLIENT retries, the queue does not)
+                    raise DecodeSlotsExhaustedError(
+                        f'request needs {total_pages} KV pages but the '
+                        f'live page cap is {self._live_page_cap} '
+                        f'(physical pool {self.n_pages - 1})')
                 n_hit, hit_pages, hks, hvs = (
                     self._prefix_probe(padded, w, s0b)
                     if self._prefix_cap > 0 else (0, [], [], []))
                 need = n0 - n_hit
+                occupied = sum(1 for s in self._slots if s is not None)
                 if (self._pending_params is None
                         and self._pending_draft is None
-                        and any(s is None for s in self._slots)):
-                    if len(self._free_pages) < need:
-                        # forget cold prefixes before making anyone
-                        # wait — but never the hit pages this request
-                        # is about to splice
+                        and occupied < self._live_slot_cap):
+                    used = self.n_pages - 1 - len(self._free_pages)
+                    # index-only pages count as used, so a shrunk live
+                    # cap must reclaim them too — but never the hit
+                    # pages this request is about to splice
+                    short = max(need - len(self._free_pages),
+                                used + need - self._live_page_cap)
+                    if short > 0:
                         self._reclaim_index_pages(
-                            need - len(self._free_pages),
-                            exclude=set(hit_pages))
-                    if len(self._free_pages) >= need:
+                            short, exclude=set(hit_pages))
+                        used = self.n_pages - 1 - len(self._free_pages)
+                    if (len(self._free_pages) >= need
+                            and used + need <= self._live_page_cap):
                         break
                 remaining = req.deadline_abs - time.monotonic()
                 if remaining <= 0:
@@ -1127,6 +1194,10 @@ class DecodeEngine:
     def _run_inner(self) -> None:
         S = self.slots
         while True:
+            # chaos surface: an installed FaultPlan's ``slow_step``
+            # events sleep here, OFF the lock and between token
+            # boundaries — latency shifts, streams never do
+            _faults.decode_step()
             with self._cond:
                 while True:
                     self._expire_slots(time.monotonic())
@@ -1320,6 +1391,8 @@ class DecodeEngine:
             self.stats.gauge('pages_shared',
                              int((self._page_refs[1:] > 1).sum()))
             self.stats.gauge('prefix_index_pages', len(self._prefix))
+            self.stats.gauge('live_slot_cap', self._live_slot_cap)
+            self.stats.gauge('live_page_cap', self._live_page_cap)
         proposed = self.stats.get('spec_proposed')
         if proposed:
             self.stats.gauge('spec_accept_rate',

@@ -265,6 +265,15 @@ class PredictEngine:
         evict the model that is serving right now."""
         return self._inflight > 0
 
+    def capacity_view(self) -> dict:
+        """Declared capacity + live compile/residency truth in one
+        snapshot — what the autoscaler's ``/statusz`` provider surfaces
+        per bound engine (serve/autoscale.py)."""
+        return {'buckets': list(self.buckets),
+                'compile_count': int(self.compile_count),
+                'resident_bytes': int(self.resident_bytes()),
+                'busy': bool(self.busy())}
+
     # -- prediction --------------------------------------------------------
     def _put(self, data: np.ndarray):
         if data.dtype != np.float32:

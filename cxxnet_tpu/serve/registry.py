@@ -325,9 +325,19 @@ class MemoryBudgeter:
     unit-testable without engines."""
 
     def __init__(self, budget_bytes: int = 0):
+        # guarded-by: _lock (live-retunable via set_budget)
         self.budget = int(budget_bytes)
         self._resident: Dict[str, int] = {}
         self._lock = threading.Lock()
+
+    def set_budget(self, budget_bytes: int) -> int:
+        """Retune the fleet budget live (the autoscaler's eviction
+        lever, serve/autoscale.py); 0 = unbounded.  Accounting only —
+        enforcement stays with :class:`MultiModelRegistry`, which evicts
+        on its next pass.  Returns the previous budget."""
+        with self._lock:
+            prev, self.budget = self.budget, int(budget_bytes)
+        return prev
 
     def account(self, model_id: str, nbytes: int) -> None:
         with self._lock:
@@ -564,6 +574,23 @@ class MultiModelRegistry:
             entry = self._entry(model_id)
             if entry.engine is not None:
                 self._evict(entry)
+
+    def evict_coldest(self) -> Optional[str]:
+        """Evict the coldest evictable model (the autoscaler's
+        memory-pressure relief valve) under the SAME invariants budget
+        enforcement obeys: never a busy, pinned, or leased model.
+        Returns the evicted model id, or ``None`` if nothing was
+        evictable — the caller degrades explicitly instead."""
+        with self._lock:
+            victims = [e for e in self._models.values()
+                       if e.engine is not None and not e.pinned
+                       and e.leases == 0
+                       and not getattr(e.engine, 'busy', lambda: False)()]
+            if not victims:
+                return None
+            coldest = min(victims, key=lambda e: e.last_used)
+            self._evict(coldest)
+            return coldest.model_id
 
     # -- speculative-decode drafts -----------------------------------------
     def attach_draft(self, model_id: str, draft_dir: str,

@@ -1,0 +1,395 @@
+"""Seeded, deterministic, replayable traffic scenarios
+(doc/serving.md "Scenarios and autoscaling").
+
+Every bench before this one drove a single synthetic workload shape, so
+the degradation story under real traffic — diurnal swell, flash crowds,
+heavy-tail length mixes, multi-tenant fleets, slow clients that walk
+away — was untested.  A :class:`ScenarioSpec` freezes one such shape as
+a compact config value (``serve.scenario=shape=flash;seed=0;...``, the
+``FaultPlan`` grammar's spirit): the *entire* schedule — arrival
+offsets, prompt contents, output horizons, tenant assignment, which
+clients abandon and after how long — is a pure function of the spec, so
+a run is a twin of itself and a regression hunt can replay the exact
+storm that broke.
+
+Determinism layering (the house twin discipline):
+
+* ``schedule()`` is pure: spec -> per-request records.  No wall clock,
+  no ambient RNG.
+* prompt *content* is keyed per request index (seed ⊕ index), never per
+  arrival order — so batch composition, autoscaler actions, and wall
+  jitter can reorder execution freely without changing a single token.
+* the driver (:func:`drive`) paces real threads against the schedule;
+  timing jitter moves latency numbers, never streams.
+
+:class:`ScenarioLedger` is the reconciliation half of the bargain:
+every submitted request must land in exactly one typed terminal bucket
+(served / rejected / expired / abandoned / shed / engine error), and
+``reconcile()`` cross-checks the ledger against the service's own
+StatSet counters — a drop or double-count anywhere in the batcher or
+engine shows up as a hard mismatch here.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime import faults
+from ..utils.config import parse_kv_list
+
+__all__ = ['ScenarioSpec', 'ScenarioRequest', 'ScenarioLedger', 'drive',
+           'drive_scenario', 'SHAPES']
+
+#: traffic shapes the grammar accepts (doc/serving.md scenario table)
+SHAPES = ('steady', 'diurnal', 'flash', 'heavy_tail', 'tenants')
+
+#: multiplicative prompt-content key stride — a large odd constant so
+#: per-index streams never collide for any practical request count
+_PROMPT_KEY = 1000003
+
+
+@dataclass(frozen=True)
+class ScenarioRequest:
+    """One scheduled request — a pure function of (spec, index)."""
+
+    index: int
+    t_offset: float                  # seconds after scenario start
+    prompt_len: int
+    max_new: int
+    tenant: int = 0
+    abandon_after: Optional[float] = None   # slow-client patience (secs)
+
+
+@dataclass(frozen=True)
+class ScenarioSpec:
+    """A frozen, replayable traffic scenario.
+
+    Grammar (``serve.scenario=`` config value, ``k=v;k=v...``):
+
+    ``shape=`` one of :data:`SHAPES` · ``seed=`` RNG schedule key ·
+    ``requests=`` total count · ``qps=`` base arrival rate ·
+    ``burst=`` flash-crowd rate multiplier · ``periods=`` diurnal
+    cycles over the run · ``tail=`` Pareto alpha for heavy-tail length
+    mixes (smaller = heavier) · ``tenants=`` fleet tenant count ·
+    ``abandon=`` slow-client abandonment probability · ``patience=``
+    mean seconds an abandoning client waits · ``max_prompt=`` /
+    ``max_new=`` length caps.
+    """
+
+    shape: str = 'steady'
+    seed: int = 0
+    requests: int = 64
+    qps: float = 50.0
+    burst: float = 4.0
+    periods: float = 2.0
+    tail: float = 1.2
+    tenants: int = 1
+    abandon: float = 0.0
+    patience: float = 0.05
+    max_prompt: int = 32
+    max_new: int = 16
+
+    #: grammar keys :meth:`parse` accepts — the doc/serving.md scenario
+    #: table is drift-tested against this tuple
+    KEYS = ('shape', 'seed', 'requests', 'qps', 'burst', 'periods',
+            'tail', 'tenants', 'abandon', 'patience', 'max_prompt',
+            'max_new')
+
+    @classmethod
+    def registered_keys(cls) -> Tuple[str, ...]:
+        return cls.KEYS
+
+    @classmethod
+    def parse(cls, text: str) -> 'ScenarioSpec':
+        ints = {'seed', 'requests', 'tenants', 'max_prompt', 'max_new'}
+        kw: Dict[str, object] = {}
+        for key, val in parse_kv_list(text):
+            if key == 'shape':
+                if val not in SHAPES:
+                    raise ValueError(
+                        f'unknown scenario shape {val!r} '
+                        f'(one of {", ".join(SHAPES)})')
+                kw[key] = val
+            elif key in cls.KEYS:
+                kw[key] = int(val) if key in ints else float(val)
+            else:
+                raise ValueError(f'unknown scenario option: {key!r}')
+        spec = cls(**kw)
+        if spec.requests <= 0 or spec.qps <= 0:
+            raise ValueError('scenario needs requests > 0 and qps > 0')
+        if not 0.0 <= spec.abandon <= 1.0:
+            raise ValueError('abandon must be a probability in [0, 1]')
+        return spec
+
+    def describe(self) -> str:
+        """Round-trips through :meth:`parse`."""
+        return (f'shape={self.shape};seed={self.seed};'
+                f'requests={self.requests};qps={self.qps:g};'
+                f'burst={self.burst:g};periods={self.periods:g};'
+                f'tail={self.tail:g};tenants={self.tenants};'
+                f'abandon={self.abandon:g};patience={self.patience:g};'
+                f'max_prompt={self.max_prompt};max_new={self.max_new}')
+
+    # -- the deterministic schedule --------------------------------------
+
+    def _rate(self, i: int) -> float:
+        """Instantaneous arrival rate at request index ``i``."""
+        frac = i / max(1, self.requests - 1)
+        if self.shape == 'diurnal':
+            # smooth day curve: trough at 30% of peak
+            swell = 0.5 * (1.0 + math.sin(
+                2.0 * math.pi * self.periods * frac - math.pi / 2.0))
+            return self.qps * (0.3 + 0.7 * swell)
+        if self.shape == 'flash':
+            # middle third arrives at burst× the base rate
+            if 1.0 / 3.0 <= frac < 2.0 / 3.0:
+                return self.qps * max(1.0, self.burst)
+            return self.qps
+        return self.qps
+
+    def schedule(self) -> List[ScenarioRequest]:
+        """The full request schedule — a pure function of the spec."""
+        rng = np.random.RandomState(self.seed)
+        out: List[ScenarioRequest] = []
+        t = 0.0
+        for i in range(self.requests):
+            t += 1.0 / self._rate(i)
+            tenant = (i % self.tenants) if self.tenants > 1 else 0
+            if self.shape == 'heavy_tail':
+                # Pareto-tailed lengths: most requests tiny, a few huge
+                draw = rng.pareto(max(0.05, self.tail))
+                p_len = 1 + min(self.max_prompt - 1,
+                                int(draw * self.max_prompt / 4.0))
+                draw = rng.pareto(max(0.05, self.tail))
+                m_new = 1 + min(self.max_new - 1,
+                                int(draw * self.max_new / 4.0))
+            elif self.shape == 'tenants' and self.tenants > 1:
+                # per-tenant length profile: tenant t's prompts cluster
+                # around its own slice of the cap
+                base = 1 + (tenant * self.max_prompt) // self.tenants
+                p_len = min(self.max_prompt,
+                            base + int(rng.randint(
+                                1, max(2, self.max_prompt
+                                       // self.tenants + 1))))
+                m_new = 1 + int(rng.randint(1, self.max_new + 1)) // 2
+            else:
+                p_len = 1 + int(rng.randint(self.max_prompt))
+                m_new = 1 + int(rng.randint(self.max_new))
+            abandon_after = None
+            if self.abandon > 0.0 and rng.random_sample() < self.abandon:
+                # seeded patience: uniform around the mean, never zero
+                abandon_after = self.patience * float(
+                    0.5 + rng.random_sample())
+            out.append(ScenarioRequest(
+                index=i, t_offset=t, prompt_len=p_len, max_new=m_new,
+                tenant=tenant, abandon_after=abandon_after))
+        return out
+
+    def prompt(self, index: int, vocab: int) -> np.ndarray:
+        """Token content for request ``index`` — keyed by (seed, index)
+        only, so execution order and batch composition can never change
+        a prompt (the twin invariant's foundation)."""
+        sched_len = None
+        # length comes from the schedule; recompute just this entry
+        # cheaply is not possible (the RNG stream is sequential), so
+        # callers normally pass through drive(); this standalone path
+        # rebuilds the schedule once.
+        for r in self.schedule():
+            if r.index == index:
+                sched_len = r.prompt_len
+                break
+        if sched_len is None:
+            raise ValueError(f'index {index} outside schedule')
+        return self.prompt_for(index, sched_len, vocab)
+
+    def prompt_for(self, index: int, length: int,
+                   vocab: int) -> np.ndarray:
+        rng = np.random.RandomState(
+            (self.seed * _PROMPT_KEY + index) % (2 ** 31 - 1))
+        return rng.randint(0, vocab, size=(1, int(length)),
+                           dtype=np.int64).astype(np.int32)
+
+
+class ScenarioLedger:
+    """Typed terminal accounting for one scenario run.
+
+    Every submitted request lands in exactly one bucket; ``total()``
+    must equal ``submitted`` and — when the service shares its StatSet —
+    the service's own counters must tell the same story
+    (:meth:`reconcile`)."""
+
+    #: terminal buckets, keyed by outcome (the serve taxonomy's names)
+    BUCKETS = ('served', 'rejected', 'expired', 'abandoned',
+               'shed_inadmissible', 'shed_pages', 'engine_errors')
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.submitted = 0            # guarded-by: _lock
+        self.counts = {b: 0 for b in self.BUCKETS}   # guarded-by: _lock
+        self.latency_s: List[float] = []             # guarded-by: _lock
+        self.streams: Dict[int, np.ndarray] = {}     # guarded-by: _lock
+
+    def note_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def note(self, bucket: str, latency: Optional[float] = None,
+             index: Optional[int] = None, stream=None) -> None:
+        with self._lock:
+            self.counts[bucket] += 1
+            if latency is not None:
+                self.latency_s.append(float(latency))
+            if index is not None and stream is not None:
+                self.streams[index] = np.asarray(stream)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self.counts.values())
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            lat = sorted(self.latency_s)
+        if not lat:
+            return None
+        return lat[min(len(lat) - 1, int(q * len(lat)))]
+
+    def shed(self) -> int:
+        """Everything typed-shed (not served, not client-abandoned)."""
+        with self._lock:
+            c = dict(self.counts)
+        return (c['rejected'] + c['expired'] + c['shed_inadmissible']
+                + c['shed_pages'] + c['engine_errors'])
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            c = dict(self.counts)
+            n = self.submitted
+        return {'submitted': n, **c,
+                'p50_s': self.quantile(0.50),
+                'p99_s': self.quantile(0.99)}
+
+    #: service StatSet counters reconcile reads — snapshot these before
+    #: a drive to reconcile a SECOND scenario on the same (cumulative)
+    #: service via ``base=``
+    STAT_KEYS = ('submitted', 'requests', 'completed', 'rejected',
+                 'expired', 'abandoned', 'shed_inadmissible',
+                 'shed_pages', 'engine_errors')
+
+    @classmethod
+    def stat_snapshot(cls, stats) -> Dict[str, int]:
+        return {k: int(stats.get(k) or 0) for k in cls.STAT_KEYS}
+
+    def reconcile(self, stats=None,
+                  base: Optional[Dict[str, int]] = None) -> None:
+        """Hard invariant: submitted == Σ terminal buckets — and when
+        ``stats`` (the service StatSet) is given, its single-owner
+        counters agree bucket for bucket.  ``base`` (a
+        :meth:`stat_snapshot` taken before the drive) subtracts a prior
+        run's cumulative counts.  Raises AssertionError with the full
+        ledger on any mismatch."""
+        with self._lock:
+            c = dict(self.counts)
+            n = self.submitted
+        assert n == sum(c.values()), \
+            f'ledger drop/double-count: submitted={n} != {c}'
+        if stats is None:
+            return
+        cur = self.stat_snapshot(stats)
+        if base is not None:
+            cur = {k: cur[k] - base.get(k, 0) for k in cur}
+        assert cur['submitted'] == n, \
+            f'service saw {cur["submitted"]} submissions, ledger saw {n}'
+        svc = {b: cur[b] for b in self.BUCKETS if b != 'served'}
+        svc['served'] = cur['requests'] + cur['completed']
+        mism = {b: (c[b], svc[b]) for b in self.BUCKETS
+                if c[b] != svc[b]}
+        assert not mism, \
+            f'ledger vs service counters disagree (ledger, service): {mism}'
+
+
+def drive(svc, spec: ScenarioSpec, *, vocab: int,
+          ledger: Optional[ScenarioLedger] = None,
+          deadline: Optional[float] = None,
+          on_tick: Optional[Callable[[float], None]] = None,
+          time_scale: float = 1.0) -> ScenarioLedger:
+    """Run ``spec`` against a :class:`~.decode.DecodeService`.
+
+    Clients honor the schedule's arrival offsets (scaled by
+    ``time_scale`` — tests shrink wall time without touching the spec),
+    wait for their stream, and abandon through the batcher's typed
+    ``abandon()`` path when their patience runs out.  ``on_tick`` (if
+    given) is called with the elapsed scenario time after each arrival —
+    the autoscaler's manual-evaluation hook, so a test or bench drives
+    scaling decisions deterministically against scenario pressure.
+
+    Greedy decoding only (``temperature=0``): streams are a pure
+    function of (params, prompt, max_new), which is what lets every
+    scenario leg twin-assert against offline ``generate``.
+    """
+    led = ledger if ledger is not None else ScenarioLedger()
+    sched = spec.schedule()
+    threads: List[threading.Thread] = []
+    t0 = time.monotonic()
+
+    def _client(rec: ScenarioRequest, prompt: np.ndarray) -> None:
+        start = time.monotonic()
+        try:
+            req = svc.submit_async(prompt, rec.max_new, 0.0,
+                                   deadline=deadline)
+        except faults.ServeOverloadError:
+            led.note('rejected')
+            return
+        # lint: allow(fault-taxonomy): the ledger's catch-all keeps one unexpected client error from wedging the drive
+        except Exception:
+            led.note('engine_errors')
+            return
+        try:
+            if rec.abandon_after is not None:
+                done = req.event.wait(rec.abandon_after * time_scale)
+                if not done:
+                    # mark intent, then reap the worker's decision: a
+                    # request already past admission completes normally
+                    # (counted served), one still queued is dropped with
+                    # a typed RequestAbandonedError — either way the
+                    # single-owner counter and this ledger agree
+                    svc.batcher.abandon(req)
+            svc.batcher.wait(req)
+            led.note('served', latency=time.monotonic() - start,
+                     index=rec.index, stream=req.result)
+        except faults.RequestAbandonedError:
+            led.note('abandoned')
+        except faults.DecodeSlotsExhaustedError:
+            led.note('shed_inadmissible')
+        except faults.DecodePagesExhaustedError:
+            led.note('shed_pages')
+        except faults.DeadlineExceededError:
+            led.note('expired')
+        except faults.ServeError:
+            led.note('engine_errors')
+
+    for rec in sched:
+        delay = t0 + rec.t_offset * time_scale - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        prompt = spec.prompt_for(rec.index, rec.prompt_len, vocab)
+        led.note_submit()
+        t = threading.Thread(target=_client, args=(rec, prompt),
+                             name=f'scenario-client-{rec.index}',
+                             daemon=True)
+        t.start()
+        threads.append(t)
+        if on_tick is not None:
+            on_tick(time.monotonic() - t0)
+    for t in threads:
+        t.join(timeout=60.0)
+    return led
+
+
+#: the package-level spelling (``serve.drive_scenario``) — same callable
+drive_scenario = drive

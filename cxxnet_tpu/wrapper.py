@@ -645,6 +645,35 @@ class LMServe:
         return self.svc.generate(prompt, max_new, temperature, rng,
                                  deadline)
 
+    def autoscale(self, policy: str):
+        """Attach an SLO-driven autoscaler (``serve.autoscale=``
+        grammar, doc/serving.md "Scenarios and autoscaling") over this
+        service's live admission caps; returns the
+        :class:`~cxxnet_tpu.serve.autoscale.Autoscaler` (call its
+        ``evaluate()`` per tick when ``interval=0``, or let its
+        ``interval>0`` thread run; ``close()`` detaches)."""
+        from .obs import get_hub
+        from .serve.autoscale import AutoscalePolicy, Autoscaler
+        scaler = Autoscaler(AutoscalePolicy.parse(policy))
+        scaler.bind_engine(self.svc.engine)
+        scaler.bind_batcher(self.svc.batcher)
+        scaler.register_into(get_hub())
+        return scaler
+
+    def run_scenario(self, spec: str, time_scale: float = 1.0,
+                     on_tick=None) -> dict:
+        """Drive a seeded traffic scenario (``serve.scenario=``
+        grammar) against this service and return the reconciled
+        ledger's summary dict (submitted / per-bucket counts / p50 /
+        p99).  Deterministic: the same spec replays the same storm."""
+        from .serve.scenario import ScenarioLedger, ScenarioSpec, drive
+        sspec = ScenarioSpec.parse(spec)
+        base = ScenarioLedger.stat_snapshot(self.engine.stats)
+        led = drive(self.svc, sspec, vocab=self.engine.cfg.vocab_size,
+                    on_tick=on_tick, time_scale=time_scale)
+        led.reconcile(self.engine.stats, base=base)
+        return led.summary()
+
     def report(self, name: str = 'decode') -> str:
         return self.svc.report(name)
 

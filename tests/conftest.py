@@ -107,6 +107,14 @@ def pytest_configure(config):
         '(tier-1: runs under -m "not slow"; select with -m slo)')
     config.addinivalue_line(
         'markers',
+        'scenario: graftstorm suite — seeded adversarial traffic '
+        'scenarios (diurnal/flash/heavy-tail/tenants/abandonment), '
+        'exactly-reconciling scenario ledger, SLO-driven autoscaler '
+        'hysteresis/degradation, live-cap shrink safety under '
+        'refcounted prefix pages; CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m scenario)')
+    config.addinivalue_line(
+        'markers',
         'dist: elastic multi-host training suite — coordinator/client '
         'membership, host-sharded stream bitwise twins, and the '
         'multi-process chaos drills (real worker subprocesses over '
@@ -121,7 +129,8 @@ def pytest_configure(config):
 # coordinator/heartbeat threads) precisely so this fixture can hold the
 # line on lifecycle
 _PIPELINE_THREAD_PREFIXES = ('cxxnet-tb-', 'cxxnet-pool-', 'cxxnet-decode-',
-                             'cxxnet-elastic-', 'cxxnet-obs-')
+                             'cxxnet-elastic-', 'cxxnet-obs-',
+                             'cxxnet-scale-')
 
 
 def _pipeline_threads():

@@ -1,0 +1,536 @@
+"""graftstorm suite: adversarial traffic scenarios + SLO-driven
+autoscaling (serve/scenario.py, serve/autoscale.py; doc/serving.md
+"Scenarios and autoscaling").
+
+The load-bearing claims:
+
+* a :class:`ScenarioSpec` is a twin of itself — the schedule and every
+  prompt token are pure functions of the spec, independent of execution
+  order, autoscaler actions, and wall jitter;
+* every submitted request lands in exactly ONE typed terminal bucket
+  and the ledger reconciles bucket-for-bucket against the service's
+  single-owner counters — sustained slow-client abandonment included;
+* the autoscaler is damped (a flapping verdict produces ZERO actions),
+  bounded, reversible, and degrades explicitly — and shrinking the live
+  page cap under live refcounted prefix pages never frees a referenced
+  page;
+* the fault grammar / scenario grammar / autoscale grammar documented
+  in doc/ cannot drift from the registered kinds and keys.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.models import transformer as T
+from cxxnet_tpu.runtime.faults import (FaultPlan, RequestAbandonedError,
+                                       ServeOverloadError)
+from cxxnet_tpu.serve.autoscale import AutoscalePolicy, Autoscaler
+from cxxnet_tpu.serve.decode import DecodeService
+from cxxnet_tpu.serve.scenario import (SHAPES, ScenarioLedger,
+                                       ScenarioSpec, drive)
+
+pytestmark = pytest.mark.scenario
+
+CFG = T.TransformerConfig(vocab_size=64, d_model=16, num_heads=2,
+                          d_ff=32, num_stages=1, seq_len=64, attn='local')
+
+
+def _params(seed: int = 0):
+    return T.init_params(np.random.RandomState(seed), CFG)
+
+
+def _service(**kw):
+    kw.setdefault('slots', 2)
+    kw.setdefault('pages', 16)
+    kw.setdefault('page_size', 4)
+    kw.setdefault('max_prompt', 16)
+    kw.setdefault('max_new_bound', 8)
+    kw.setdefault('max_queue', 8)
+    kw.setdefault('max_wait', 0.001)
+    kw.setdefault('deadline', 30.0)
+    kw.setdefault('eos_id', None)
+    return DecodeService(_params(), CFG, **kw)
+
+
+def _offline(params, prompt, max_new):
+    return np.asarray(T.generate(params, prompt, max_new, CFG))[0]
+
+
+# --- the spec: grammar + determinism ---------------------------------------
+
+def test_spec_parse_describe_roundtrip():
+    spec = ScenarioSpec.parse('shape=flash;seed=7;requests=128;qps=300;'
+                              'burst=6;abandon=0.25;patience=0.1;'
+                              'max_prompt=24;max_new=12')
+    assert spec.shape == 'flash' and spec.burst == 6.0
+    assert ScenarioSpec.parse(spec.describe()) == spec
+    # defaults round-trip too
+    assert ScenarioSpec.parse(ScenarioSpec().describe()) == ScenarioSpec()
+
+
+def test_spec_rejects_unknown_and_invalid():
+    with pytest.raises(ValueError, match='unknown scenario option'):
+        ScenarioSpec.parse('shape=steady;bogus=1')
+    with pytest.raises(ValueError, match='unknown scenario shape'):
+        ScenarioSpec.parse('shape=tsunami')
+    with pytest.raises(ValueError, match='requests > 0'):
+        ScenarioSpec.parse('requests=0')
+    with pytest.raises(ValueError, match='probability'):
+        ScenarioSpec.parse('abandon=1.5')
+
+
+def test_schedule_is_a_twin_of_itself():
+    spec = ScenarioSpec.parse('shape=heavy_tail;seed=11;requests=64;'
+                              'qps=500;tail=1.1;abandon=0.3')
+    a, b = spec.schedule(), spec.schedule()
+    assert a == b
+    # and prompt contents replay bit for bit
+    for rec in a[:8]:
+        p1 = spec.prompt_for(rec.index, rec.prompt_len, CFG.vocab_size)
+        p2 = spec.prompt_for(rec.index, rec.prompt_len, CFG.vocab_size)
+        assert (p1 == p2).all() and p1.dtype == np.int32
+    # a different seed is a different storm
+    other = ScenarioSpec.parse('shape=heavy_tail;seed=12;requests=64;'
+                               'qps=500;tail=1.1;abandon=0.3')
+    assert other.schedule() != a
+
+
+def test_prompt_content_is_execution_order_independent():
+    """Prompt tokens are keyed by request INDEX, not arrival/execution
+    order — the property that lets autoscaler actions and batch
+    composition reorder execution without changing a single token."""
+    spec = ScenarioSpec.parse('seed=3;requests=16;qps=100')
+    sched = spec.schedule()
+    forward = [spec.prompt_for(r.index, r.prompt_len, CFG.vocab_size)
+               for r in sched]
+    backward = [spec.prompt_for(r.index, r.prompt_len, CFG.vocab_size)
+                for r in reversed(sched)]
+    for f, b in zip(forward, reversed(backward)):
+        assert (f == b).all()
+
+
+def test_shapes_produce_their_curves():
+    n = 90
+    flash = ScenarioSpec.parse(f'shape=flash;requests={n};qps=100;burst=10')
+    gaps = np.diff([r.t_offset for r in flash.schedule()])
+    third = n // 3
+    # the middle third arrives 10x faster than the edges
+    assert np.mean(gaps[third:2 * third - 1]) < np.mean(gaps[:third]) / 5
+    diurnal = ScenarioSpec.parse(f'shape=diurnal;requests={n};qps=100')
+    dgaps = np.diff([r.t_offset for r in diurnal.schedule()])
+    assert dgaps.max() > 2.5 * dgaps.min()        # trough vs peak
+    heavy = ScenarioSpec.parse(f'shape=heavy_tail;requests={n};qps=100;'
+                               'tail=1.05;max_prompt=32')
+    lens = [r.prompt_len for r in heavy.schedule()]
+    assert max(lens) == 32 and sorted(lens)[n // 2] < 16  # tail + mass
+    tenants = ScenarioSpec.parse(f'shape=tenants;requests={n};qps=100;'
+                                 'tenants=3')
+    assert [r.tenant for r in tenants.schedule()[:6]] == [0, 1, 2, 0, 1, 2]
+    assert 'steady' in SHAPES
+
+
+def test_abandonment_is_seeded_and_bounded():
+    spec = ScenarioSpec.parse('seed=5;requests=200;qps=1000;abandon=0.4;'
+                              'patience=0.01')
+    sched = spec.schedule()
+    quitters = [r for r in sched if r.abandon_after is not None]
+    assert 40 <= len(quitters) <= 120           # ~0.4 of 200, seeded
+    assert all(q.abandon_after > 0 for q in quitters)
+    assert [r.index for r in spec.schedule()
+            if r.abandon_after is not None] == [q.index for q in quitters]
+
+
+# --- the ledger ------------------------------------------------------------
+
+def test_ledger_total_and_reconcile_catch_drops():
+    led = ScenarioLedger()
+    led.note_submit()
+    led.note_submit()
+    led.note('served', latency=0.01, index=0, stream=[1, 2])
+    with pytest.raises(AssertionError, match='drop/double-count'):
+        led.reconcile()
+    led.note('rejected')
+    led.reconcile()                              # balanced now
+    assert led.total() == 2 and led.shed() == 1
+    s = led.summary()
+    assert s['submitted'] == 2 and s['served'] == 1 and s['p99_s'] > 0
+
+
+# --- live service: abandonment + reconciliation (satellite 1) --------------
+
+def test_sustained_abandonment_reconciles_exactly():
+    """The hardened slow-client path: under sustained abandonment every
+    request still lands in exactly one typed bucket, and the ledger
+    agrees with the service's single-owner counters bucket for bucket
+    (abandoned+served+shed == submitted, no drops, no double counts)."""
+    svc = _service()
+    try:
+        spec = ScenarioSpec.parse('shape=steady;seed=13;requests=40;'
+                                  'qps=400;abandon=0.5;patience=0.005;'
+                                  'max_prompt=10;max_new=6')
+        led = drive(svc, spec, vocab=CFG.vocab_size)
+        led.reconcile(svc.engine.stats)
+        s = led.summary()
+        assert s['submitted'] == 40
+        assert s['served'] + led.shed() + s['abandoned'] == 40
+        # the storm actually exercised the path under test
+        assert s['abandoned'] > 0, s
+        assert int(svc.engine.stats.get('abandoned')) == s['abandoned']
+    finally:
+        svc.close(30.0)
+
+
+def test_scenario_streams_twin_offline_generate():
+    """Bitwise stream twins under scenario traffic: every SERVED stream
+    equals the offline generate call for its (index-keyed) prompt."""
+    svc = _service(max_queue=32)
+    try:
+        # absorb the first-dispatch compile before pacing the storm —
+        # this test asserts the no-pressure outcome (every request
+        # served), so compile latency must not masquerade as overload
+        svc.generate(np.zeros((1, 2), np.int32), 2)
+        spec = ScenarioSpec.parse('shape=heavy_tail;seed=21;requests=12;'
+                                  'qps=200;tail=1.2;max_prompt=10;'
+                                  'max_new=6')
+        sched = spec.schedule()
+        base = ScenarioLedger.stat_snapshot(svc.engine.stats)
+        led = drive(svc, spec, vocab=CFG.vocab_size)
+        led.reconcile(svc.engine.stats, base=base)
+        assert led.counts['served'] == 12     # no pressure: all served
+        for rec in sched:
+            prompt = spec.prompt_for(rec.index, rec.prompt_len,
+                                     CFG.vocab_size)
+            off = _offline(svc.engine.params, prompt, rec.max_new)
+            got = np.asarray(led.streams[rec.index])
+            assert (got == off[:len(got)]).all(), rec.index
+    finally:
+        svc.close(30.0)
+
+
+# --- the autoscaler (satellite 3) ------------------------------------------
+
+class _FakeEngine:
+    slots, n_pages = 8, 33
+
+    def __init__(self):
+        self.calls = []
+
+    def live_limits(self):
+        return (2, 4)
+
+    def set_live_limits(self, max_slots=None, max_pages=None):
+        self.calls.append((max_slots, max_pages))
+        return (max_slots, max_pages)
+
+    def capacity_view(self):
+        return {'slots': self.slots}
+
+
+class _FakeBatcher:
+    max_queue = 16
+
+    def set_max_queue(self, n):
+        prev, self.max_queue = self.max_queue, int(n)
+        return prev
+
+
+def _scaler(verdict_box, policy='min_slots=1;min_pages=1;min_queue=2;'
+                               'max_queue=64;cooldown=0;hysteresis=2;'
+                               'step=2'):
+    pol = AutoscalePolicy.parse(policy)
+    sc = Autoscaler(pol, verdicts=lambda: {'o': {'state': verdict_box[0]}},
+                    gauges=lambda: {})
+    eng, bat = _FakeEngine(), _FakeBatcher()
+    sc.bind_engine(eng)
+    sc.bind_batcher(bat)
+    return sc, eng, bat
+
+
+def test_policy_parse_describe_roundtrip_and_validation():
+    pol = AutoscalePolicy.parse('min_slots=2;max_slots=16;cooldown=0.5;'
+                                'hysteresis=3;step=2;interval=0')
+    assert AutoscalePolicy.parse(pol.describe()) == pol
+    for bad in ('bogus=1', 'step=1.0', 'hysteresis=0', 'min_slots=0',
+                'min_pages=5;max_pages=2'):
+        with pytest.raises(ValueError):
+            AutoscalePolicy.parse(bad)
+
+
+def test_flapping_verdict_produces_zero_actions():
+    """Hysteresis: an OK<->AT_RISK flap at a burn-rate boundary never
+    accumulates enough same-direction agreement to act."""
+    box = ['OK']
+    sc, eng, bat = _scaler(box)
+    before = (dict(sc.knob_values()), bat.max_queue, list(eng.calls))
+    for i in range(50):
+        box[0] = 'AT_RISK' if i % 2 else 'OK'
+        assert sc.evaluate(now=float(i)) == []
+    assert sc.history() == []
+    assert (dict(sc.knob_values()), bat.max_queue,
+            list(eng.calls)) == before
+
+
+def test_sustained_pressure_grows_and_ok_reverts_to_baseline():
+    box = ['AT_RISK']
+    sc, eng, bat = _scaler(box)
+    base = dict(sc.knob_values())
+    for i in range(8):
+        sc.evaluate(now=float(i))
+    grown = sc.knob_values()
+    assert grown['slots'] == 8 and grown['pages'] == 32
+    assert bat.max_queue == grown['queue'] > base['queue']
+    box[0] = 'OK'
+    for i in range(8, 30):
+        sc.evaluate(now=float(i))
+    assert sc.knob_values() == base              # reversible, to baseline
+    assert bat.max_queue == base['queue']
+
+
+def test_cooldown_rate_limits_actions():
+    box = ['AT_RISK']
+    sc, eng, _ = _scaler(box, policy='cooldown=100;hysteresis=1;step=2')
+    sc.evaluate(now=0.0)
+    n = len(sc.history())
+    assert n > 0
+    for t in (1.0, 2.0, 50.0):                   # inside the cooldown
+        sc.evaluate(now=t)
+    assert len(sc.history()) == n
+    sc.evaluate(now=101.0)                       # past it
+    assert len(sc.history()) > n
+
+
+def test_breach_at_ceiling_degrades_explicitly_then_recovers():
+    box = ['BREACHED']
+    sc, eng, bat = _scaler(box)
+    for i in range(12):
+        sc.evaluate(now=float(i))
+    assert sc.degraded
+    assert bat.max_queue == 2                    # clamped to the floor
+    assert any(a['kind'] == 'degrade' for a in sc.history())
+    # degraded state holds under continued pressure (no re-open flap)
+    for i in range(12, 16):
+        sc.evaluate(now=float(i))
+    assert bat.max_queue == 2
+    box[0] = 'OK'
+    for i in range(16, 30):
+        sc.evaluate(now=float(i))
+    assert not sc.degraded and bat.max_queue == 16
+    assert any(a['kind'] == 'recover' for a in sc.history())
+
+
+def test_autoscaler_interval_thread_named_and_joined():
+    pol = AutoscalePolicy.parse('interval=0.01;hysteresis=2')
+    sc = Autoscaler(pol, verdicts=lambda: {}, gauges=lambda: {},
+                    name='t1')
+    try:
+        names = [t.name for t in threading.enumerate()]
+        assert 'cxxnet-scale-t1' in names
+        time.sleep(0.05)
+    finally:
+        sc.close()
+    assert 'cxxnet-scale-t1' not in [t.name for t in threading.enumerate()
+                                     if t.is_alive()]
+
+
+# --- live caps on the real engine (satellite 3) ----------------------------
+
+def test_live_cap_shrink_never_frees_referenced_prefix_page():
+    """Shrinking the live page cap under live refcounted prefix pages
+    is an ADMISSION change only: pages referenced by the index or an
+    in-flight stream stay exactly where they are (no page is ever both
+    free and referenced), streams stay bitwise twins, and a request
+    that no longer fits sheds typed instead of waiting forever."""
+    svc = _service(slots=2, pages=16, page_size=4, prefix_share=8)
+    eng = svc.engine
+    try:
+        shared = np.arange(8, dtype=np.int32)[None] % CFG.vocab_size
+        # populate the prefix index (first request publishes its pages)
+        first = svc.generate(shared, 4)
+        with eng._cond:
+            indexed = {e['page'] for e in eng._prefix.values()}
+            assert indexed, 'prefix index should hold pages'
+        # shrink the live cap to exactly what an aligned prefix-hit
+        # request needs; the physical pool is untouched
+        eng.set_live_limits(max_pages=4)
+        assert eng.live_limits()[1] == 4
+        with eng._cond:
+            free = set(eng._free_pages)
+            refs = {p for p in range(1, eng.n_pages)
+                    if eng._page_refs[p] > 0}
+            assert not (free & refs), 'a referenced page is on the free list'
+            assert indexed <= refs, 'shrink dropped an index reference'
+        # a too-big request sheds typed immediately (cap, not pool)
+        big = np.arange(14, dtype=np.int32)[None] % CFG.vocab_size
+        from cxxnet_tpu.runtime.faults import DecodeSlotsExhaustedError
+        with pytest.raises(DecodeSlotsExhaustedError, match='live page cap'):
+            svc.generate(big, 4)
+        # the prefix-sharing request still fits under the shrunk cap and
+        # its stream still equals the unshrunk twin
+        again = svc.generate(shared, 4)
+        assert (np.asarray(again) == np.asarray(first)).all()
+        with eng._cond:
+            free = set(eng._free_pages)
+            refs = {p for p in range(1, eng.n_pages)
+                    if eng._page_refs[p] > 0}
+            assert not (free & refs)
+        # restore: the clamp is reversible
+        eng.set_live_limits(max_pages=eng.n_pages - 1)
+        assert np.asarray(svc.generate(big, 4)).shape == (4,)
+    finally:
+        svc.close(30.0)
+
+
+def test_live_slot_cap_clamps_admission_not_inflight():
+    svc = _service(slots=4)
+    eng = svc.engine
+    try:
+        eng.set_live_limits(max_slots=1)
+        assert eng.live_limits()[0] == 1
+        cv = eng.capacity_view()
+        assert cv['live_slot_cap'] == 1 and cv['slots'] == 4
+        p = np.arange(6, dtype=np.int32)[None] % CFG.vocab_size
+        # serially the clamp is invisible: requests run one at a time
+        outs = [svc.generate(p, 4) for _ in range(3)]
+        assert all((np.asarray(o) == np.asarray(outs[0])).all()
+                   for o in outs)
+        # out-of-range clamps are pinned to [1, physical]
+        assert eng.set_live_limits(max_slots=99)[0] == 4
+        assert eng.set_live_limits(max_slots=0)[0] == 1
+    finally:
+        svc.close(30.0)
+
+
+def test_autoscaler_on_real_engine_under_flash_crowd():
+    """The composed loop: a flash-crowd scenario over a deliberately
+    tight engine, with the autoscaler fed a pressure verdict — caps
+    grow toward the physical ceiling while streams stay twins and the
+    ledger reconciles."""
+    svc = _service(slots=2, pages=16, max_queue=16)
+    eng = svc.engine
+    try:
+        eng.set_live_limits(max_slots=1, max_pages=4)
+        pol = AutoscalePolicy.parse('min_slots=1;min_pages=2;min_queue=2;'
+                                    'cooldown=0;hysteresis=2;step=2')
+        sc = Autoscaler(
+            pol,
+            verdicts=lambda: {'load': {'state': 'AT_RISK'}},
+            gauges=lambda: {})
+        sc.bind_engine(eng)
+        sc.bind_batcher(svc.batcher)
+        spec = ScenarioSpec.parse('shape=flash;seed=17;requests=20;'
+                                  'qps=300;burst=8;max_prompt=8;'
+                                  'max_new=4')
+        led = drive(svc, spec, vocab=CFG.vocab_size,
+                    on_tick=lambda _t: sc.evaluate())
+        led.reconcile(svc.engine.stats)
+        slots_cap, pages_cap = eng.live_limits()
+        assert slots_cap == 2 and pages_cap == 15   # grew to physical
+        assert led.counts['served'] > 0
+        for idx, stream in led.streams.items():
+            rec = spec.schedule()[idx]
+            prompt = spec.prompt_for(idx, rec.prompt_len, CFG.vocab_size)
+            off = _offline(eng.params, prompt, rec.max_new)
+            got = np.asarray(stream)
+            assert (got == off[:len(got)]).all(), idx
+    finally:
+        svc.close(30.0)
+
+
+# --- doc drift (satellite 2) -----------------------------------------------
+
+def _repo_doc(rel):
+    import os
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(here, 'doc', rel)) as f:
+        return f.read()
+
+
+def test_fault_grammar_table_matches_registered_kinds():
+    """doc/fault_tolerance.md's fault-grammar table and
+    FaultPlan.registered_kinds() cannot drift: every registered kind is
+    documented, every documented event is registered."""
+    from cxxnet_tpu.analysis.config_keys import backtick_key, doc_table_rows
+    text = _repo_doc('fault_tolerance.md')
+    rows = doc_table_rows(text, after='## Fault-injection harness')
+    documented = {backtick_key(r[0]) for r in rows
+                  if backtick_key(r[0]) is not None}
+    registered = set(FaultPlan.registered_kinds())
+    assert documented == registered, (
+        f'doc minus code: {sorted(documented - registered)}, '
+        f'code minus doc: {sorted(registered - documented)}')
+    assert 'slow_step' in registered
+
+
+def test_scenario_and_autoscale_tables_match_registered_keys():
+    from cxxnet_tpu.analysis.config_keys import backtick_key, doc_table_rows
+    text = _repo_doc('serving.md')
+    scen_heading = '### Scenario grammar'
+    auto_heading = '### Autoscale policy grammar'
+    assert scen_heading in text and auto_heading in text
+    auto_rows = doc_table_rows(text, after=auto_heading)
+    scen_all = doc_table_rows(text, after=scen_heading)
+    scen_rows = scen_all[:len(scen_all) - len(auto_rows)]
+
+    def keys(rows):
+        return {backtick_key(r[0]) for r in rows
+                if backtick_key(r[0]) is not None and r[0] != 'key'}
+
+    assert keys(scen_rows) == set(ScenarioSpec.registered_keys()), (
+        keys(scen_rows) ^ set(ScenarioSpec.registered_keys()))
+    assert keys(auto_rows) == set(AutoscalePolicy.registered_keys()), (
+        keys(auto_rows) ^ set(AutoscalePolicy.registered_keys()))
+
+
+def test_new_cli_keys_are_documented():
+    """serve.scenario / serve.autoscale ride the config-key-drift lint's
+    contract: parsed in main.py, backticked in a DOC_FILE."""
+    from cxxnet_tpu.analysis.config_keys import doc_keys
+    documented = doc_keys(_repo_doc('tasks.md'))
+    assert {'serve.scenario', 'serve.autoscale'} <= documented
+
+
+# --- the composed chaos drill ----------------------------------------------
+
+def test_chaos_flash_crowd_with_slow_step_faultplan():
+    """The ISSUE's composed drill, test-sized: a slow_step@every
+    FaultPlan (deterministic compute stalls between token boundaries)
+    composed with a flash-crowd scenario in ONE run — zero twin
+    violations, every non-served outcome typed."""
+    from cxxnet_tpu.runtime import faults
+    plan = FaultPlan.parse('seed=1;slow_step@every=3:0.002')
+    svc = _service(slots=2, pages=16)
+    prev = faults.install_plan(plan)
+    try:
+        spec = ScenarioSpec.parse('shape=flash;seed=29;requests=16;'
+                                  'qps=300;burst=6;max_prompt=8;'
+                                  'max_new=4')
+        led = drive(svc, spec, vocab=CFG.vocab_size)
+        faults.install_plan(prev)
+        led.reconcile(svc.engine.stats)
+        assert any(tag.startswith('slow_step@every=')
+                   for tag in plan.fired()), plan.fired()
+        assert led.counts['served'] > 0
+        # zero twin violations under the composed storm
+        for idx, stream in led.streams.items():
+            rec = spec.schedule()[idx]
+            prompt = spec.prompt_for(idx, rec.prompt_len, CFG.vocab_size)
+            off = _offline(svc.engine.params, prompt, rec.max_new)
+            got = np.asarray(stream)
+            assert (got == off[:len(got)]).all(), idx
+        # only typed outcomes: the ledger has no untyped bucket at all,
+        # and reconcile already proved nothing fell outside it
+        assert led.total() == led.summary()['submitted'] == 16
+    finally:
+        faults.install_plan(prev)
+        svc.close(30.0)
+
+
+def test_fault_plan_slow_step_parse_describe_roundtrip():
+    plan = FaultPlan.parse('seed=4;slow_step=2:0.01;slow_step@every=5:0.02')
+    desc = plan.describe()
+    assert 'slow_step=2:0.01' in desc and 'slow_step@every=5:0.02' in desc
+    plan2 = FaultPlan.parse(desc)
+    assert plan2.describe() == desc

@@ -305,7 +305,8 @@ def test_batcher_deadline_typed_error_counted_once():
         gate.set()
         b.wait(blocker)
         # drain the abandoned request off the queue, then verify the shed
-        # was counted ONCE (client side) and its forward never executed
+        # was counted ONCE (by the worker's drop path — the single owner
+        # of terminal counts) and its forward never executed
         assert b.submit(np.zeros((3, 4), np.float32)).shape == (3, 1)
         assert b.stats.get('expired') == 1
         assert eng.batches == [1, 3]           # the doomed 2 rows: never run

@@ -25,6 +25,14 @@ on, so the guard enforces the rules the bench modes promise
   (``*ms``) rise beyond ``--tolerance`` (default 30%) is flagged.
   Flags are warnings (exit 0) unless ``--strict`` — cross-round
   hardware may legitimately differ; the stamp says so.
+* **scenario receipts** — a ``BENCH_SCENARIOS_*`` receipt
+  (``scenario_autoscale_wins``) is an A/B claim, so its structure is
+  validated: at least four scenarios, each with a static AND an
+  autoscale leg whose every served stream was twin-checked in-bench,
+  the win count consistent with the per-scenario verdicts and at
+  least 3, and a composed chaos leg with zero twin violations and
+  zero untyped sheds.  Per-leg ``p99_ms``/``loss`` are expanded into
+  synthetic payloads so cross-round regression flags cover them.
 
 Exit codes: ``0`` clean (or warnings only), ``1`` validation failure
 (or flagged regressions under ``--strict``), ``2`` internal error.
@@ -77,6 +85,66 @@ def payloads(doc) -> List[dict]:
     return payloads(parsed) if parsed is not None else []
 
 
+SCENARIO_METRIC = 'scenario_autoscale_wins'
+
+#: a scenario receipt must show the autoscaler beating the static
+#: baseline on at least this many scenarios — the claim it exists for
+SCENARIO_MIN_WINS = 3
+
+
+def expand_scenarios(p: dict, name: str) -> Tuple[List[str], List[dict]]:
+    """Validate one ``scenario_autoscale_wins`` payload and expand its
+    per-scenario legs into synthetic payloads for regression flags."""
+    errs: List[str] = []
+    synth: List[dict] = []
+    plat = p.get('platform')
+    rows = p.get('scenarios')
+    if not isinstance(rows, list) or len(rows) < 4:
+        return [f'{name}: scenario receipt carries '
+                f'{len(rows) if isinstance(rows, list) else 0} '
+                'scenarios (need >= 4)'], []
+    wins = 0
+    for row in rows:
+        rname = row.get('name', '?')
+        for leg_name in ('static', 'autoscale'):
+            leg = row.get(leg_name)
+            if not isinstance(leg, dict):
+                errs.append(f'{name}: scenario {rname!r} has no '
+                            f'{leg_name!r} leg')
+                continue
+            if leg.get('twin_checked') != leg.get('served'):
+                errs.append(
+                    f'{name}: scenario {rname!r} {leg_name} leg '
+                    f'twin-checked {leg.get("twin_checked")} of '
+                    f'{leg.get("served")} served streams — every '
+                    'served stream must be twin-asserted in-bench')
+            for key, unit in (('p99_ms', 'ms'), ('loss', 'requests')):
+                synth.append({
+                    'metric': f'scenario_{rname}_{leg_name}_{key}',
+                    'value': leg.get(key), 'unit': unit,
+                    'platform': plat})
+        wins += bool(row.get('win'))
+    if wins != p.get('value'):
+        errs.append(f'{name}: win count {p.get("value")} disagrees '
+                    f'with per-scenario verdicts ({wins})')
+    if wins < SCENARIO_MIN_WINS:
+        errs.append(f'{name}: autoscale beat static on only {wins} '
+                    f'scenarios (need >= {SCENARIO_MIN_WINS})')
+    chaos = p.get('chaos')
+    if not isinstance(chaos, dict):
+        errs.append(f'{name}: scenario receipt has no composed chaos '
+                    'leg')
+    else:
+        for key in ('twin_violations', 'untyped_sheds'):
+            if chaos.get(key) != 0:
+                errs.append(f'{name}: chaos leg {key}='
+                            f'{chaos.get(key)} (must be 0)')
+        if not chaos.get('slow_steps_fired'):
+            errs.append(f'{name}: chaos leg fired no faults — it is '
+                        'not a chaos leg')
+    return errs, synth
+
+
 def check_file(path: str) -> Tuple[List[str], List[dict]]:
     """(errors, payloads) for one receipt file."""
     name = os.path.basename(path)
@@ -86,6 +154,7 @@ def check_file(path: str) -> Tuple[List[str], List[dict]]:
         return [f'{name}: invalid strict JSON: {e}'], []
     errs = []
     loads = payloads(doc)
+    extra: List[dict] = []               # synthetic, never re-scanned
     for p in loads:
         if p.get('value') is None:
             continue                     # unmeasured/error payload
@@ -93,7 +162,11 @@ def check_file(path: str) -> Tuple[List[str], List[dict]]:
             errs.append(
                 f'{name}: measured payload {p.get("metric")!r} carries '
                 'no "platform" stamp (tpu / cpu-fallback / ...)')
-    return errs, loads
+        if p.get('metric') == SCENARIO_METRIC:
+            s_errs, synth = expand_scenarios(p, name)
+            errs.extend(s_errs)
+            extra.extend(synth)
+    return errs, loads + extra
 
 
 def _direction(unit: Optional[str], metric: str) -> int:
@@ -104,6 +177,8 @@ def _direction(unit: Optional[str], metric: str) -> int:
         return 1
     if u == 'ms' or metric.endswith('_ms') or '_ms_' in metric:
         return -1
+    if metric.endswith(('_loss', '_shed')):
+        return -1                        # lost/shed requests: fewer wins
     return 0
 
 
