@@ -294,8 +294,10 @@ def lm_serve_start(cfg: str):
     ``max_new``/``eos``, batcher knobs ``max_queue``/``max_wait``/
     ``deadline``, serving tier ``dtype`` (``f32``/``bf16``/``int8``),
     attention leg ``flash_decode`` (``auto``/``0``/``1``), prefix
-    sharing ``prefix_share`` (index page cap, 0 = off), and greedy
-    speculative decoding ``spec_k`` + ``draft.*`` draft-model keys.
+    sharing ``prefix_share`` (index page cap, 0 = off), greedy
+    speculative decoding ``spec_k`` + ``draft.*`` draft-model keys, and
+    the graftcache KV tiers ``kv_host_mb``/``kv_disk_mb``/``kv_dir``/
+    ``kv_share_dir`` (doc/serving.md "Tiered KV cache").
     Returns the service handle the other ``lm_serve_*`` calls take."""
     from .wrapper import LMServe
     return LMServe.from_spec(cfg)

@@ -93,6 +93,12 @@ class LearnTask:
         self.serve_dtype = 'f32'       # serve.dtype: f32 | bf16 | int8
         self.serve_flash = 'auto'      # serve.flash_decode: auto | 0 | 1
         self.serve_prefix_share = 0    # serve.prefix_share index pages (0=off)
+        # graftcache: tiered KV prefix cache (doc/serving.md "Tiered KV
+        # cache"); tiers need serve.prefix_share > 0
+        self.serve_kv_host_mb = 0      # serve.kv_host_mb tier-1 RAM (0=off)
+        self.serve_kv_disk_mb = 0      # serve.kv_disk_mb tier-2 disk (0=off)
+        self.serve_kv_dir = ''         # serve.kv_dir tier-2 record dir
+        self.serve_kv_share_dir = ''   # serve.kv_share_dir cross-replica
         self.serve_spec_k = 0          # serve.spec_k window width (0/1=off)
         self.serve_draft = ''          # serve.draft spec (k=v;... like serve.lm)
         # graftstorm: adversarial traffic + SLO-driven autoscaling
@@ -193,6 +199,10 @@ class LearnTask:
             'serve.dtype': ('serve_dtype', str),
             'serve.flash_decode': ('serve_flash', str),
             'serve.prefix_share': ('serve_prefix_share', int),
+            'serve.kv_host_mb': ('serve_kv_host_mb', int),
+            'serve.kv_disk_mb': ('serve_kv_disk_mb', int),
+            'serve.kv_dir': ('serve_kv_dir', str),
+            'serve.kv_share_dir': ('serve_kv_share_dir', str),
             'serve.spec_k': ('serve_spec_k', int),
             'serve.draft': ('serve_draft', str),
             'serve.scenario': ('serve_scenario', str),
@@ -1177,7 +1187,11 @@ class LearnTask:
             deadline=max(self.serve_deadline, 60.0),
             dtype=self.serve_dtype, flash_decode=self.serve_flash,
             prefix_share=self.serve_prefix_share,
-            spec_k=self.serve_spec_k, draft=draft)
+            spec_k=self.serve_spec_k, draft=draft,
+            kv_host_mb=self.serve_kv_host_mb,
+            kv_disk_mb=self.serve_kv_disk_mb,
+            kv_dir=self.serve_kv_dir or None,
+            kv_share_dir=self.serve_kv_share_dir or None)
         from .obs import get_hub
         # ONE StatSet backs both the engine and the batcher
         # (DecodeService shares it), so this single registration carries
@@ -1185,6 +1199,13 @@ class LearnTask:
         # gen-cache/acceptance gauges before each /metrics render
         get_hub().register_stats('decode', svc.engine.stats,
                                  refresh=lambda: svc.report('decode'))
+        if svc.engine.kv_stats is not None:
+            # graftcache tier gauges ride the hub under their own set so
+            # slo.kv_hit=kv.hit_rate>=0.5@60-style specs resolve; the
+            # refresh folds tier occupancy right before each render
+            get_hub().register_stats(
+                'kv', svc.engine.kv_stats,
+                refresh=svc.engine.kv_occupancy)
         if not self.silent:
             print(f'serve: decode engine up — {self.serve_slots} slots, '
                   f'{self.serve_pages}x{self.serve_page_size}-token KV '

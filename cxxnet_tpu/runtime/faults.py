@@ -245,6 +245,41 @@ class PrefixIndexFullError(ServeError):
             f'(serve.prefix_share cap is {cap}): request served unshared')
 
 
+class KVTierError(ServeError):
+    """Base of the tiered-KV-cache failure family (``serve/kvcache.py``,
+    doc/serving.md "Tiered KV cache").  Every member is an
+    *availability* outcome, never a correctness one: a tier that cannot
+    deliver its rows reports a miss and the request re-prefills — the
+    bitwise stream-twin contract holds through every tier failure."""
+
+
+class KVCorruptRecordError(KVTierError):
+    """A tier-2 spill record failed digest verification, or its decoded
+    header does not carry the exact key it was fetched for.  The store
+    quarantines the record (renamed aside, never re-read) and reports a
+    miss, so a poisoned record can never reach a stream — the same
+    digest discipline the model registry applies to checkpoints."""
+
+    def __init__(self, path: str, reason: str):
+        self.path = str(path)
+        self.reason = str(reason)
+        super().__init__(
+            f'corrupt KV spill record {path}: {reason} — quarantined, '
+            'serving falls back to re-prefill')
+
+
+class KVSpillError(KVTierError):
+    """A tier-2 spill write failed terminally (out of disk, permission
+    loss).  The entry is dropped — a cache never owes durability — and
+    the failure is recorded so operators see the disk going bad before
+    the hit rate quietly does."""
+
+    def __init__(self, path: str, error: BaseException):
+        self.path = str(path)
+        super().__init__(f'KV spill to {path} failed: {error!r} — '
+                         'entry dropped')
+
+
 class SLOBreachError(RuntimeError):
     """A declarative SLO (``slo.<name>=`` config grammar, evaluated by
     the ``obs.slo`` engine; doc/observability.md "SLOs and burn rates")
@@ -586,6 +621,12 @@ class FaultPlan:
       (default 30): a deterministic network partition.  Outliving the
       coordinator's heartbeat timeout makes the worker a declared host
       loss; a short blip just stalls the step.
+    * ``corrupt_kv=N`` — after the N-th tiered-KV spill record *commits*
+      (record bytes + crc32 sidecar both on disk, fired on the staged
+      file BEFORE the rename makes it visible), the record is truncated
+      so the store's digest verification must quarantine it and the
+      request must fall back to a re-prefill — never a crash, never a
+      non-twin stream (doc/serving.md "Tiered KV cache").
     * ``slow_step=N[:secs]`` — the N-th decode engine loop iteration
       (1-based, counted across the process) sleeps ``secs`` (default
       0.05) before stepping: deterministic serve-path latency injection.
@@ -629,7 +670,9 @@ class FaultPlan:
                                         ...] = (),
                  slow_step: Tuple[Tuple[int, Optional[float]], ...] = (),
                  slow_step_every: Tuple[Tuple[int, Optional[float]],
-                                        ...] = ()):
+                                        ...] = (),
+                 corrupt_kv: Tuple[int, ...] = (),
+                 corrupt_kv_every: Tuple[int, ...] = ()):
         def _periods(vals):
             out = set()
             for k in vals:
@@ -670,6 +713,8 @@ class FaultPlan:
                            for n, s in slow_step}
         self._slow_step_every = {int(k): (0.05 if s is None else s)
                                  for k, s in slow_step_every}
+        self._corrupt_kv = set(corrupt_kv)
+        self._corrupt_kv_every = _periods(corrupt_kv_every)
         if 0 in self._host_loss_every or 0 in self._partition_every:
             raise ValueError('@every period must be > 0')
         if 0 in self._stall_every or 0 in self._stall_write_every:
@@ -686,6 +731,7 @@ class FaultPlan:
         self._write_count = 0
         self._model_count = 0
         self._decode_count = 0
+        self._kv_count = 0
         self._fired: List[str] = []
         self._lock = threading.Lock()
 
@@ -694,7 +740,7 @@ class FaultPlan:
     #: table is drift-tested against :meth:`registered_kinds`
     KINDS = ('raise_on_write', 'stall_batch', 'stall_write',
              'corrupt_shard', 'nan_at_step', 'corrupt_model',
-             'host_loss', 'partition', 'slow_step')
+             'host_loss', 'partition', 'slow_step', 'corrupt_kv')
 
     @classmethod
     def registered_kinds(cls) -> Tuple[str, ...]:
@@ -768,6 +814,9 @@ class FaultPlan:
                   for n, s in sorted(self._slow_step.items())]
         parts += [f'slow_step@every={k}:{s:g}'
                   for k, s in sorted(self._slow_step_every.items())]
+        parts += [f'corrupt_kv={n}' for n in sorted(self._corrupt_kv)]
+        parts += [f'corrupt_kv@every={k}'
+                  for k in sorted(self._corrupt_kv_every)]
         return ';'.join(parts)
 
     @staticmethod
@@ -962,6 +1011,34 @@ class FaultPlan:
         else:
             os.unlink(path)
 
+    def on_kv_record_committed(self, path: str) -> None:
+        """After the N-th tiered-KV spill record commit (record + crc32
+        sidecar both durable; fired on the STAGED file, before the
+        rename), truncate the record: digest verification must
+        quarantine it on the next promote and the request must fall
+        back to a re-prefill (doc/serving.md "Tiered KV cache")."""
+        with self._lock:
+            self._kv_count += 1
+            n = self._kv_count
+            hit = n in self._corrupt_kv
+            if hit:
+                self._corrupt_kv.discard(n)
+                self._fired.append(f'corrupt_kv={n}')
+            else:
+                k = self._periodic_hit(n, self._corrupt_kv_every)
+                if k is not None:
+                    hit = True
+                    self._fired.append(f'corrupt_kv@every={k}#{n}')
+        if not hit:
+            return
+        import os
+        size = os.path.getsize(path)
+        if size > 1:
+            with open(path, 'r+b') as f:
+                f.truncate(size // 2)
+        else:
+            os.unlink(path)
+
     def on_shard_committed(self, step: int, path: str) -> None:
         """Truncate one payload file of a just-committed sharded
         checkpoint (seeded pick) so restore-time verification must
@@ -1068,3 +1145,13 @@ def model_committed(path: str, staged: Optional[str] = None) -> None:
     plan = _ACTIVE
     if plan is not None:
         plan.on_model_committed(path if staged is None else staged)
+
+
+def kv_record_committed(path: str, staged: Optional[str] = None) -> None:
+    """Call when a tiered-KV spill record's bytes + digest sidecar are
+    both durable; ``staged=`` is the pre-rename temp file, so injected
+    corruption (``corrupt_kv=N``) lands BEFORE the record is visible
+    and digest verification catches it deterministically on promote."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.on_kv_record_committed(path if staged is None else staged)

@@ -20,6 +20,12 @@ sit behind heavy live traffic.
 * :class:`~cxxnet_tpu.serve.registry.MultiModelRegistry` — N models on
   one chip under a :class:`~cxxnet_tpu.serve.registry.MemoryBudgeter`
   (evict-cold, never the serving model; per-model reload machinery),
+* :class:`~cxxnet_tpu.serve.kvcache.TieredKVCache` — graftcache: the
+  tiered KV prefix cache (HBM page pool → bounded host RAM →
+  crc32-digested disk records) behind the prefix index; evictions
+  demote instead of dropping, later hits promote back without a
+  re-prefill, and ``serve.kv_share_dir`` lets N replicas adopt each
+  other's tier-2 records,
 * :mod:`~cxxnet_tpu.serve.scenario` — graftstorm: seeded, replayable
   adversarial traffic scenarios (``serve.scenario=``) with an exactly
   reconciling :class:`~cxxnet_tpu.serve.scenario.ScenarioLedger`,
@@ -37,7 +43,8 @@ from ..runtime.faults import (AutoscaleDegradedError, AutoscaleError,
                               DeadlineExceededError,
                               DecodePagesExhaustedError,
                               DecodeSlotsExhaustedError,
-                              MemoryBudgetExceededError,
+                              KVCorruptRecordError, KVSpillError,
+                              KVTierError, MemoryBudgetExceededError,
                               RequestAbandonedError, ServeError,
                               ServeOverloadError, TokenDeadlineExceededError)
 from .autoscale import AutoscalePolicy, Autoscaler
@@ -45,6 +52,8 @@ from .batcher import DynamicBatcher, ServeRequest
 from .decode import (DecodeEngine, DecodeService, lm_loader,
                      load_lm_params, save_lm_params)
 from .engine import PredictEngine
+from .kvcache import TieredKVCache
+from .kvstore import KVStore
 from .registry import (MemoryBudgeter, ModelRegistry, MultiModelRegistry,
                        load_model_params)
 from .scenario import (ScenarioLedger, ScenarioRequest, ScenarioSpec,
@@ -60,4 +69,5 @@ __all__ = ['PredictEngine', 'DynamicBatcher', 'ServeRequest',
            'TokenDeadlineExceededError', 'DecodeSlotsExhaustedError',
            'DecodePagesExhaustedError', 'MemoryBudgetExceededError',
            'RequestAbandonedError', 'AutoscaleError',
-           'AutoscaleDegradedError']
+           'AutoscaleDegradedError', 'TieredKVCache', 'KVStore',
+           'KVTierError', 'KVCorruptRecordError', 'KVSpillError']

@@ -75,7 +75,7 @@ import dataclasses
 import os
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -151,6 +151,13 @@ class DecodeEngine:
     ``flash_decode`` (``serve.flash_decode``) picks the attention leg:
     ``1``/``0`` force the Pallas paged flash-decode kernel / the dense
     gather; ``'auto'``/None defer to ``pallas_mode()``.
+    ``kv_host_mb``/``kv_disk_mb``/``kv_dir``/``kv_share_dir``
+    (``serve.kv_*``) attach the graftcache tier hierarchy behind the
+    prefix index: evicted index entries demote host → disk instead of
+    dropping, later probes promote them back without a re-prefill, and
+    a share directory lets N replicas adopt each other's tier-2
+    records (doc/serving.md "Tiered KV cache"); requires
+    ``prefix_share > 0``.
 
     Requests arrive through :meth:`execute_requests` (the
     ``DynamicBatcher`` hands over each coalesced batch — the engine owns
@@ -168,7 +175,10 @@ class DecodeEngine:
                  max_new_bound: int = 64, eos_id: Optional[int] = None,
                  stats: Optional[StatSet] = None, name: str = 'lm',
                  dtype: str = 'f32', flash_decode=None,
-                 prefix_share: int = 0, spec_k: int = 0, draft=None):
+                 prefix_share: int = 0, spec_k: int = 0, draft=None,
+                 kv_host_mb: int = 0, kv_disk_mb: int = 0,
+                 kv_dir: Optional[str] = None,
+                 kv_share_dir: Optional[str] = None):
         if not cfg.causal:
             raise ValueError('DecodeEngine requires a causal config')
         if slots < 1 or pages < 2 or page_size < 1:
@@ -180,6 +190,18 @@ class DecodeEngine:
         if spec_k < 0 or (spec_k >= 2 and draft is None):
             raise ValueError('spec_k >= 2 needs a draft model '
                              '(draft=(params, cfg)); spec_k must be >= 0')
+        if kv_host_mb < 0 or kv_disk_mb < 0:
+            raise ValueError('kv_host_mb / kv_disk_mb must be >= 0')
+        if (kv_host_mb or kv_disk_mb) and prefix_share <= 0:
+            raise ValueError('the tiered KV cache sits behind the '
+                             'prefix index: serve.kv_host_mb/kv_disk_mb '
+                             'need serve.prefix_share > 0')
+        if kv_disk_mb > 0 and not kv_dir:
+            raise ValueError('serve.kv_disk_mb > 0 needs serve.kv_dir= '
+                             '(the tier-2 record directory)')
+        if kv_share_dir and kv_disk_mb <= 0:
+            raise ValueError('serve.kv_share_dir shares tier-2 records: '
+                             'it needs serve.kv_disk_mb > 0')
         # quantized tier (serve.dtype): bf16/int8 serve with a bfloat16
         # compute config — params, KV pool and block math all follow
         # cfg.dtype, so the offline twin is generate(engine.params,
@@ -227,6 +249,31 @@ class DecodeEngine:
         self._prefix_cap = int(prefix_share)
         self._prefix: collections.OrderedDict = (
             collections.OrderedDict())             # guarded-by: _cond
+        # graftcache (serve/kvcache.py): host/disk tiers BEHIND the
+        # index — eviction demotes host mirrors down-tier, a later probe
+        # promotes them back into a freshly allocated physical page.
+        # The cache owns its own `kv` StatSet (hub-registered by the
+        # CLI) and its own internal lock; this engine only ever calls
+        # it while holding _cond (demote/take) or with no lock at all
+        # (prefetch) — lock order _cond -> kvcache._lock, never back.
+        self._kv = None
+        self.kv_stats: Optional[StatSet] = None
+        if kv_host_mb > 0 or kv_disk_mb > 0:
+            from .kvcache import KVStore, TieredKVCache
+            self.kv_stats = StatSet()
+            kv_store = None
+            if kv_disk_mb > 0:
+                kv_store = KVStore(kv_dir, kv_disk_mb * (1 << 20),
+                                   share_dir=kv_share_dir,
+                                   stats=self.kv_stats, name=name)
+            self._kv = TieredKVCache(host_bytes=kv_host_mb * (1 << 20),
+                                     store=kv_store, stats=self.kv_stats)
+        # tier-promoted rows awaiting their device upload: (physical
+        # page, host K rows, host V rows), each holding its own page
+        # reference until the decode loop writes the rows at the next
+        # token boundary — a promoting page is never an eviction victim
+        self._pending_uploads: collections.deque = (
+            collections.deque())                   # guarded-by: _cond
         self._table = np.zeros((self.slots, self.pages_per_slot),
                                np.int32)           # guarded-by: _cond
         self._slots: List[Optional[_Slot]] = (
@@ -662,12 +709,19 @@ class DecodeEngine:
             return 0, [], [], []
         return len(pages), pages, hks, hvs
 
-    def _prefix_evict_one(self) -> bool:  # requires-lock: _cond
+    def _prefix_evict_one(self, demote: bool = True) -> bool:  # requires-lock: _cond
         """Drop the LRU index entry; frees its page when the index held
-        the last reference."""
+        the last reference.  With a tiered cache attached the entry's
+        host mirrors DEMOTE down-tier instead of dropping (memory-moves
+        only — spill I/O happens on the store's worker thread, never
+        under this lock).  ``demote=False`` on param swaps: the rows
+        are the old model's activations and their keys carry the old
+        version — caching them would be pure waste."""
         if not self._prefix:
             return False
-        _key, ent = self._prefix.popitem(last=False)
+        key, ent = self._prefix.popitem(last=False)
+        if demote and self._kv is not None and key[0] == self.version:
+            self._kv.demote(key, ent['hk'], ent['hv'])
         self._release_pages([ent['page']])
         return True
 
@@ -722,6 +776,8 @@ class DecodeEngine:
             if ent['page'] in exclude:
                 continue
             if self._page_refs[ent['page']] == 1:
+                if self._kv is not None and key[0] == self.version:
+                    self._kv.demote(key, ent['hk'], ent['hv'])
                 del self._prefix[key]
                 self._release_pages([ent['page']])
                 freed += 1
@@ -730,9 +786,64 @@ class DecodeEngine:
 
     def _clear_prefix_index(self) -> None:  # requires-lock: _cond
         """Release every index reference (param swaps: cached rows are
-        the OLD model's activations — stale keys would leak pages)."""
+        the OLD model's activations — stale keys would leak pages).
+        Never demotes: the tiers must not inherit a dead version's rows
+        (old-version entries already down-tier can never alias — the
+        version is part of every key and every record header)."""
         while self._prefix:
-            self._prefix_evict_one()
+            self._prefix_evict_one(demote=False)
+
+    def _promote_splice(self, padded, w, s0b, n_hit,  # requires-lock: _cond
+                        pages, hks, hvs) -> int:
+        """Extend the index hit chain with tier-promoted pages: for
+        each consecutive full page past ``n_hit`` whose rows tier 1
+        holds (prefetched from disk OUTSIDE this lock), take the rows,
+        re-publish the key against the freshly allocated physical page
+        ``pages[lp]``, and queue the device upload for the decode loop
+        (which scatters it at the next token boundary, BEFORE any join
+        splices a table row at it).  Promoted pages join ``hks/hvs`` so
+        the tail prefill attends over them exactly as over index hits —
+        the promoted rows ARE the original prefill rows, so streams
+        stay bitwise twins.  Returns the new ``n_hit``; memory-moves
+        only, safe under the lock."""
+        ps = self.page_size
+        max_hit = (s0b - 1) // ps
+        if n_hit >= max_hit:
+            return n_hit
+        keys = self._prefix_keys(padded, w, max_hit)
+        taken = []
+        for lp in range(n_hit, max_hit):
+            ent = self._kv.take(keys[lp])
+            if ent is None:
+                break
+            taken.append((keys[lp], ent))
+        if not taken:
+            return n_hit
+        if (n_hit + len(taken)) * ps < w:
+            # the probe's pad-coverage rule: hits must span every pad
+            # slot or the tail prefill would see pad queries — put the
+            # rows back rather than serve a chain we cannot splice
+            for key, (hk, hv) in taken:
+                self._kv.put_back(key, hk, hv)
+            return n_hit
+        for i, (key, (hk, hv)) in enumerate(taken):
+            page = int(pages[n_hit + i])
+            while len(self._prefix) >= self._prefix_cap:
+                if not self._prefix_evict_one():
+                    break
+            if len(self._prefix) < self._prefix_cap:
+                # the index's own reference, exactly as publish takes
+                self._page_refs[page] += 1
+                self._prefix[key] = {'page': page, 'hk': hk, 'hv': hv}
+                self.stats.inc('prefix_published')
+            # the pending upload's reference: until the rows land, the
+            # page can be neither reclaimed nor reallocated
+            self._page_refs[page] += 1
+            self._pending_uploads.append((page, hk, hv))
+            hks.append(hk)
+            hvs.append(hv)
+            self.stats.inc('kv_promoted_pages')
+        return n_hit + len(taken)
 
     # -- page accounting (requires-lock helpers) ---------------------------
     def _alloc_pages(self, n: int) -> List[int]:  # requires-lock: _cond
@@ -780,7 +891,12 @@ class DecodeEngine:
         The paged KV pool is ONE allocation counted ONCE — prefix
         sharing multiplies page-table references, never this number
         (pinned by a regression test: two slots sharing a prefix report
-        the same footprint as one)."""
+        the same footprint as one).  The tiered cache's host/disk bytes
+        are deliberately EXCLUDED: they are not device memory, and
+        folding them in would double-count tiers against the
+        ``hbm.headroom_frac`` / ``budget_drift()`` cross-check (their
+        occupancy reports through the ``kv.*`` gauges instead; pinned
+        by a kv_tier regression test)."""
         with self._cond:
             params = self._params
             draft = self._draft_params
@@ -791,6 +907,18 @@ class DecodeEngine:
         if draft is not None:
             total += sum(l.nbytes for l in jax.tree.leaves(draft))
         return int(total)
+
+    def kv_occupancy(self) -> Optional[Tuple[int, int]]:
+        """``(host_bytes, disk_bytes)`` held by the tiered cache, or
+        None when no tiers are attached — the fleet-report surface.
+        Deliberately separate from :meth:`resident_bytes`: tier bytes
+        are host/disk, never HBM, and must not feed the budgeter."""
+        if self._kv is None:
+            return None
+        self._kv.refresh_gauges()
+        store = self._kv.store
+        return (self._kv.host_bytes(),
+                0 if store is None else store.disk_bytes())
 
     def busy(self) -> bool:
         with self._cond:
@@ -912,6 +1040,16 @@ class DecodeEngine:
         n0 = (s0b // self.page_size + 1) if max_new >= 2 else n_prompt
         ps = self.page_size
         padded = np.pad(prompt, ((0, 0), (w, 0)))
+        if self._kv is not None and (s0b - 1) // ps > 0:
+            # tier-2 promote prefetch: disk records rise into the host
+            # tier HERE, on the admit thread with NO engine lock held —
+            # the reserve loop's take() below is then memory-only.  The
+            # reads are ThreadBuffer-double-buffered in the cache.
+            with self._cond:
+                want = [k for k in
+                        self._prefix_keys(padded, w, (s0b - 1) // ps)
+                        if k not in self._prefix]
+            self._kv.prefetch(want)
         # --- reserve capacity (blocks; bounded by the request deadline)
         with self._cond:
             while True:
@@ -962,6 +1100,10 @@ class DecodeEngine:
             for p in hit_pages:                    # splice shared pages
                 self._page_refs[p] += 1
             pages = list(hit_pages) + self._alloc_pages(need)
+            if self._kv is not None:
+                n_hit = self._promote_splice(padded, w, s0b, n_hit,
+                                             pages, hks, hvs)
+                self.kv_stats.inc('hits' if n_hit else 'misses')
             if n_hit:
                 self.stats.inc('prefix_hits')
                 self.stats.inc('prefix_hit_pages', n_hit)
@@ -1092,7 +1234,30 @@ class DecodeEngine:
         """Token boundary: splice every admitted request into its slot
         (caller holds the lock; pool writes release it per join).  A
         prefix-hit join splices the SHARED physical pages and writes
-        only its freshly prefilled tail rows."""
+        only its freshly prefilled tail rows.  Tier-promoted pages
+        upload FIRST: a promote enqueues its upload strictly before the
+        promoted request's join is appended, so draining uploads ahead
+        of joins guarantees every promoted page's rows are in the pool
+        before any table row can reference it (the decode loop owns the
+        device pools — this is the only thread that writes them)."""
+        if self._pending_uploads:
+            # one scatter for the whole backlog: a promote lands a whole
+            # prefix of pages at once, and per-page uploads would pay a
+            # dispatch each — batching matches the join path's
+            # one-call-per-splice idiom
+            batch = list(self._pending_uploads)
+            self._pending_uploads.clear()
+            ps = self.page_size
+            pages = np.asarray([b[0] for b in batch], np.int32)
+            hk = np.concatenate([b[1] for b in batch], axis=1)
+            hv = np.concatenate([b[2] for b in batch], axis=1)
+            wfn = self._write_fn(len(batch), len(batch) * ps)
+            self._kpool, self._vpool = wfn(
+                self._kpool, self._vpool, hk[:, None], hv[:, None],
+                pages)
+            # the uploads' own references (taken at promote) retire
+            self._release_pages(pages.tolist())
+            self.stats.inc('kv_uploads')
         while self._joinq:
             j = self._joinq.popleft()
             sid = j['sid']
@@ -1372,7 +1537,10 @@ class DecodeEngine:
         if threading.current_thread() is self._loop:
             return False
         self._loop.join(timeout)
-        return not self._loop.is_alive()
+        ok = not self._loop.is_alive()
+        if self._kv is not None:
+            ok = self._kv.close(timeout) and ok
+        return ok
 
     def report(self, name: Optional[str] = None) -> str:
         """Eval-line stats snapshot; folds in the ``generate`` program-
@@ -1393,6 +1561,15 @@ class DecodeEngine:
             self.stats.gauge('prefix_index_pages', len(self._prefix))
             self.stats.gauge('live_slot_cap', self._live_slot_cap)
             self.stats.gauge('live_page_cap', self._live_page_cap)
+            if self._kv is not None:
+                self.kv_stats.gauge('pending_uploads',
+                                    len(self._pending_uploads))
+        if self._kv is not None:
+            # tier occupancy/hit gauges land on the separate `kv`
+            # StatSet (its own /metrics family and SLO set name) —
+            # NEVER on resident_bytes/budget_drift: host and disk
+            # bytes are not HBM and must not read as such
+            self._kv.refresh_gauges()
         proposed = self.stats.get('spec_proposed')
         if proposed:
             self.stats.gauge('spec_accept_rate',
@@ -1485,7 +1662,9 @@ class DecodeService:
                  max_queue: int = 64, max_wait: float = 0.002,
                  deadline: float = 30.0, dtype: str = 'f32',
                  flash_decode=None, prefix_share: int = 0,
-                 spec_k: int = 0, draft=None):
+                 spec_k: int = 0, draft=None, kv_host_mb: int = 0,
+                 kv_disk_mb: int = 0, kv_dir: Optional[str] = None,
+                 kv_share_dir: Optional[str] = None):
         from .batcher import DynamicBatcher
         stats = StatSet()
         self.engine = DecodeEngine(
@@ -1493,7 +1672,9 @@ class DecodeService:
             max_prompt=max_prompt, max_new_bound=max_new_bound,
             eos_id=eos_id, stats=stats, dtype=dtype,
             flash_decode=flash_decode, prefix_share=prefix_share,
-            spec_k=spec_k, draft=draft)
+            spec_k=spec_k, draft=draft, kv_host_mb=kv_host_mb,
+            kv_disk_mb=kv_disk_mb, kv_dir=kv_dir,
+            kv_share_dir=kv_share_dir)
         # with prefix sharing on, admission prices each request at its
         # ACTUAL prefill cost (a hit is just its tail), so a coalescing
         # window full of hits admits everything while a burst of cold

@@ -1,0 +1,217 @@
+"""graftcache — the tiered KV prefix cache (HBM → host → disk).
+
+Sits BEHIND the PR 12 content-addressed prefix index
+(``serve/decode.py``), extending its lifecycle without touching its
+ownership rules:
+
+* **Tier 0** is the live HBM page pool.  Unchanged: the decode loop
+  thread owns the physical pages; the admit thread only ever touches
+  host mirrors.
+* **Tier 1** is this module's bounded host-RAM ``OrderedDict`` (LRU by
+  insertion/touch): when the index evicts an entry whose page refcount
+  hits zero, the page's host K/V row mirrors **demote** here instead
+  of dropping.  The demote hook runs under the engine lock, so it is
+  memory-moves only — tier-1 overflow hands the coldest entry to the
+  tier-2 spill queue, whose disk writes happen on the store's own
+  ``cxxnet-kv-store-*`` worker thread.
+* **Tier 2** is :class:`~cxxnet_tpu.serve.kvstore.KVStore` — crc32-
+  digested fixed-size records on disk, optionally shared cross-replica
+  through ``serve.kv_share_dir``.
+
+A later prefix **probe** that runs past the index promotes: the admit
+thread calls :meth:`prefetch` OUTSIDE the engine lock (record reads
+fan out over a small persistent reader pool, so a whole-prefix walk
+never serialises page-sized I/O and the engine lock is never held
+across it), then :meth:`take` under the lock hands the rows to the
+engine, which
+re-uploads them into a freshly allocated physical page on the decode
+loop thread at the next token boundary.  The published rows ARE the
+prefill rows, so bitwise stream twins hold through every demote /
+promote / spill / adopt path — pinned by ``tests/test_kv_tiers.py``.
+
+Telemetry: the cache owns a ``kv`` :class:`StatSet` registered on the
+hub, so ``/metrics``, the gauge sampler and ``slo.kv_hit=
+kv.hit_rate>=0.5@60``-style specs ride free.  Host/disk occupancy is
+deliberately NOT part of ``DecodeEngine.resident_bytes()`` — the HBM
+ledger / ``budget_drift()`` cross-check stays device-truth only.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+from ..utils.metric import StatSet
+from .kvstore import KVStore
+
+__all__ = ['TieredKVCache', 'KVStore']
+
+
+class TieredKVCache:
+    """Host-tier LRU over spillable prefix-page entries.
+
+    ``host_bytes`` bounds tier 1 (0 = no host tier: demotes go straight
+    to the store, or drop when there is none); ``store`` is the
+    optional tier-2 :class:`KVStore`.  Thread-safe: the engine calls
+    :meth:`demote`/:meth:`take` under its own lock, the admit thread
+    calls :meth:`prefetch` outside it — lock order is always
+    ``engine._cond`` → ``TieredKVCache._lock``, and this module never
+    calls back into the engine.
+    """
+
+    def __init__(self, *, host_bytes: int = 0,
+                 store: Optional[KVStore] = None,
+                 stats: Optional[StatSet] = None):
+        self.stats = stats if stats is not None else StatSet()
+        self._store = store
+        self._host_cap = int(host_bytes)
+        self._lock = threading.Lock()
+        self._host: collections.OrderedDict = (
+            collections.OrderedDict())   # guarded-by: _lock
+        self._host_bytes = 0             # guarded-by: _lock
+        self._readers: Optional[ThreadPoolExecutor] = None  # guarded-by: _lock
+
+    # -- tier 1 ------------------------------------------------------------
+    @staticmethod
+    def _nbytes(hk, hv) -> int:
+        return int(hk.nbytes) + int(hv.nbytes)
+
+    def demote(self, key, hk: np.ndarray, hv: np.ndarray) -> None:
+        """Index eviction hands an entry down-tier.  Copies the rows
+        (the engine's mirrors are views into whole-prompt arrays, and a
+        view would pin the full prompt's memory against a page-sized
+        budget); memory-only — safe under the engine lock."""
+        hk = np.ascontiguousarray(hk)
+        hv = np.ascontiguousarray(hv)
+        spill = []
+        with self._lock:
+            if key in self._host:
+                self._host.move_to_end(key)
+                return
+            if self._host_cap > 0:
+                self._host[key] = (hk, hv)
+                self._host_bytes += self._nbytes(hk, hv)
+                while self._host_bytes > self._host_cap and self._host:
+                    k, (ck, cv) = self._host.popitem(last=False)
+                    self._host_bytes -= self._nbytes(ck, cv)
+                    spill.append((k, ck, cv))
+            else:
+                spill.append((key, hk, hv))
+        self.stats.inc('demote_pages')
+        for item in spill:
+            if self._store is not None:
+                self._store.spill(*item)  # async; drop-on-full inside
+            else:
+                self.stats.inc('host_evicted')
+
+    def take(self, key):
+        """Pop ``(hk, hv)`` for an exact key, or None — the promote
+        read.  The entry leaves tier 1: it is about to live in the HBM
+        index again, and will demote back here on its next eviction."""
+        with self._lock:
+            ent = self._host.pop(key, None)
+            if ent is not None:
+                self._host_bytes -= self._nbytes(*ent)
+        if ent is not None:
+            self.stats.inc('promote_pages')
+        return ent
+
+    def put_back(self, key, hk: np.ndarray, hv: np.ndarray) -> None:
+        """Undo a :meth:`take` (the engine's pad-coverage rule rejected
+        the promote chain); no counters move."""
+        with self._lock:
+            if key in self._host:
+                return
+            self._host[key] = (hk, hv)
+            self._host_bytes += self._nbytes(hk, hv)
+
+    # -- tier 2 promote path -----------------------------------------------
+    def prefetch(self, keys) -> int:
+        """Pull any of ``keys`` that tier 2 holds up into tier 1, in
+        order, stopping at the first miss (prefix chains are
+        consecutive: page ``lp`` is useless without ``lp-1``).  Runs on
+        the admit thread OUTSIDE the engine lock; record reads fan out
+        over a small persistent reader pool (a whole-prefix promote is
+        dozens of page-sized records, and serial open/read/crc would
+        put the disk walk on the admission critical path).  Records
+        past the first miss may load and be discarded — bounded by the
+        chain length, and the host dict only ever gains the consecutive
+        run.  Returns the number promoted to tier 1."""
+        store = self._store
+        if store is None or not keys:
+            return 0
+        with self._lock:
+            want = [k for k in keys if k not in self._host]
+        if not want:
+            return 0
+        t0 = time.monotonic()
+        got = 0
+        if len(want) > 1:
+            with self._lock:
+                if self._readers is None:
+                    self._readers = ThreadPoolExecutor(
+                        4, thread_name_prefix='cxxnet-kv-read')
+                ex = self._readers
+            loaded = list(ex.map(store.load, want))
+        else:
+            loaded = [store.load(want[0])]
+        for key, ent in zip(want, loaded):
+            if ent is None:
+                break
+            hk, hv = ent
+            with self._lock:
+                if key not in self._host:
+                    self._host[key] = (hk, hv)
+                    self._host_bytes += self._nbytes(hk, hv)
+            got += 1
+        if got:
+            self.stats.inc('disk_promote_pages', got)
+        self.stats.observe('promote_ms',
+                           (time.monotonic() - t0) * 1e3)
+        return got
+
+    # -- observability / lifecycle ------------------------------------------
+    def refresh_gauges(self) -> None:
+        """Tier occupancy + hit-rate gauges onto the ``kv`` StatSet —
+        the hub refresh hook, also folded into the engine report."""
+        with self._lock:
+            self.stats.gauge('host_bytes', self._host_bytes)
+            self.stats.gauge('host_entries', len(self._host))
+        if self._store is not None:
+            self.stats.gauge('disk_bytes', self._store.disk_bytes())
+            self.stats.gauge('disk_entries', self._store.disk_entries())
+        hits = self.stats.get('hits')
+        total = hits + self.stats.get('misses')
+        if total:
+            self.stats.gauge('hit_rate', hits / total)
+
+    def host_bytes(self) -> int:
+        with self._lock:
+            return self._host_bytes
+
+    def host_entries(self) -> int:
+        with self._lock:
+            return len(self._host)
+
+    @property
+    def store(self) -> Optional[KVStore]:
+        return self._store
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        return self._store.flush(timeout) if self._store is not None \
+            else True
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        with self._lock:
+            readers, self._readers = self._readers, None
+        if readers is not None:
+            readers.shutdown(wait=True)
+        if self._store is not None:
+            self._store.flush(timeout if timeout is not None else 5.0)
+            return self._store.close(timeout)
+        return True

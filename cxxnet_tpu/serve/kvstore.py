@@ -1,0 +1,443 @@
+"""graftcache tier 2 — the on-disk KV spill record store.
+
+One record per evicted prefix page: for a given engine shape every
+record is the same byte size (the BinaryPage fixed-record idiom,
+SURVEY.md §2.6), laid out as::
+
+    b'CXKV1\\n' | u32 header_len | header JSON | K rows | V rows
+
+with the exact PR 12 content key — ``(model version, pad width,
+logical page, exact padded token span)`` — carried in the header and
+re-checked on every read, so the sha256 *filename* digest is a lookup
+convenience, never a correctness dependence.  Records commit through
+the checkpoint publish discipline (``nnet/checkpoint.py``): staged
+write + fsync, crc32 sidecar computed from the staged bytes and
+committed BEFORE the rename, directory fsync — a reader can never
+observe a record without its digest.  The ``corrupt_kv=N`` chaos hook
+fires on the staged file between digest and rename, so injected
+corruption is deterministically caught by :func:`verify_record`.
+
+A record that fails digest verification (or whose header is not the
+key it was fetched for) is **quarantined** — renamed aside with a
+``.quarantine`` suffix, recorded as a typed
+:class:`~cxxnet_tpu.runtime.faults.KVCorruptRecordError` — and
+reported as a miss: the request re-prefills; a poisoned record can
+never reach a stream.
+
+Spill writes run on a dedicated ``cxxnet-kv-store-*`` worker thread
+(the engine's demote hook runs under the decode lock and must never
+touch a disk), bounded by a drop-on-full queue: a cache never owes
+durability.  ``share_dir`` turns the store cross-replica: every
+committed record is republished there under the same digest filename
++ sidecar discipline, and a local miss adopts a verified shared record
+— one replica's prefill serves the fleet (doc/serving.md "Tiered KV
+cache").
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import queue
+import struct
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils.metric import StatSet
+
+_MAGIC = b'CXKV1\n'
+_RECORD_SUFFIX = '.kv'
+
+
+def key_digest(key) -> str:
+    """Stable content digest of a PR 12 prefix key — the cross-replica
+    record name.  ``repr`` of the model version is the canonical form
+    (engine versions are ints / registry checkpoint numbers, identical
+    across replicas serving the same model)."""
+    version, w, lp, span = key
+    h = hashlib.sha256()
+    h.update(repr(version).encode())
+    h.update(b'|%d|%d|' % (int(w), int(lp)))
+    h.update(bytes(span))
+    return h.hexdigest()
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes                 # bf16 serving tier
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_record(key, hk: np.ndarray, hv: np.ndarray) -> bytes:
+    """Serialize one page's host K/V row mirrors + their exact key."""
+    version, w, lp, span = key
+    hk = np.ascontiguousarray(hk)
+    hv = np.ascontiguousarray(hv)
+    if hk.shape != hv.shape or hk.dtype != hv.dtype:
+        raise ValueError('K/V row mirrors must share shape and dtype')
+    header = json.dumps(
+        {'v': repr(version), 'w': int(w), 'lp': int(lp),
+         'span': bytes(span).hex(), 'dtype': str(hk.dtype),
+         'shape': list(hk.shape)}, sort_keys=True).encode()
+    return b''.join([_MAGIC, struct.pack('<I', len(header)), header,
+                     hk.tobytes(), hv.tobytes()])
+
+
+def decode_record(blob: bytes, key) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse a record back into ``(hk, hv)``; raises ``ValueError``
+    unless the header carries EXACTLY ``key`` (digest collisions and
+    stale-version aliasing both land here, never in a stream)."""
+    if blob[:len(_MAGIC)] != _MAGIC:
+        raise ValueError('bad record magic')
+    off = len(_MAGIC)
+    (hlen,) = struct.unpack_from('<I', blob, off)
+    off += 4
+    header = json.loads(blob[off:off + hlen].decode())
+    off += hlen
+    version, w, lp, span = key
+    want = {'v': repr(version), 'w': int(w), 'lp': int(lp),
+            'span': bytes(span).hex()}
+    got = {k: header.get(k) for k in want}
+    if got != want:
+        raise ValueError(f'record key mismatch: {got!r} != {want!r}')
+    dtype = _np_dtype(header['dtype'])
+    shape = tuple(int(s) for s in header['shape'])
+    n = int(np.prod(shape)) * dtype.itemsize
+    if len(blob) - off != 2 * n:
+        raise ValueError(f'record payload is {len(blob) - off} bytes, '
+                         f'expected {2 * n}')
+    hk = np.frombuffer(blob, dtype=dtype, count=int(np.prod(shape)),
+                       offset=off).reshape(shape)
+    hv = np.frombuffer(blob, dtype=dtype, count=int(np.prod(shape)),
+                       offset=off + n).reshape(shape)
+    return hk, hv
+
+
+class KVStore:
+    """Tier-2 record store: bounded disk budget, LRU-by-mtime eviction,
+    async spill worker, optional cross-replica ``share_dir``."""
+
+    def __init__(self, root: str, budget_bytes: int,
+                 share_dir: Optional[str] = None,
+                 stats: Optional[StatSet] = None, name: str = 'kv',
+                 max_queue: int = 256):
+        self.root = os.fspath(root)
+        self.share_dir = None if share_dir is None else os.fspath(share_dir)
+        self.budget_bytes = int(budget_bytes)
+        self.stats = stats if stats is not None else StatSet()
+        os.makedirs(self.root, exist_ok=True)
+        if self.share_dir is not None:
+            os.makedirs(self.share_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._disk_bytes = 0             # guarded-by: _lock (record bytes)
+        self._disk_entries = 0           # guarded-by: _lock
+        # spills awaiting the worker: read-through so a promote landing
+        # between enqueue and commit still finds the entry (a prefix
+        # chain breaks on ANY mid-chain miss, so the queue window must
+        # not read as one)
+        self._inflight: dict = {}        # guarded-by: _lock
+        self._scan_ledger()
+        # spill queue: drop-on-full (a cache never owes durability; a
+        # blocked producer here would be the decode admit thread)
+        self._q: queue.Queue = queue.Queue(maxsize=int(max_queue))
+        self._stop = threading.Event()
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name=f'cxxnet-kv-store-{name}')
+        self._worker.start()
+
+    # -- ledger ------------------------------------------------------------
+    def _records(self):
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        return [os.path.join(self.root, n) for n in sorted(names)
+                if n.endswith(_RECORD_SUFFIX)]
+
+    def _scan_ledger(self) -> None:
+        total = entries = 0
+        for path in self._records():
+            try:
+                total += os.path.getsize(path)
+                entries += 1
+            except OSError:
+                pass
+        with self._lock:
+            self._disk_bytes, self._disk_entries = total, entries
+
+    def disk_bytes(self) -> int:
+        with self._lock:
+            return self._disk_bytes
+
+    def disk_entries(self) -> int:
+        with self._lock:
+            return self._disk_entries
+
+    def record_path(self, key) -> str:
+        return os.path.join(self.root, key_digest(key) + _RECORD_SUFFIX)
+
+    # -- spill (async; worker thread) --------------------------------------
+    def spill(self, key, hk: np.ndarray, hv: np.ndarray) -> bool:
+        """Enqueue one demoted entry for the worker; False = queue full
+        (entry dropped, counted — never blocks the caller).  An
+        enqueued entry is immediately loadable through the in-flight
+        read-through; a dropped one is gone.
+
+        Spill-once: a key names an immutable span (version + pad + exact
+        tokens), so an existing record can never be stale — a re-demote
+        of an already-durable key just refreshes its LRU clock instead
+        of burning the worker on an identical record + fsync storm."""
+        with self._lock:
+            queued = key in self._inflight
+        path = self.record_path(key)
+        if queued or os.path.exists(path):
+            if not queued:
+                try:
+                    os.utime(path)
+                except OSError:
+                    pass
+            self.stats.inc('spill_dedup')
+            return True
+        hk = np.ascontiguousarray(hk)
+        hv = np.ascontiguousarray(hv)
+        try:
+            self._q.put_nowait((key, hk, hv))
+        except queue.Full:
+            self.stats.inc('spill_dropped')
+            return False
+        with self._lock:
+            self._inflight[key] = (hk, hv)
+        return True
+
+    def _run(self) -> None:
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                if item is not None:
+                    self._write_record(*item)
+            except BaseException as e:  # noqa: BLE001 — worker survives
+                from ..runtime import faults
+                self.stats.inc('spill_errors')
+                faults.global_failure_log().record(
+                    'kv_spill_error',
+                    repr(faults.KVSpillError(self.root, e)))
+            finally:
+                if item is not None:
+                    # retire the read-through entry only if a re-spill
+                    # hasn't replaced it (identity, not equality: the
+                    # newer enqueue owns the key now)
+                    with self._lock:
+                        cur = self._inflight.get(item[0])
+                        if cur is not None and cur[0] is item[1]:
+                            del self._inflight[item[0]]
+                self._q.task_done()
+            if item is None:
+                return
+
+    def _publish(self, path: str, blob: bytes, chaos: bool) -> None:
+        """Commit ``blob`` under ``path`` with the publish discipline:
+        staged bytes + fsync, digest sidecar from the staged bytes
+        committed BEFORE the rename, then rename + dir fsync.  The
+        ``corrupt_kv`` chaos hook fires between digest and rename
+        (``chaos`` gates it to the primary copy so one fault plan event
+        is one poisoned record, not a record AND its shared twin)."""
+        import zlib
+
+        from ..nnet import checkpoint
+        from ..runtime import faults
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(
+            d, f'.{os.path.basename(path)}.pub.{os.getpid()}')
+        try:
+            with open(tmp, 'wb') as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            digest = {'size': len(blob),
+                      'crc32': zlib.crc32(blob) & 0xFFFFFFFF}
+            with checkpoint.atomic_write(
+                    checkpoint.model_digest_path(path)) as f:
+                f.write(json.dumps(digest).encode())
+            if chaos:
+                faults.kv_record_committed(path, staged=tmp)
+            os.replace(tmp, path)
+            try:
+                dfd = os.open(d, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    def _write_record(self, key, hk, hv) -> None:
+        path = self.record_path(key)
+        fresh = not os.path.exists(path)
+        blob = encode_record(key, hk, hv)
+        self._publish(path, blob, chaos=True)
+        self.stats.inc('spills')
+        if fresh:
+            with self._lock:
+                self._disk_bytes += len(blob)
+                self._disk_entries += 1
+        self._enforce_budget()
+        if self.share_dir is not None:
+            share = os.path.join(self.share_dir,
+                                 os.path.basename(path))
+            if not os.path.exists(share):
+                self._publish(share, blob, chaos=False)
+                self.stats.inc('published')
+
+    def _enforce_budget(self) -> None:
+        """Delete coldest (oldest-mtime) records until under budget —
+        only the LOCAL root; the share dir is every replica's, pruned
+        by whoever owns its retention."""
+        if self.budget_bytes <= 0:
+            return
+        with self._lock:
+            over = self._disk_bytes > self.budget_bytes
+        if not over:
+            return
+        aged = []
+        for path in self._records():
+            try:
+                aged.append((os.path.getmtime(path),
+                             os.path.getsize(path), path))
+            except OSError:
+                pass
+        aged.sort()
+        freed_bytes = freed_entries = 0
+        with self._lock:
+            total = self._disk_bytes
+        for _mt, size, path in aged:
+            if total - freed_bytes <= self.budget_bytes:
+                break
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            try:
+                os.unlink(path + '.crc32')
+            except OSError:
+                pass
+            freed_bytes += size
+            freed_entries += 1
+            self.stats.inc('disk_evicted')
+        with self._lock:
+            self._disk_bytes = max(0, self._disk_bytes - freed_bytes)
+            self._disk_entries = max(0, self._disk_entries - freed_entries)
+
+    # -- promote reads (caller thread; pipelined by the cache) -------------
+    def _quarantine(self, path: str, reason: str) -> None:
+        from ..runtime import faults
+        err = faults.KVCorruptRecordError(path, reason)
+        self.stats.inc('corrupt_quarantined')
+        faults.global_failure_log().record('kv_corrupt_record', repr(err))
+        size = 0
+        try:
+            size = os.path.getsize(path)
+            os.replace(path, path + '.quarantine')
+        except OSError:
+            pass
+        try:
+            os.unlink(path + '.crc32')
+        except OSError:
+            pass
+        if os.path.dirname(os.path.abspath(path)) == \
+                os.path.abspath(self.root):
+            with self._lock:
+                self._disk_bytes = max(0, self._disk_bytes - size)
+                self._disk_entries = max(0, self._disk_entries - 1)
+
+    def _read_verified(self, path: str, key):
+        """(hk, hv) from one record file, or None — digest mismatch and
+        undecodable bytes both quarantine and read as a miss."""
+        from ..nnet import checkpoint
+        reason = checkpoint.verify_model_digest(path)
+        if reason is not None:
+            self._quarantine(path, reason)
+            return None
+        try:
+            with open(path, 'rb') as f:
+                blob = f.read()
+            ent = decode_record(blob, key)
+        except (OSError, ValueError) as e:
+            self._quarantine(path, repr(e))
+            return None
+        try:
+            os.utime(path)               # LRU clock for _enforce_budget
+        except OSError:
+            pass
+        return ent
+
+    def load(self, key):
+        """(hk, hv) for ``key``: an in-flight spill first (enqueued but
+        not yet committed — the rows in memory ARE the record), then the
+        local root, else adopted from the share dir (the adopted copy is
+        re-committed locally so the byte budget owns it), else None."""
+        with self._lock:
+            ent = self._inflight.get(key)
+        if ent is not None:
+            self.stats.inc('inflight_hits')
+            return ent
+        path = self.record_path(key)
+        if os.path.exists(path):
+            ent = self._read_verified(path, key)
+            if ent is not None:
+                return ent
+        if self.share_dir is None:
+            return None
+        share = os.path.join(self.share_dir, os.path.basename(path))
+        if not os.path.exists(share):
+            return None
+        ent = self._read_verified(share, key)
+        if ent is None:
+            return None
+        self.stats.inc('adopts')
+        blob = encode_record(key, *ent)
+        fresh = not os.path.exists(path)
+        self._publish(path, blob, chaos=False)
+        if fresh:
+            with self._lock:
+                self._disk_bytes += len(blob)
+                self._disk_entries += 1
+        self._enforce_budget()
+        return ent
+
+    # -- lifecycle ---------------------------------------------------------
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until every queued spill committed (tests and clean
+        shutdown); False on timeout."""
+        import time
+        deadline = time.monotonic() + timeout
+        while self._q.unfinished_tasks:
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        self._stop.set()
+        try:
+            self._q.put_nowait(None)     # wake the worker
+        except queue.Full:
+            pass
+        self._worker.join(timeout if timeout is not None else 5.0)
+        return not self._worker.is_alive()

@@ -444,10 +444,18 @@ class MultiModelRegistry:
     """
 
     def __init__(self, mem_budget: int = 0, poll_interval: float = 1.0,
-                 log: Optional[faults.FailureLog] = None):
+                 log: Optional[faults.FailureLog] = None,
+                 kv_share_dir: Optional[str] = None):
         self.budgeter = MemoryBudgeter(mem_budget)
         self.poll_interval = float(poll_interval)
         self.log = faults.global_failure_log() if log is None else log
+        # fleet root for the tiered KV cache (doc/serving.md "Tiered KV
+        # cache"): engine factories route their serve.kv_* wiring
+        # through kv_engine_kwargs() so every replica of one model —
+        # in this process or another — publishes/adopts the same
+        # share directory, while DIFFERENT models never alias
+        self.kv_share_dir = (None if kv_share_dir is None
+                             else os.fspath(kv_share_dir))
         self._models: Dict[str, _ManagedModel] = {}  # guarded-by: _lock
         self._drafts: List[ModelRegistry] = []       # guarded-by: _lock
         self._lock = threading.RLock()
@@ -470,6 +478,31 @@ class MultiModelRegistry:
                 pinned)
         if load:
             self.get(model_id)
+
+    def kv_engine_kwargs(self, model_id: str, kv_host_mb: int = 0,
+                         kv_disk_mb: int = 0) -> dict:
+        """The ``kv_*`` kwargs an engine factory passes straight to
+        ``DecodeEngine``/``DecodeService`` to join the fleet's tiered
+        KV cache: a per-process local record dir and a per-MODEL share
+        dir under the registry's ``kv_share_dir`` root.  Keeping the
+        share dir per model id is load-bearing — spill records are
+        keyed by (version, span) with no model identity, so two
+        different models at the same checkpoint number would alias in
+        one flat directory; replicas of the SAME model (any process)
+        share by construction.  Empty dict when the fleet has no kv
+        root or both tiers are off."""
+        if self.kv_share_dir is None \
+                or (kv_host_mb <= 0 and kv_disk_mb <= 0):
+            return {}
+        kw = {'kv_host_mb': int(kv_host_mb)}
+        if kv_disk_mb > 0:
+            kw.update(
+                kv_disk_mb=int(kv_disk_mb),
+                kv_dir=os.path.join(self.kv_share_dir, 'local',
+                                    f'{model_id}.{os.getpid()}'),
+                kv_share_dir=os.path.join(self.kv_share_dir, 'shared',
+                                          model_id))
+        return kw
 
     def models(self) -> List[str]:
         with self._lock:
@@ -682,6 +715,28 @@ class MultiModelRegistry:
         stats.gauge('evictions', self.evictions)
         for mid, nb in sorted(self.budgeter.resident().items()):
             stats.gauge(f'bytes[{mid}]', nb)
+        # tiered-KV occupancy rides the fleet report as its OWN gauges:
+        # host/disk tier bytes are never part of resident_bytes (the
+        # budgeter/budget_drift ledger stays HBM-truth only — pinned
+        # by a kv_tier regression test)
+        with self._lock:
+            engines = [(mid, e.engine) for mid, e in
+                       sorted(self._models.items())
+                       if e.engine is not None]
+        kv_host = kv_disk = 0
+        kv_any = False
+        for mid, eng in engines:
+            occ = getattr(eng, 'kv_occupancy', lambda: None)()
+            if occ is None:
+                continue
+            kv_any = True
+            kv_host += occ[0]
+            kv_disk += occ[1]
+            stats.gauge(f'kv_host_bytes[{mid}]', occ[0])
+            stats.gauge(f'kv_disk_bytes[{mid}]', occ[1])
+        if kv_any:
+            stats.gauge('kv_host_bytes', kv_host)
+            stats.gauge('kv_disk_bytes', kv_disk)
         drift = self.budget_drift()
         if drift is not None:
             stats.gauge('budget_drift', round(drift, 4))

@@ -563,7 +563,10 @@ class LMServe:
     "Prefix sharing"), and greedy speculative decoding ``spec_k`` plus
     ``draft.*`` keys (``draft.d_model=16;draft.stages=1;draft.seed=1``
     or ``draft.model_in=`` — the draft's vocab defaults to the
-    target's; doc/serving.md "Speculative decoding")."""
+    target's; doc/serving.md "Speculative decoding"), and the graftcache
+    KV tiers ``kv_host_mb`` / ``kv_disk_mb`` / ``kv_dir`` /
+    ``kv_share_dir`` (doc/serving.md "Tiered KV cache"; tiers need
+    ``prefix_share`` on)."""
 
     def __init__(self, svc):
         self.svc = svc
@@ -591,7 +594,7 @@ class LMServe:
                  'stages': 'num_stages', 'experts': 'num_experts',
                  'seq': 'seq_len'}
         ints = ('slots', 'pages', 'page_size', 'max_prompt', 'max_queue',
-                'prefix_share', 'spec_k')
+                'prefix_share', 'spec_k', 'kv_host_mb', 'kv_disk_mb')
         for key, val in parse_kv_list(cfg or ''):
             if key in names:
                 cfg_kw[names[key]] = int(val)
@@ -611,6 +614,8 @@ class LMServe:
                 svc_kw['dtype'] = val
             elif key == 'flash_decode':
                 svc_kw['flash_decode'] = val
+            elif key in ('kv_dir', 'kv_share_dir'):
+                svc_kw[key] = val
             elif key.startswith('draft.'):
                 has_draft = True
                 sub = key[len('draft.'):]

@@ -121,6 +121,14 @@ def pytest_configure(config):
         'localhost; host_loss/partition recovery bitwise-equal to '
         'fault-free twins); CPU-only '
         '(tier-1: runs under -m "not slow"; select with -m dist)')
+    config.addinivalue_line(
+        'markers',
+        'kv_tier: graftcache suite — tiered KV prefix cache (HBM page '
+        'pool -> bounded host RAM -> crc32-digested disk records), '
+        'demote/promote bitwise stream twins, LRU + byte-budget '
+        'enforcement, cross-replica share-dir adopt, corrupt-record '
+        'quarantine drills; CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m kv_tier)')
 
 
 # every pipeline thread the framework starts carries a cxxnet- name
@@ -130,7 +138,7 @@ def pytest_configure(config):
 # line on lifecycle
 _PIPELINE_THREAD_PREFIXES = ('cxxnet-tb-', 'cxxnet-pool-', 'cxxnet-decode-',
                              'cxxnet-elastic-', 'cxxnet-obs-',
-                             'cxxnet-scale-')
+                             'cxxnet-scale-', 'cxxnet-kv-')
 
 
 def _pipeline_threads():

@@ -1,0 +1,589 @@
+"""graftcache tiered KV prefix cache suite (serve/kvcache.py +
+serve/kvstore.py, doc/serving.md "Tiered KV cache").
+
+The load-bearing claim is tier transparency: demoting an evicted prefix
+page to host RAM, spilling it to a crc32-digested disk record, adopting
+it from another replica's share dir, or quarantining a poisoned copy
+must be BITWISE-invisible to token streams — every promoted stream
+equals its cold-prefill serve equals its offline
+``transformer.generate`` twin.  Plus the tier mechanics themselves: LRU
+demotion ordering, host/disk byte-budget enforcement, refcount safety
+(a promoting page is never an eviction victim), the record codec's
+key-mismatch rejection (digest collisions never reach a stream), the
+``corrupt_kv`` chaos drill, and the ``kv.*`` gauge surface.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from cxxnet_tpu.models import transformer as T
+from cxxnet_tpu.runtime import faults
+from cxxnet_tpu.serve.decode import DecodeEngine
+from cxxnet_tpu.serve.kvcache import TieredKVCache
+from cxxnet_tpu.serve.kvstore import (KVStore, decode_record,
+                                      encode_record, key_digest)
+from cxxnet_tpu.utils.metric import StatSet
+
+pytestmark = pytest.mark.kv_tier
+
+CFG = T.TransformerConfig(vocab_size=64, d_model=32, num_heads=4,
+                          d_ff=48, num_stages=2, seq_len=32, attn='local')
+PARAMS = T.init_params(np.random.RandomState(0), CFG)
+
+
+def _wait_ok(req, timeout=120):
+    assert req.event.wait(timeout), 'request never completed'
+    if req.error is not None:
+        raise req.error
+    return req.result
+
+
+def _offline(prompt, max_new, temperature=0.0, rng=None):
+    return np.asarray(T.generate(PARAMS, prompt, max_new, CFG,
+                                 temperature=temperature, rng=rng))[0]
+
+
+def _assert_twin(got, off):
+    got = np.asarray(got)
+    assert len(got) >= 1
+    np.testing.assert_array_equal(got, off[:len(got)])
+
+
+def _key(i, nbytes=64):
+    """A synthetic PR 12-shaped content key: (model version, pad width,
+    logical page, exact padded token span bytes)."""
+    return (0, 0, int(i), bytes([i % 251]) * nbytes)
+
+
+def _rows(seed, shape=(2, 8, 4, 8), dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(*shape).astype(dtype),
+            rng.randn(*shape).astype(dtype))
+
+
+def _engine(**kw):
+    kw.setdefault('slots', 2)
+    kw.setdefault('pages', 16)
+    kw.setdefault('page_size', 8)
+    kw.setdefault('max_prompt', 16)
+    kw.setdefault('max_new_bound', 16)
+    kw.setdefault('prefix_share', 2)
+    kw.setdefault('kv_host_mb', 4)
+    return DecodeEngine(PARAMS, CFG, **kw)
+
+
+def _serve(eng, prompt, max_new=6, temp=0.0, rng=None):
+    return _wait_ok(eng.submit_direct(prompt, max_new=max_new,
+                                      temperature=temp, rng=rng))
+
+
+# --- record codec (tier 2 on-disk format) ----------------------------------
+
+class TestRecordCodec:
+    def test_roundtrip_bitwise(self):
+        key = _key(3)
+        hk, hv = _rows(1)
+        rk, rv = decode_record(encode_record(key, hk, hv), key)
+        np.testing.assert_array_equal(hk, rk)
+        np.testing.assert_array_equal(hv, rv)
+        assert rk.dtype == hk.dtype and rk.shape == hk.shape
+
+    def test_key_mismatch_rejected(self):
+        """The sha256 filename is a lookup convenience only: the header
+        carries the exact key and a mismatch (digest collision, stale
+        version) is a typed rejection, never a silent wrong read."""
+        hk, hv = _rows(1)
+        blob = encode_record(_key(3), hk, hv)
+        for other in [(1, 0, 3, _key(3)[3]),      # other model version
+                      (0, 3, 3, _key(3)[3]),      # other pad width
+                      (0, 0, 4, _key(3)[3]),      # other logical page
+                      _key(4)]:                   # other token span
+            with pytest.raises(ValueError, match='key mismatch'):
+                decode_record(blob, other)
+
+    def test_truncated_and_bad_magic_rejected(self):
+        hk, hv = _rows(2)
+        blob = encode_record(_key(1), hk, hv)
+        with pytest.raises(ValueError):
+            decode_record(blob[:-8], _key(1))
+        with pytest.raises(ValueError, match='magic'):
+            decode_record(b'JUNK' + blob, _key(1))
+
+    def test_digest_is_content_stable(self):
+        """Same key -> same filename on every replica (the cross-replica
+        contract); any key component changes it."""
+        assert key_digest(_key(5)) == key_digest(_key(5))
+        assert key_digest(_key(5)) != key_digest(_key(6))
+        assert key_digest((0, 1, 5, _key(5)[3])) != key_digest(_key(5))
+
+
+# --- tier 1: host-RAM LRU ---------------------------------------------------
+
+class TestHostTier:
+    def _cache(self, entries=2, store=None):
+        hk, hv = _rows(0)
+        per = hk.nbytes + hv.nbytes
+        return (TieredKVCache(host_bytes=per * entries, store=store),
+                per)
+
+    def test_lru_eviction_order_and_byte_budget(self):
+        cache, per = self._cache(entries=2)
+        for i in range(3):
+            cache.demote(_key(i), *_rows(i))
+        # k0 was coldest: evicted (no store -> dropped, counted)
+        assert cache.take(_key(0)) is None
+        assert cache.host_entries() == 2
+        assert cache.host_bytes() <= 2 * per
+        assert cache.stats.get('demote_pages') == 3
+        assert cache.stats.get('host_evicted') == 1
+        hk, hv = cache.take(_key(2))
+        np.testing.assert_array_equal(hk, _rows(2)[0])
+        np.testing.assert_array_equal(hv, _rows(2)[1])
+
+    def test_redemote_touch_refreshes_lru(self):
+        cache, _ = self._cache(entries=2)
+        cache.demote(_key(0), *_rows(0))
+        cache.demote(_key(1), *_rows(1))
+        cache.demote(_key(0), *_rows(0))   # touch: k0 back to MRU
+        cache.demote(_key(2), *_rows(2))   # now k1 is the victim
+        assert cache.take(_key(1)) is None
+        assert cache.take(_key(0)) is not None
+
+    def test_take_put_back_counters(self):
+        cache, _ = self._cache(entries=2)
+        cache.demote(_key(0), *_rows(0))
+        ent = cache.take(_key(0))
+        assert ent is not None
+        assert cache.stats.get('promote_pages') == 1
+        cache.put_back(_key(0), *ent)      # coverage-rule undo: no count
+        assert cache.stats.get('promote_pages') == 1
+        assert cache.take(_key(0)) is not None
+
+    def test_zero_host_cap_spills_straight_to_store(self, tmp_path):
+        stats = StatSet()
+        store = KVStore(str(tmp_path / 'r'), 1 << 20, stats=stats)
+        try:
+            cache = TieredKVCache(host_bytes=0, store=store, stats=stats)
+            cache.demote(_key(0), *_rows(0))
+            assert cache.flush(10)
+            assert stats.get('spills') == 1
+            assert cache.host_entries() == 0
+            assert cache.prefetch([_key(0)]) == 1   # rises back to tier 1
+            assert cache.take(_key(0)) is not None
+        finally:
+            store.close(10)
+
+
+# --- tier 2: disk store -----------------------------------------------------
+
+class TestDiskStore:
+    def test_spill_load_roundtrip_and_ledger(self, tmp_path):
+        st = KVStore(str(tmp_path / 'root'), 1 << 20)
+        try:
+            key = _key(1)
+            hk, hv = _rows(4)
+            assert st.spill(key, hk, hv)
+            assert st.flush(10)
+            assert st.disk_entries() == 1
+            assert st.disk_bytes() == os.path.getsize(st.record_path(key))
+            # publish discipline: the digest sidecar is durable too
+            assert os.path.exists(st.record_path(key) + '.crc32')
+            rk, rv = st.load(key)
+            np.testing.assert_array_equal(hk, rk)
+            np.testing.assert_array_equal(hv, rv)
+        finally:
+            st.close(10)
+
+    def test_byte_budget_evicts_coldest(self, tmp_path):
+        hk, hv = _rows(0)
+        size = len(encode_record(_key(0), hk, hv))
+        st = KVStore(str(tmp_path / 'root'), int(size * 2.5))
+        try:
+            for i in range(2):
+                st.spill(_key(i), *_rows(i))
+            assert st.flush(10)
+            # age the first two so mtime-LRU ordering is unambiguous
+            for i in range(2):
+                old = time.time() - 1000 + i
+                os.utime(st.record_path(_key(i)), (old, old))
+            st.spill(_key(2), *_rows(2))
+            assert st.flush(10)
+            assert st.stats.get('disk_evicted') >= 1
+            assert st.disk_bytes() <= int(size * 2.5)
+            assert st.load(_key(0)) is None          # coldest gone
+            assert st.load(_key(2)) is not None      # newest kept
+        finally:
+            st.close(10)
+
+    def test_corrupt_record_quarantined_reads_as_miss(self, tmp_path):
+        st = KVStore(str(tmp_path / 'root'), 1 << 20)
+        try:
+            key = _key(7)
+            st.spill(key, *_rows(7))
+            assert st.flush(10)
+            path = st.record_path(key)
+            with open(path, 'r+b') as f:
+                f.truncate(os.path.getsize(path) // 2)
+            assert st.load(key) is None
+            assert st.stats.get('corrupt_quarantined') == 1
+            assert os.path.exists(path + '.quarantine')
+            assert not os.path.exists(path)
+            assert st.disk_entries() == 0            # ledger follows
+        finally:
+            st.close(10)
+
+    def test_share_publish_and_adopt(self, tmp_path):
+        share = str(tmp_path / 'shared')
+        s1 = KVStore(str(tmp_path / 'l1'), 1 << 20, share_dir=share)
+        s2 = KVStore(str(tmp_path / 'l2'), 1 << 20, share_dir=share)
+        try:
+            key = _key(9)
+            hk, hv = _rows(9)
+            s1.spill(key, hk, hv)
+            assert s1.flush(10)
+            assert s1.stats.get('published') == 1
+            rk, rv = s2.load(key)                    # replica 2 adopts
+            np.testing.assert_array_equal(hk, rk)
+            np.testing.assert_array_equal(hv, rv)
+            assert s2.stats.get('adopts') == 1
+            # the adopted copy re-commits locally: the next read is
+            # local and the byte budget owns it
+            assert os.path.exists(s2.record_path(key))
+            assert s2.disk_entries() == 1
+            assert s2.load(key) is not None
+            assert s2.stats.get('adopts') == 1
+        finally:
+            s1.close(10)
+            s2.close(10)
+
+
+# --- engine-level: demote -> promote bitwise twins --------------------------
+
+class TestEngineTiers:
+    @pytest.mark.parametrize('s0', [16, 13])         # w=0 / w=3
+    def test_demote_promote_bitwise_twin(self, s0):
+        """Prime a prefix, LRU-evict it down to the host tier, re-serve
+        it: the promoted stream equals the cold serve equals offline —
+        bitwise — at both pad widths."""
+        eng = _engine()
+        try:
+            rng = np.random.RandomState(7)
+            a = rng.randint(0, 64, (1, s0)).astype(np.int32)
+            off = _offline(a, 6)
+            _assert_twin(_serve(eng, a), off)        # cold + publish
+            for i in range(2):                       # evict A down-tier
+                f = rng.randint(0, 64, (1, s0)).astype(np.int32)
+                _assert_twin(_serve(eng, f), _offline(f, 6))
+            assert eng.kv_stats.get('demote_pages') >= 1
+            before = eng.stats.get('kv_promoted_pages')
+            _assert_twin(_serve(eng, a.copy()), off)  # promoted serve
+            assert eng.stats.get('kv_promoted_pages') > before
+            assert eng.stats.get('kv_uploads') >= 1
+            assert eng.kv_stats.get('hits') >= 1
+        finally:
+            eng.close(30)
+
+    def test_sampled_promote_twin(self):
+        eng = _engine()
+        try:
+            rng = np.random.RandomState(8)
+            a = rng.randint(0, 64, (1, 16)).astype(np.int32)
+            key = jax.random.PRNGKey(5)
+            off = _offline(a, 6, temperature=0.9, rng=key)
+            _assert_twin(_serve(eng, a, temp=0.9, rng=key), off)
+            for i in range(2):
+                f = rng.randint(0, 64, (1, 16)).astype(np.int32)
+                _serve(eng, f)
+            got = _serve(eng, a.copy(), temp=0.9, rng=key)
+            _assert_twin(got, off)
+            assert eng.stats.get('kv_promoted_pages') >= 1
+        finally:
+            eng.close(30)
+
+    def test_mid_stream_join_promote_twin(self):
+        """A promoted request joining a RUNNING decode loop (another
+        stream mid-flight) stays bitwise-twin — the upload drains on the
+        loop thread strictly before the join integrates."""
+        eng = _engine(slots=3)
+        try:
+            rng = np.random.RandomState(9)
+            a = rng.randint(0, 64, (1, 16)).astype(np.int32)
+            off = _offline(a, 6)
+            _assert_twin(_serve(eng, a), off)
+            for i in range(2):
+                f = rng.randint(0, 64, (1, 16)).astype(np.int32)
+                _serve(eng, f)
+            long = rng.randint(0, 64, (1, 16)).astype(np.int32)
+            r_long = eng.submit_direct(long, max_new=16)
+            time.sleep(0.05)                  # long stream is decoding
+            r_a = eng.submit_direct(a.copy(), max_new=6)
+            _assert_twin(_wait_ok(r_a), off)
+            _assert_twin(_wait_ok(r_long), _offline(long, 16))
+            assert eng.stats.get('kv_promoted_pages') >= 1
+        finally:
+            eng.close(30)
+
+    def test_disk_tier_promote_twin(self, tmp_path):
+        """No host tier at all: demotes spill to disk records and the
+        promote path rides prefetch (ThreadBuffer) -> verify -> upload;
+        streams stay bitwise twins."""
+        eng = _engine(kv_host_mb=0, kv_disk_mb=4,
+                      kv_dir=str(tmp_path / 'kv'))
+        try:
+            rng = np.random.RandomState(10)
+            a = rng.randint(0, 64, (1, 16)).astype(np.int32)
+            off = _offline(a, 6)
+            _assert_twin(_serve(eng, a), off)
+            for i in range(2):
+                f = rng.randint(0, 64, (1, 16)).astype(np.int32)
+                _serve(eng, f)
+            assert eng._kv.flush(10)          # spills durable
+            assert eng.kv_stats.get('spills') >= 1
+            _assert_twin(_serve(eng, a.copy()), off)
+            assert eng.kv_stats.get('disk_promote_pages') >= 1
+            assert eng.stats.get('kv_promoted_pages') >= 1
+        finally:
+            eng.close(30)
+
+    def test_refcount_promote_never_eviction_victim(self):
+        """Concurrent promoted + cold streams under a tight pool: the
+        promote splice holds an index ref AND a pending-upload ref, so
+        pool-dry reclaim can never free a promoting page — every stream
+        twins and no page ends up both free and referenced."""
+        eng = _engine(slots=2, pages=10)
+        try:
+            rng = np.random.RandomState(11)
+            a = rng.randint(0, 64, (1, 16)).astype(np.int32)
+            off_a = _offline(a, 8)
+            _assert_twin(_serve(eng, a, max_new=8), off_a)
+            for i in range(2):
+                f = rng.randint(0, 64, (1, 16)).astype(np.int32)
+                _serve(eng, f)
+            prompts = [a.copy()] + [rng.randint(0, 64, (1, 16))
+                                    .astype(np.int32) for _ in range(3)]
+            outs = [None] * len(prompts)
+
+            def drive(i):
+                outs[i] = _wait_ok(eng.submit_direct(prompts[i],
+                                                     max_new=8), 120)
+            ts = [threading.Thread(target=drive, args=(i,))
+                  for i in range(len(prompts))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(120)
+            _assert_twin(outs[0], off_a)
+            for i in range(1, len(prompts)):
+                _assert_twin(outs[i], _offline(prompts[i], 8))
+            with eng._cond:
+                refs = eng._page_refs.copy()
+                free = set(eng._free_pages)
+            assert all(refs[p] == 0 for p in free)
+        finally:
+            eng.close(30)
+
+    def test_kv_kwargs_validation(self):
+        with pytest.raises(ValueError, match='prefix_share'):
+            DecodeEngine(PARAMS, CFG, prefix_share=0, kv_host_mb=1)
+        with pytest.raises(ValueError, match='kv_dir'):
+            DecodeEngine(PARAMS, CFG, prefix_share=2, kv_disk_mb=1)
+        with pytest.raises(ValueError, match='kv_share_dir'):
+            DecodeEngine(PARAMS, CFG, prefix_share=2, kv_host_mb=1,
+                         kv_share_dir='/tmp/x')
+        with pytest.raises(ValueError, match='>= 0'):
+            DecodeEngine(PARAMS, CFG, prefix_share=2, kv_host_mb=-1)
+
+
+# --- observability ----------------------------------------------------------
+
+class TestGauges:
+    def test_kv_gauges_on_hub_and_no_hbm_double_count(self, tmp_path):
+        from cxxnet_tpu.obs.hub import TelemetryHub
+        from cxxnet_tpu.obs.slo import SLOSpec
+        eng = _engine(kv_host_mb=4, kv_disk_mb=4,
+                      kv_dir=str(tmp_path / 'kv'))
+        try:
+            resident0 = eng.resident_bytes()
+            rng = np.random.RandomState(12)
+            a = rng.randint(0, 64, (1, 16)).astype(np.int32)
+            _serve(eng, a)
+            for i in range(2):
+                _serve(eng, rng.randint(0, 64, (1, 16)).astype(np.int32))
+            _serve(eng, a.copy())             # promote -> promote_ms
+            host, disk = eng.kv_occupancy()
+            assert host > 0
+            # tier occupancy is host/disk memory, never HBM: the device
+            # ledger the budgeter cross-checks must not move
+            assert eng.resident_bytes() == resident0
+            hub = TelemetryHub(ring_events=64)
+            hub.register_stats('kv', eng.kv_stats,
+                               refresh=eng.kv_occupancy)
+            text = hub.metrics_text()
+            for metric in ('cxxnet_kv_host_bytes',
+                           'cxxnet_kv_host_entries',
+                           'cxxnet_kv_demote_pages',
+                           'cxxnet_kv_hit_rate',
+                           'cxxnet_kv_promote_ms_p50',
+                           'cxxnet_kv_promote_ms_p99'):
+                assert metric in text, metric
+            # the satellite contract: kv.* specs parse in the SLO
+            # grammar with no extra wiring
+            sp = SLOSpec.parse('kv_hit', 'kv.hit_rate>=0.5@60')
+            assert sp.key == 'kv.hit_rate' and sp.threshold == 0.5
+        finally:
+            eng.close(30)
+
+
+# --- cross-replica shared index --------------------------------------------
+
+class TestCrossReplica:
+    def test_two_engines_adopt_via_share_dir(self, tmp_path):
+        """Engine 1 prefills, spills and publishes; engine 2 (same
+        model, its own local root) adopts the records through the share
+        dir and serves the prefix WITHOUT re-prefilling — bitwise twin."""
+        share = str(tmp_path / 'shared')
+        e1 = _engine(kv_host_mb=0, kv_disk_mb=4,
+                     kv_dir=str(tmp_path / 'l1'), kv_share_dir=share)
+        e2 = _engine(kv_host_mb=0, kv_disk_mb=4,
+                     kv_dir=str(tmp_path / 'l2'), kv_share_dir=share)
+        try:
+            rng = np.random.RandomState(13)
+            a = rng.randint(0, 64, (1, 16)).astype(np.int32)
+            off = _offline(a, 6)
+            _assert_twin(_serve(e1, a), off)
+            for i in range(2):
+                f = rng.randint(0, 64, (1, 16)).astype(np.int32)
+                _serve(e1, f)
+            assert e1._kv.flush(10)
+            assert e1.kv_stats.get('published') >= 1
+            _assert_twin(_serve(e2, a.copy()), off)
+            assert e2.kv_stats.get('adopts') >= 1
+            assert e2.stats.get('kv_promoted_pages') >= 1
+        finally:
+            e1.close(30)
+            e2.close(30)
+
+    def test_two_process_cli_adopt(self, tmp_path):
+        """The full cross-replica protocol over real process boundaries:
+        one CLI replica publishes tier-2 records, a second adopts them —
+        and its stream equals the offline twin computed HERE."""
+        share = str(tmp_path / 'shared')
+        spec = ('vocab=64;d_model=32;heads=4;d_ff=48;stages=2;seq=32;'
+                'seed=0;slots=2;pages=16;page_size=8;max_prompt=16;'
+                'max_new=8;prefix_share=2;kv_host_mb=0;kv_disk_mb=4;'
+                'kv_share_dir=' + share + ';kv_dir=')
+        script = (
+            'import sys, numpy as np\n'
+            'from cxxnet_tpu.wrapper import LMServe\n'
+            'spec, mode = sys.argv[1], sys.argv[2]\n'
+            'h = LMServe.from_spec(spec)\n'
+            'a = (np.arange(16, dtype=np.int32) % 64)[None]\n'
+            'toks = h.generate(a, 6)\n'
+            'if mode == "publish":\n'
+            '    rng = np.random.RandomState(99)\n'
+            '    for _ in range(2):\n'
+            '        f = rng.randint(0, 64, (1, 16)).astype(np.int32)\n'
+            '        h.generate(f, 6)\n'
+            '    h.engine._kv.flush(10)\n'
+            'print("STREAM " + " ".join(str(int(t)) for t in toks))\n'
+            'print("ADOPTS %d PROMOTED %d" % ('
+            'h.engine.kv_stats.get("adopts"), '
+            'h.engine.stats.get("kv_promoted_pages")))\n'
+            'h.close(30)\n')
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        outs = []
+        for i, mode in enumerate(('publish', 'adopt')):
+            r = subprocess.run(
+                [sys.executable, '-c', script,
+                 spec + str(tmp_path / f'l{i}'), mode],
+                capture_output=True, text=True, timeout=300, env=env,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))
+            assert r.returncode == 0, r.stderr[-2000:]
+            outs.append(r.stdout)
+        a = (np.arange(16, dtype=np.int32) % 64)[None]
+        off = _offline(a, 6)
+        for out in outs:
+            line = [ln for ln in out.splitlines()
+                    if ln.startswith('STREAM')][0]
+            _assert_twin(np.array([int(t) for t in line.split()[1:]],
+                                  np.int32), off)
+        tail = [ln for ln in outs[1].splitlines()
+                if ln.startswith('ADOPTS')][0].split()
+        assert int(tail[1]) >= 1, f'replica 2 never adopted: {tail}'
+        assert int(tail[3]) >= 1, f'replica 2 never promoted: {tail}'
+
+
+# --- chaos: corrupt_kv ------------------------------------------------------
+
+class TestChaos:
+    def test_corrupt_kv_registered_and_grammar_roundtrip(self):
+        assert 'corrupt_kv' in faults.FaultPlan.registered_kinds()
+        plan = faults.FaultPlan.parse(
+            'seed=3;corrupt_kv=2;corrupt_kv@every=5')
+        assert 'corrupt_kv=2' in plan.describe()
+        assert 'corrupt_kv@every=5' in plan.describe()
+
+    def test_corrupt_kv_truncates_committed_record(self, tmp_path):
+        plan = faults.FaultPlan(corrupt_kv=(1,))
+        faults.install_plan(plan)
+        try:
+            st = KVStore(str(tmp_path / 'r'), 1 << 20)
+            try:
+                key = _key(1)
+                st.spill(key, *_rows(2))
+                assert st.flush(10)
+                assert plan.fired() == ['corrupt_kv=1']
+                # digest verify rejects the truncated record: miss,
+                # quarantined, never an exception
+                assert st.load(key) is None
+                assert st.stats.get('corrupt_quarantined') == 1
+                assert os.path.exists(st.record_path(key) +
+                                      '.quarantine')
+                # one plan event poisons ONE record; the next commits
+                # clean
+                k2 = _key(2)
+                st.spill(k2, *_rows(3))
+                assert st.flush(10)
+                assert st.load(k2) is not None
+            finally:
+                st.close(10)
+        finally:
+            faults.clear_plan()
+
+    def test_poisoned_tier2_record_never_nontwin_stream(self, tmp_path):
+        """The acceptance drill: a poisoned disk record is quarantined
+        on promote and the request falls back to a re-prefill — the
+        stream CANNOT diverge from its twin, and nothing crashes."""
+        plan = faults.FaultPlan(corrupt_kv=(1,))
+        faults.install_plan(plan)
+        try:
+            eng = _engine(kv_host_mb=0, kv_disk_mb=4,
+                          kv_dir=str(tmp_path / 'kv'))
+            try:
+                rng = np.random.RandomState(14)
+                a = rng.randint(0, 64, (1, 16)).astype(np.int32)
+                off = _offline(a, 6)
+                _assert_twin(_serve(eng, a), off)
+                for i in range(2):
+                    f = rng.randint(0, 64, (1, 16)).astype(np.int32)
+                    _serve(eng, f)
+                assert eng._kv.flush(10)
+                assert plan.fired() == ['corrupt_kv=1']
+                # the first spilled record (A's prefix page) is
+                # poisoned: the promote probe must quarantine it and
+                # the stream must still twin via re-prefill
+                _assert_twin(_serve(eng, a.copy()), off)
+                assert eng.kv_stats.get('corrupt_quarantined') >= 1
+            finally:
+                eng.close(30)
+        finally:
+            faults.clear_plan()

@@ -33,6 +33,16 @@ on, so the guard enforces the rules the bench modes promise
   least 3, and a composed chaos leg with zero twin violations and
   zero untyped sheds.  Per-leg ``p99_ms``/``loss`` are expanded into
   synthetic payloads so cross-round regression flags cover them.
+* **kv-tier receipts** — a ``BENCH_KV_*`` receipt
+  (``kv_tier_speedup``) claims the tiered cache beats cold prefill, so
+  the guard re-checks the claim's load-bearing structure: the cached
+  working set is LARGER than the HBM pool (``cache_pages`` >
+  ``hbm_pages`` — otherwise the tiers were never needed), the warm leg
+  actually promoted through tier 2 (``kv_promoted_pages`` and
+  ``kv.disk_promote_pages`` both positive), every stream in BOTH legs
+  was twin-asserted in-bench, and the speedup is at least 2x.  Per-leg
+  throughput and promote latency are expanded into synthetic payloads
+  for cross-round regression flags.
 
 Exit codes: ``0`` clean (or warnings only), ``1`` validation failure
 (or flagged regressions under ``--strict``), ``2`` internal error.
@@ -145,6 +155,58 @@ def expand_scenarios(p: dict, name: str) -> Tuple[List[str], List[dict]]:
     return errs, synth
 
 
+KV_METRIC = 'kv_tier_speedup'
+
+#: the tier thesis the receipt exists for: serving a prefix hit through
+#: the host/disk tiers must beat re-prefilling it cold by at least 2x
+KV_MIN_SPEEDUP = 2.0
+
+
+def expand_kv_tiers(p: dict, name: str) -> Tuple[List[str], List[dict]]:
+    """Validate one ``kv_tier_speedup`` payload and expand its per-leg
+    numbers into synthetic payloads for regression flags."""
+    errs: List[str] = []
+    synth: List[dict] = []
+    plat = p.get('platform')
+    for leg_name in ('warm', 'cold'):
+        leg = p.get(leg_name)
+        if not isinstance(leg, dict):
+            errs.append(f'{name}: kv receipt has no {leg_name!r} leg')
+            continue
+        if leg.get('twin_checked') != leg.get('streams'):
+            errs.append(
+                f'{name}: {leg_name} leg twin-checked '
+                f'{leg.get("twin_checked")} of {leg.get("streams")} '
+                'streams — every stream must be twin-asserted in-bench')
+        synth.append({'metric': f'kv_{leg_name}_tokens_per_sec',
+                      'value': leg.get('tokens_per_sec'),
+                      'unit': 'tokens/sec', 'platform': plat})
+    warm = p.get('warm') if isinstance(p.get('warm'), dict) else {}
+    kv = warm.get('kv') if isinstance(warm.get('kv'), dict) else {}
+    if not warm.get('kv_promoted_pages') or not kv.get(
+            'disk_promote_pages'):
+        errs.append(f'{name}: warm leg never promoted through the '
+                    'tiers (kv_promoted_pages='
+                    f'{warm.get("kv_promoted_pages")}, '
+                    f'disk_promote_pages={kv.get("disk_promote_pages")})'
+                    ' — the speedup is not a tier claim')
+    cache_pages, hbm_pages = p.get('cache_pages'), p.get('hbm_pages')
+    if not (isinstance(cache_pages, int) and isinstance(hbm_pages, int)
+            and cache_pages > hbm_pages):
+        errs.append(f'{name}: cached working set ({cache_pages} pages) '
+                    f'does not exceed the HBM pool ({hbm_pages} pages) '
+                    '— the bench proves nothing about tiering')
+    value = p.get('value')
+    if not (isinstance(value, (int, float))
+            and value >= KV_MIN_SPEEDUP):
+        errs.append(f'{name}: kv_tier_speedup {value} is below the '
+                    f'{KV_MIN_SPEEDUP}x claim the receipt exists for')
+    for key in ('promote_ms_p50', 'promote_ms_p99'):
+        synth.append({'metric': f'kv_{key}', 'value': warm.get(key),
+                      'unit': 'ms', 'platform': plat})
+    return errs, synth
+
+
 def check_file(path: str) -> Tuple[List[str], List[dict]]:
     """(errors, payloads) for one receipt file."""
     name = os.path.basename(path)
@@ -165,6 +227,10 @@ def check_file(path: str) -> Tuple[List[str], List[dict]]:
         if p.get('metric') == SCENARIO_METRIC:
             s_errs, synth = expand_scenarios(p, name)
             errs.extend(s_errs)
+            extra.extend(synth)
+        elif p.get('metric') == KV_METRIC:
+            k_errs, synth = expand_kv_tiers(p, name)
+            errs.extend(k_errs)
             extra.extend(synth)
     return errs, loads + extra
 
