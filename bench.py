@@ -1736,6 +1736,10 @@ _HEALABLE = {
     # BENCH_KV_r01: the tier ratio is compute-vs-disk-vs-HBM balance,
     # which a cpu host only approximates — re-measure on a real chip
     'kv_tier_speedup': ('bench_serve.py', 'kv_tiers'),
+    # BENCH_SHARD_r01: on the virtual CPU mesh every shard shares one
+    # host — the tp:N wall-clock ratio is a capacity/batching proxy;
+    # real per-chip scaling needs real chips
+    'decode_shard_scaling': ('bench_serve.py', 'sharded'),
 }
 
 

@@ -54,6 +54,22 @@ legs twin-asserted (the BENCH_KV_r01 shape)::
    "cold": {"tokens_per_sec": T, "streams": N, "twin_checked": N},
    "cache_pages": CP, "hbm_pages": HP}   # guard re-checks CP > HP
 
+``sharded`` — graftshard (doc/serving.md "Sharded serving"): decode
+tokens/sec at ``tp:1/2/4`` under a fixed per-device page budget (the
+mesh scales pool capacity, so the slot count riding it scales too) +
+the prefill-disaggregation A/B (``prefill_workers=0`` vs ``2`` with a
+long prompt at the head of the queue; the metric is the short crowd's
+time-to-first-token p99 — what the knob buys is admission past the
+head-of-line blocker), every leg's streams twin-asserted against a
+HOST copy of the leg's tree (the BENCH_SHARD_r01 shape)::
+
+  {"metric": "decode_shard_scaling", "value": X, "unit": "x",
+   "legs": [{"tp": N, "tokens_per_sec": T, "streams": S,
+             "twin_checked": S, "resident_bytes_per_device": [...]},
+            ...],
+   "disagg": {"off": {...}, "on": {...}, "short_ttft_improvement": I},
+   "twin_violations": 0}
+
 Method: a tiny model (random init — serving cost is shape-bound, not
 value-bound) behind the real engine + DynamicBatcher stack;
 ``--clients`` in-process threads submit mixed-size requests (seeded)
@@ -408,8 +424,12 @@ def _drive_leg(svc, prompts, max_new, twin_all=True):
         ttft.append((r.token_times[0] - r.t_submit) * 1e3)
     wall = time.monotonic() - t0
     checked = 0
+    # sharded engines oracle against a HOST copy of the params — the
+    # offline reference must never itself compile SPMD
+    oracle = getattr(svc.engine, 'oracle_params',
+                     lambda: svc.engine.params)()
     for p, r in zip(prompts, reqs):
-        off = np.asarray(T.generate(svc.engine.params, p, max_new,
+        off = np.asarray(T.generate(oracle, p, max_new,
                                     svc.engine.cfg))[0]
         got = np.asarray(r.result)
         assert (got == off[:len(got)]).all(), (
@@ -983,12 +1003,186 @@ def bench_scenarios(args) -> dict:
     }
 
 
+def bench_sharded(args) -> dict:
+    """graftshard ledger (doc/serving.md "Sharded serving"): decode
+    tokens/sec at tp:1/2/4 under a FIXED PER-DEVICE page budget — the
+    mesh is a capacity lever: the pool (and the slot count feeding it)
+    scales with the shard width while each device's slice stays one
+    chip's share, so at tp:1 a crowd round-robining over shared prompt
+    stems thrashes the prefix index (full stem prefill per stream)
+    while the tp:4 pool keeps every stem resident (page splices) —
+    plus the prefill-disaggregation A/B (``prefill_workers=0`` vs
+    ``2``) reading the short crowd's TTFT p99 past a long head-of-line
+    prompt.  Every leg's streams twin-asserted in-bench against
+    offline ``generate`` over a host copy of the leg's own tree."""
+    import jax
+    from cxxnet_tpu.serve.decode import DecodeService
+
+    ndev = len(jax.devices())
+    widths = [tp for tp in (1, 2, 4) if tp <= ndev]
+    from cxxnet_tpu.models import transformer as T
+    # a wider body than the shared decode-bench model: the quantity
+    # under test is AVOIDED stem-prefill compute, so the stem prefill
+    # must dwarf per-call dispatch overhead or the ledger reads noise
+    cfg = T.TransformerConfig(vocab_size=256, d_model=256, num_heads=4,
+                              d_ff=1024, num_stages=2, seq_len=64,
+                              attn='local')
+    params = T.init_params(np.random.RandomState(1), cfg)
+    ps = args.page_size
+    max_new = int(os.environ.get('CXXNET_SERVE_BENCH_SHARD_MAX_NEW', 8))
+    rng = np.random.RandomState(args.seed)
+    # Residency workload: the crowd round-robins over a few long shared
+    # prompt stems.  The per-device page budget is ONE stream's worth,
+    # so the tp:1 pool cannot keep a stem's prefix pages resident past
+    # the next stem's admission (reclaim evicts them) and every stream
+    # pays the full stem prefill again; the tp:4 pool holds every stem
+    # and streams splice cached pages instead — HBM capacity scaling
+    # the mesh buys, read out as aggregate tokens/sec.
+    stem_len = 60 * ps                     # prefills the 1024 bucket
+    n_stems = int(os.environ.get('CXXNET_SERVE_BENCH_SHARD_STEMS', 3))
+    reps = 8
+    stems = [rng.randint(0, cfg.vocab_size,
+                         (1, stem_len)).astype(np.int32)
+             for _ in range(n_stems)]
+    prompts = [stems[i % n_stems] for i in range(n_stems * reps)]
+    s0b = T._size_class(stem_len, floor=8)
+    # exactly one stream's pages per device: prompt pages + decode tail
+    pages_per_dev = int(os.environ.get(
+        'CXXNET_SERVE_BENCH_SHARD_PAGES',
+        (s0b + max_new - 2) // ps + 1))
+
+    legs = []
+    violations = 0
+    for tp in widths:
+        svc = DecodeService(
+            params, cfg, slots=2 * tp, pages=1 + pages_per_dev * tp,
+            page_size=ps, max_prompt=stem_len, max_new_bound=max_new,
+            max_queue=4 * len(prompts), deadline=600.0,
+            prefix_share=n_stems * (s0b // ps),
+            shard='' if tp == 1 else f'tp:{tp}')
+        try:
+            for p in stems:        # warmup: compile + publish off-clock
+                svc.batcher.wait(svc.submit_async(p, max_new))
+            toks, wall, _, checked = _drive_leg(svc, prompts, max_new)
+            hits = svc.engine.stats.get('prefix_hits')
+            misses = svc.engine.stats.get('prefix_misses')
+            hitp = svc.engine.stats.get('prefix_hit_pages')
+            legs.append({
+                'tp': tp, 'slots': 2 * tp,
+                'pages': 1 + pages_per_dev * tp,
+                'tokens_per_sec': round(toks / wall, 2),
+                'wall_sec': round(wall, 3),
+                'prefix_hits': int(hits), 'prefix_misses': int(misses),
+                'prefix_hit_pages': int(hitp),
+                'streams': len(prompts), 'twin_checked': checked,
+                'resident_bytes_per_device':
+                    [int(b) for b in svc.engine.resident_bytes_per_device()],
+            })
+        except AssertionError:
+            violations += 1
+            raise
+        finally:
+            svc.close(60)
+
+    # --- prefill disaggregation A/B: a LONG prompt at the head of the
+    # admission queue must not block the short streams behind it.  With
+    # workers=0, admission runs serially on the batcher worker, so
+    # every short waits out the long prefill; with workers=2, one
+    # worker chews the long prompt while the other drains the shorts —
+    # their time-to-first-token is the head-of-line claim.
+    long_len = 60 * ps                     # the same 1024-bucket weight
+    d_max_new = 24                         # longs: slot-holding streams
+    n_short = 12
+    longs = [rng.randint(0, cfg.vocab_size,
+                         (1, long_len)).astype(np.int32)
+             for _ in range(3)]
+    shorts = [rng.randint(0, cfg.vocab_size,
+                          (1, int(rng.randint(1, 8)))).astype(np.int32)
+              for _ in range(n_short)]
+    # longs INTERLEAVED with the short crowd: with workers=0 every
+    # mid-queue long prefill blocks all shorts behind it (serial
+    # admission), with workers=2 the second worker keeps draining
+    # shorts through it — the short crowd's TTFT p99 is the claim
+    order = ([(longs[0], False)]
+             + [(s, True) for s in shorts[:n_short // 2]]
+             + [(longs[1], False)]
+             + [(s, True) for s in shorts[n_short // 2:]]
+             + [(longs[2], False)])
+    dcfg_prompts = [p for p, _ in order]
+    short_idx = {i for i, (_, sh) in enumerate(order) if sh}
+    short_new = 4                          # shorts: TTFT-bound streams
+
+    def disagg_leg(workers: int) -> dict:
+        svc = DecodeService(
+            params, cfg, slots=6, pages=256, page_size=ps,
+            max_prompt=long_len, max_new_bound=d_max_new,
+            max_queue=64, deadline=600.0, prefill_workers=workers)
+        try:
+            # warmup compiles BOTH prompt buckets off the clock
+            svc.batcher.wait(svc.submit_async(longs[0], 2))
+            svc.batcher.wait(svc.submit_async(shorts[0], 2))
+            t0 = time.monotonic()
+            reqs = [svc.submit_async(
+                p, short_new if i in short_idx else d_max_new)
+                for i, p in enumerate(dcfg_prompts)]
+            ttft = []
+            for i, r in enumerate(reqs):
+                svc.batcher.wait(r)
+                if i in short_idx:
+                    ttft.append((r.token_times[0] - r.t_submit) * 1e3)
+            wall = time.monotonic() - t0
+            toks = sum(len(r.tokens) for r in reqs)
+            from cxxnet_tpu.models import transformer as T
+            checked = 0
+            for i, (p, r) in enumerate(zip(dcfg_prompts, reqs)):
+                mn = short_new if i in short_idx else d_max_new
+                off = np.asarray(T.generate(params, p, mn, cfg))[0]
+                got = np.asarray(r.result)
+                assert (got == off[:len(got)]).all(), \
+                    'disagg stream diverged from its offline twin'
+                checked += 1
+            tt = np.asarray(ttft)
+            return {
+                'prefill_workers': workers,
+                'tokens_per_sec': round(toks / wall, 2),
+                'short_ttft_p50_ms': round(float(np.quantile(tt, 0.5)), 3),
+                'short_ttft_p99_ms': round(float(np.quantile(tt, 0.99)), 3),
+                'streams': len(dcfg_prompts), 'twin_checked': checked,
+            }
+        finally:
+            svc.close(60)
+
+    d_off, d_on = disagg_leg(0), disagg_leg(2)
+    tp1 = legs[0]['tokens_per_sec']
+    tpN = legs[-1]['tokens_per_sec']
+    return {
+        'metric': 'decode_shard_scaling',
+        'value': round(tpN / tp1, 2),
+        'unit': 'x',
+        'legs': legs,
+        'pages_per_device': pages_per_dev,
+        'disagg': {
+            'off': d_off, 'on': d_on,
+            'short_ttft_improvement': round(
+                d_off['short_ttft_p99_ms']
+                / max(d_on['short_ttft_p99_ms'], 1e-9), 2),
+        },
+        'twin_violations': violations,
+        'max_new': max_new, 'page_size': ps,
+        'devices': ndev,
+        'model': {'vocab': cfg.vocab_size, 'd_model': cfg.d_model,
+                  'heads': cfg.num_heads, 'd_ff': cfg.d_ff,
+                  'stages': cfg.num_stages},
+        'platform': jax.default_backend(),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('mode', nargs='?', default='predict',
                     choices=('predict', 'decode', 'decode_matrix',
                              'prefix', 'spec', 'prefix_spec',
-                             'scenarios', 'kv_tiers'))
+                             'scenarios', 'kv_tiers', 'sharded'))
     ap.add_argument('--clients', type=int, default=int(
         os.environ.get('CXXNET_SERVE_BENCH_CLIENTS', 8)))
     ap.add_argument('--duration', type=float, default=float(
@@ -1013,6 +1207,17 @@ def main(argv=None) -> int:
     ap.add_argument('--seed', type=int, default=7)
     args = ap.parse_args(argv)
 
+    if args.mode == 'sharded':
+        # the sharded legs need a mesh: on CPU, widen the virtual
+        # device set BEFORE jax initializes (the conftest pattern)
+        plats = os.environ.get('JAX_PLATFORMS', '')
+        flags = os.environ.get('XLA_FLAGS', '')
+        if (not plats or plats == 'cpu') and \
+                'xla_force_host_platform_device_count' not in flags:
+            os.environ['XLA_FLAGS'] = (
+                flags + ' --xla_force_host_platform_device_count=8'
+            ).strip()
+
     budget = float(os.environ.get('CXXNET_BENCH_BACKEND_WAIT', '60'))
     if not _backend_ok(budget):
         return _cpu_fallback(argv, f'TPU backend unavailable within '
@@ -1022,7 +1227,8 @@ def main(argv=None) -> int:
              'prefix': bench_prefix, 'spec': bench_spec,
              'prefix_spec': bench_prefix_spec,
              'scenarios': bench_scenarios,
-             'kv_tiers': bench_kv_tiers}
+             'kv_tiers': bench_kv_tiers,
+             'sharded': bench_sharded}
     metrics = {'predict': 'serve_p99_latency_ms',
                'decode': 'decode_tokens_per_sec',
                'decode_matrix': 'decode_int8_resident_reduction',
@@ -1030,7 +1236,8 @@ def main(argv=None) -> int:
                'spec': 'spec_decode_speedup',
                'prefix_spec': 'prefix_share_speedup',
                'scenarios': 'scenario_autoscale_wins',
-               'kv_tiers': 'kv_tier_speedup'}
+               'kv_tiers': 'kv_tier_speedup',
+               'sharded': 'decode_shard_scaling'}
     try:
         out = modes[args.mode](args)
     except Exception as e:  # structured failure, never a bare traceback

@@ -156,8 +156,9 @@ def net_serve_start(net: Net, cfg: str) -> None:
     ``1:8:32``), ``max_queue``, ``max_wait`` (seconds), ``deadline``
     (seconds), ``warm`` (0/1), ``models`` (``|``-separated ``id:dir``
     fleet siblings), ``mem_budget`` (bytes), ``dtype`` (``f32``/
-    ``bf16``/``int8`` quantized-inference tier).  Empty string = all
-    defaults."""
+    ``bf16``/``int8`` quantized-inference tier), ``replicas`` (>=2 =
+    data-parallel per-device engine replicas behind the one batcher).
+    Empty string = all defaults."""
     from .utils.config import parse_kv_list
     kw = {}
     for key, val in parse_kv_list(cfg or ''):
@@ -178,6 +179,8 @@ def net_serve_start(net: Net, cfg: str) -> None:
             kw['mem_budget'] = int(val)
         elif key == 'dtype':
             kw['dtype'] = val
+        elif key == 'replicas':
+            kw['replicas'] = int(val)
         else:
             raise ValueError(f'unknown serve option: {key!r}')
     net.serve_start(**kw)
@@ -297,7 +300,10 @@ def lm_serve_start(cfg: str):
     sharing ``prefix_share`` (index page cap, 0 = off), greedy
     speculative decoding ``spec_k`` + ``draft.*`` draft-model keys, and
     the graftcache KV tiers ``kv_host_mb``/``kv_disk_mb``/``kv_dir``/
-    ``kv_share_dir`` (doc/serving.md "Tiered KV cache").
+    ``kv_share_dir`` (doc/serving.md "Tiered KV cache"), plus
+    graftshard's ``shard=tp:N`` tensor-parallel decode and
+    ``prefill_workers=N`` disaggregated prefill (doc/serving.md
+    "Sharded serving").
     Returns the service handle the other ``lm_serve_*`` calls take."""
     from .wrapper import LMServe
     return LMServe.from_spec(cfg)

@@ -101,6 +101,11 @@ class LearnTask:
         self.serve_kv_share_dir = ''   # serve.kv_share_dir cross-replica
         self.serve_spec_k = 0          # serve.spec_k window width (0/1=off)
         self.serve_draft = ''          # serve.draft spec (k=v;... like serve.lm)
+        # graftshard: mesh-sharded decode + disaggregated prefill +
+        # data-parallel predict replicas (doc/serving.md "Sharded serving")
+        self.serve_shard = ''          # serve.shard tp:N decode tensor split
+        self.serve_prefill_workers = 0  # serve.prefill_workers threads (0=inline)
+        self.serve_replicas = 0        # serve.replicas predict DP (0/1=single)
         # graftstorm: adversarial traffic + SLO-driven autoscaling
         self.serve_scenario = ''       # serve.scenario spec (shape=...;seed=...)
         self.serve_autoscale = ''      # serve.autoscale policy (min_slots=...;...)
@@ -205,6 +210,9 @@ class LearnTask:
             'serve.kv_share_dir': ('serve_kv_share_dir', str),
             'serve.spec_k': ('serve_spec_k', int),
             'serve.draft': ('serve_draft', str),
+            'serve.shard': ('serve_shard', str),
+            'serve.prefill_workers': ('serve_prefill_workers', int),
+            'serve.replicas': ('serve_replicas', int),
             'serve.scenario': ('serve_scenario', str),
             'serve.autoscale': ('serve_autoscale', str),
             'dist.hosts': ('dist_hosts', int),
@@ -890,20 +898,36 @@ class LearnTask:
         assert self.itr_pred is not None, 'must specify a pred iterator'
         import numpy as np
 
-        from .serve import DynamicBatcher, ModelRegistry, PredictEngine
+        from .serve import (DynamicBatcher, ModelRegistry, PredictEngine,
+                            ReplicatedPredictEngine)
         from .utils.bucketing import parse_buckets
 
-        engine = PredictEngine(self.net_trainer,
-                               parse_buckets(self.serve_buckets),
-                               dtype=self.serve_dtype)
+        if self.serve_replicas >= 2:
+            # graftshard DP: N per-device replicas behind ONE batcher;
+            # coalesced windows round-robin, hot swaps drain the fleet.
+            # Completion is engine-owned, so the replicas share the
+            # batcher's StatSet (single-owner counting still holds)
+            from .utils.metric import StatSet as _SS
+            engine = ReplicatedPredictEngine(
+                self.net_trainer, parse_buckets(self.serve_buckets),
+                dtype=self.serve_dtype, replicas=self.serve_replicas,
+                stats=_SS())
+        else:
+            engine = PredictEngine(self.net_trainer,
+                                   parse_buckets(self.serve_buckets),
+                                   dtype=self.serve_dtype)
         engine.warm()
         if not self.silent:
+            nrep = getattr(engine, 'engines', None)
             print(f'serve: warmed {len(engine.buckets)} bucket programs '
-                  f'{engine.buckets} (dtype={engine.serve_dtype}, '
-                  f'{engine.resident_bytes()} resident bytes)', flush=True)
+                  f'{engine.buckets} (dtype={self.serve_dtype}, '
+                  f'{engine.resident_bytes()} resident bytes'
+                  + (f', {len(nrep)} replicas' if nrep else '') + ')',
+                  flush=True)
         batcher = DynamicBatcher(engine, max_queue=self.serve_max_queue,
                                  max_wait=self.serve_max_wait,
-                                 deadline=self.serve_deadline)
+                                 deadline=self.serve_deadline,
+                                 stats=getattr(engine, 'stats', None))
         registry = None
         if self.serve_reload > 0:
             registry = ModelRegistry(
@@ -987,6 +1011,8 @@ class LearnTask:
             if registry is not None:
                 registry.close(timeout=5.0)
             batcher.close(timeout=30.0)
+            if hasattr(engine, 'close'):        # replica worker threads
+                engine.close(timeout=10.0)
             sys.stderr.write(f'[serve]{batcher.report("serve")}\n')
             if registry is not None:
                 # swap stamps: which step is serving and how stale it is
@@ -1191,7 +1217,9 @@ class LearnTask:
             kv_host_mb=self.serve_kv_host_mb,
             kv_disk_mb=self.serve_kv_disk_mb,
             kv_dir=self.serve_kv_dir or None,
-            kv_share_dir=self.serve_kv_share_dir or None)
+            kv_share_dir=self.serve_kv_share_dir or None,
+            shard=self.serve_shard,
+            prefill_workers=self.serve_prefill_workers)
         from .obs import get_hub
         # ONE StatSet backs both the engine and the batcher
         # (DecodeService shares it), so this single registration carries
@@ -1214,7 +1242,12 @@ class LearnTask:
                   f'attention={"flash" if svc.engine.use_flash else "gather"}'
                   f', prefix_share={self.serve_prefix_share}'
                   f', spec_k={svc.engine._spec_k}'
-                  f')', flush=True)
+                  + (f', shard=tp:{svc.engine._tp} over '
+                     f'{svc.engine._tp} devices'
+                     if svc.engine._tp > 1 else '')
+                  + (f', prefill_workers={self.serve_prefill_workers}'
+                     if self.serve_prefill_workers else '')
+                  + ')', flush=True)
         if self.serve_scenario:
             self._serve_decode_scenario(svc, cfg)
             return
@@ -1248,8 +1281,8 @@ class LearnTask:
             checked = 0
             for i in range(min(3, n_req)):
                 off = np.asarray(TT.generate(
-                    svc.engine.params, prompts[i], self.serve_max_new,
-                    svc.engine.cfg,
+                    svc.engine.oracle_params(), prompts[i],
+                    self.serve_max_new, svc.engine.cfg,
                     temperature=temp, rng=keys[i],
                     eos_id=None if self.serve_eos < 0
                     else self.serve_eos))[0]

@@ -26,10 +26,12 @@ so optimizer state never leaves the device that owns the shard.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import functools
 import math
 import os
+import threading
 from dataclasses import dataclass
 from typing import Tuple
 
@@ -37,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..nnet.quantize import qdot, qtake
 from ..parallel.moe import moe_ffn_local
@@ -50,6 +52,61 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 AXES = ('pipe', 'data', 'seq', 'model')
+
+# --- serving-side tensor parallelism (graftshard, doc/serving.md
+# "Sharded serving") -------------------------------------------------------
+#
+# The decode engine serves a COLUMN-sharded param tree over a 1xN
+# ('data', 'model') mesh: every matmul weight's last (output-feature)
+# axis is split over 'model' — wq/wk/wv along attention heads, wo/w2
+# along d_model, w1 along d_ff, head along vocab, embed along d_model —
+# and the residual stream is pulled back to replicated with an explicit
+# sharding constraint BEFORE any op that would contract over a sharded
+# axis.  That constraint lowers to an all-gather: pure data movement, no
+# arithmetic.  The payoff is the stream-twin contract — every float
+# reduction (matmul K-loops, layernorm moments, softmax sums) runs over
+# fully-replicated operands in the exact operand order of the
+# single-device program, so sharded logits are BITWISE-equal to
+# unsharded ones at any shard count (tests/test_serve_shard.py).  The
+# training path (`_stage_fn`) keeps its psum-based row-parallel layout:
+# training tolerates reduction-order drift, serving twins do not.
+#
+# The active serve mesh rides a thread-local rather than the config:
+# `TransformerConfig` must stay `dataclasses.astuple`-able (generate()'s
+# program-cache key), and tracing happens on whichever thread first
+# calls the jitted program — the engine wraps each traced body in
+# :func:`shard_scope`, so concurrent prefill workers tracing different
+# programs cannot see each other's mesh.
+_SHARD_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def shard_scope(mesh):
+    """Activate ``mesh`` as the serve-shard mesh for ops traced inside
+    this scope (``None`` = single-device: every hook is an identity)."""
+    prev = getattr(_SHARD_TLS, 'mesh', None)
+    _SHARD_TLS.mesh = mesh
+    try:
+        yield
+    finally:
+        _SHARD_TLS.mesh = prev
+
+
+def serve_shard_mesh():
+    """The serve-shard mesh active on this thread (None = off)."""
+    return getattr(_SHARD_TLS, 'mesh', None)
+
+
+def _rep(x):
+    """Constrain a (possibly model-sharded) activation to fully
+    replicated — the all-gather boundary of the column-parallel serving
+    layout.  Identity when no serve-shard mesh is active, so training,
+    ``generate`` and the single-device engines compile byte-identical
+    programs."""
+    mesh = serve_shard_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
 
 
 @dataclass
@@ -379,7 +436,10 @@ def _stage_attn(p, h, cfg: TransformerConfig, mask):
     k = qdot(y, p['wk']).reshape(mb, s, cfg.num_heads, hd)
     v = qdot(y, p['wv']).reshape(mb, s, cfg.num_heads, hd)
     attn = _local_attention(q, k, v, 1.0 / math.sqrt(hd), mask)
-    h = h + qdot(attn.reshape(mb, s, d), p['wo'])
+    # serve-shard boundary: gather the head-sharded attention output
+    # before contracting over d_model, re-replicate wo's column-sharded
+    # output before the residual add (no-ops off-mesh)
+    h = h + _rep(qdot(_rep(attn.reshape(mb, s, d)), p['wo']))
     y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
     return h, y2, k, v
 
@@ -538,7 +598,8 @@ def _gen_ffn(cfg: TransformerConfig, p, y2, gather: bool):
     if cfg.num_experts:
         return _nodrop_moe_ffn(y2.reshape(mb * s, d), p,
                                gather).reshape(mb, s, d)
-    return qdot(jax.nn.relu(qdot(y2, p['w1'])), p['w2'])
+    # serve-shard boundaries around the d_ff contraction (see _rep)
+    return _rep(qdot(_rep(jax.nn.relu(qdot(y2, p['w1']))), p['w2']))
 
 
 def prefill_kv(params, prompt, w, cfg: TransformerConfig):
@@ -554,7 +615,7 @@ def prefill_kv(params, prompt, w, cfg: TransformerConfig):
     cache rows for positions [0, s0), logits0 (b, vocab) float32 for the
     last position (the first generated token's distribution)."""
     b, s0 = prompt.shape
-    h = qtake(params['embed'], prompt)
+    h = _rep(qtake(params['embed'], prompt))
     # causal over the real tokens only: the first ``w`` slots are
     # bucket padding (generate() left-pads), excluded from every
     # real query.  Each PAD query attends just its own slot — an
@@ -572,7 +633,7 @@ def prefill_kv(params, prompt, w, cfg: TransformerConfig):
         ks.append(k)
         vs.append(v)
         h = h + _gen_ffn(cfg, p, y2, gather=False)
-    logits0 = qdot(h[:, -1], params['head']).astype(jnp.float32)
+    logits0 = _rep(qdot(h[:, -1], params['head'])).astype(jnp.float32)
     return jnp.stack(ks), jnp.stack(vs), logits0
 
 
@@ -601,7 +662,7 @@ def prefill_tail_kv(params, prefix_ks, prefix_vs, tail, w,
     b, tt = tail.shape
     t0 = prefix_ks.shape[2]
     hd = cfg.d_model // cfg.num_heads
-    h = qtake(params['embed'], tail)
+    h = _rep(qtake(params['embed'], tail))
     # query i sits at global position t0 + i; it attends cache positions
     # [w, t0 + i] — the same set full prefill's mask grants a real query
     gq = t0 + jnp.arange(tt)
@@ -618,12 +679,13 @@ def prefill_tail_kv(params, prefix_ks, prefix_vs, tail, w,
         kf = jnp.concatenate([prefix_ks[i], k], axis=1)
         vf = jnp.concatenate([prefix_vs[i], v], axis=1)
         attn = _local_attention(q, kf, vf, 1.0 / math.sqrt(hd), mask)
-        h = h + qdot(attn.reshape(b, tt, cfg.d_model), p['wo'])
+        h = h + _rep(qdot(_rep(attn.reshape(b, tt, cfg.d_model)),
+                          p['wo']))
         y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
         ks.append(k)
         vs.append(v)
         h = h + _gen_ffn(cfg, p, y2, gather=False)
-    logits0 = qdot(h[:, -1], params['head']).astype(jnp.float32)
+    logits0 = _rep(qdot(h[:, -1], params['head'])).astype(jnp.float32)
     return jnp.stack(ks), jnp.stack(vs), logits0
 
 
@@ -718,7 +780,7 @@ def _window_tokens(params, cfg: TransformerConfig, toks, attend):
     EVERY window position instead of just the last."""
     b, K = toks.shape
     hd = cfg.d_model // cfg.num_heads
-    h = qtake(params['embed'], toks)
+    h = _rep(qtake(params['embed'], toks))
     for i in range(cfg.num_stages):
         p = jax.tree.map(lambda a, i=i: a[i], params['stages'])
         y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
@@ -726,10 +788,11 @@ def _window_tokens(params, cfg: TransformerConfig, toks, attend):
         k = qdot(y, p['wk']).reshape(b, K, cfg.num_heads, hd)
         v = qdot(y, p['wv']).reshape(b, K, cfg.num_heads, hd)
         attn = attend(i, p, q, k, v)
-        h = h + qdot(attn.reshape(b, K, cfg.d_model), p['wo'])
+        h = h + _rep(qdot(_rep(attn.reshape(b, K, cfg.d_model)),
+                          p['wo']))
         y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
         h = h + _gen_ffn(cfg, p, y2, gather=True)
-    return qdot(h, params['head']).astype(jnp.float32)
+    return _rep(qdot(h, params['head'])).astype(jnp.float32)
 
 
 def _decode_token(params, cfg: TransformerConfig, tok, attend):
@@ -742,7 +805,7 @@ def _decode_token(params, cfg: TransformerConfig, tok, attend):
     drift from each other or from the shared projection math."""
     b = tok.shape[0]
     hd = cfg.d_model // cfg.num_heads
-    h = qtake(params['embed'], tok[:, None])
+    h = _rep(qtake(params['embed'], tok[:, None]))
     for i in range(cfg.num_stages):
         p = jax.tree.map(lambda a, i=i: a[i], params['stages'])
         y = _layer_norm(h, p['ln1_scale'], p['ln1_bias'])
@@ -750,10 +813,11 @@ def _decode_token(params, cfg: TransformerConfig, tok, attend):
         k = qdot(y, p['wk']).reshape(b, 1, cfg.num_heads, hd)
         v = qdot(y, p['wv']).reshape(b, 1, cfg.num_heads, hd)
         attn = attend(i, p, q, k, v)
-        h = h + qdot(attn.reshape(b, 1, cfg.d_model), p['wo'])
+        h = h + _rep(qdot(_rep(attn.reshape(b, 1, cfg.d_model)),
+                          p['wo']))
         y2 = _layer_norm(h, p['ln2_scale'], p['ln2_bias'])
         h = h + _gen_ffn(cfg, p, y2, gather=True)
-    return qdot(h[:, -1], params['head']).astype(jnp.float32)
+    return _rep(qdot(h[:, -1], params['head'])).astype(jnp.float32)
 
 
 def decode_step(params, cfg: TransformerConfig, tok, kc, vc, t, w):

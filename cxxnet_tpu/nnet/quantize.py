@@ -58,7 +58,7 @@ import numpy as np
 __all__ = ['QuantLeaf', 'quantize_leaf', 'quantize_tree',
            'dequantize_tree', 'qdot', 'qtake', 'tree_nbytes',
            'parse_serve_dtype', 'SERVE_DTYPES', 'LM_MATMUL_KEYS',
-           'quantize_lm_tree']
+           'quantize_lm_tree', 'shard_put']
 
 SERVE_DTYPES = ('f32', 'bf16', 'int8')
 
@@ -215,6 +215,31 @@ def dequantize_tree(tree, dtype=None):
 
     return jax.tree.map(one, tree,
                         is_leaf=lambda n: isinstance(n, QuantLeaf))
+
+
+def shard_put(leaf, mesh, spec):
+    """Device-put one param leaf with a full-rank ``PartitionSpec``
+    over ``mesh`` (the graftshard tensor-parallel placement,
+    doc/serving.md "Sharded serving").
+
+    A plain array takes ``spec`` directly.  A :class:`QuantLeaf` must
+    keep its two children CO-SHARDED: ``q`` takes ``spec``, and
+    ``scale`` — whose shape is ``q``'s with the contraction axis
+    (``-2``) dropped — takes ``spec`` with that same entry dropped, so
+    every per-output-channel scale lives on the device that owns its
+    channels and ``qdot``'s rescale multiply never crosses devices."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def put(arr, parts):
+        return jax.device_put(arr, NamedSharding(mesh,
+                                                 PartitionSpec(*parts)))
+
+    if isinstance(leaf, QuantLeaf):
+        parts = tuple(spec) + (None,) * (leaf.q.ndim - len(tuple(spec)))
+        return QuantLeaf(put(leaf.q, parts),
+                         put(leaf.scale, parts[:-2] + parts[-1:]),
+                         leaf.out_dtype)
+    return put(leaf, tuple(spec))
 
 
 def tree_nbytes(tree) -> int:

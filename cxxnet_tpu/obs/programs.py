@@ -317,6 +317,11 @@ class LedgerProgram:
         return self.ledger.record_trace(self, key, skel, wrapper,
                                         steps=steps)
 
+    def retire(self) -> None:
+        """Owner is shutting down: stop all future AOT probes of this
+        family's entries (see :meth:`ProgramLedger.retire_program`)."""
+        self.ledger.retire_program(self.name)
+
     def entries(self, analyze: bool = True) -> List[ProgramEntry]:
         return self.ledger.entries_for(self.name, analyze=analyze)
 
@@ -373,6 +378,23 @@ class ProgramLedger:
             self._names[name] = n + 1
             full = name if n == 0 else f'{name}#{n + 1}'
         return LedgerProgram(self, full, bound=bound)
+
+    def retire_program(self, name: str) -> None:
+        """Drop the analysis hooks of every entry under ``name`` — called
+        when the owning engine closes.  Rows and any compiler truth
+        already probed stay in the ledger views; un-probed entries are
+        marked analyzed with zeros (the failed-probe policy), so a later
+        :meth:`entries` sweep never AOT-compiles a dead program — the
+        owner's mesh/devices may be gone, and re-lowering a stale SPMD
+        skeleton late in the process is exactly the probe that can take
+        the whole XLA client down."""
+        with self._analyze_lock:       # exclude an in-flight probe
+            with self._lock:
+                for (n, _k), e in self._entries.items():
+                    if n == name:
+                        e._wrapper = None
+                        e._skel = None
+                        e._analyzed = True
 
     def set_recompile(self, mode: str) -> None:
         if mode not in ('warn', 'raise', 'off'):
@@ -660,21 +682,22 @@ class DeviceMemory:
     @staticmethod
     def _live_bytes() -> Dict[int, float]:
         """CPU fallback: bytes of every live ``jax.Array`` attributed
-        per device (a sharded array splits its bytes evenly across its
-        device set — the per-shard truth for even layouts)."""
+        per device from its addressable shards — a model-sharded array
+        adds each device's OWN shard bytes, a replicated one its full
+        bytes on EVERY device it occupies.  (An even split over the
+        device set undercounts replicated arrays N-fold, which is
+        exactly the error the sharded-serving budget reconciliation
+        would trip over.)"""
         import jax
         out: Dict[int, float] = {}
         for arr in jax.live_arrays():
             try:
-                devs = list(arr.devices())
+                for sh in arr.addressable_shards:
+                    out[sh.device.id] = (out.get(sh.device.id, 0.0)
+                                         + sh.data.nbytes)
             # lint: allow(fault-taxonomy): a deleted/donated array mid-walk must not kill the gauge fill
             except Exception:
                 continue
-            if not devs:
-                continue
-            per = arr.nbytes / len(devs)
-            for d in devs:
-                out[d.id] = out.get(d.id, 0.0) + per
         return out
 
 

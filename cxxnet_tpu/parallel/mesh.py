@@ -67,6 +67,34 @@ def build_mesh(devices: Optional[List] = None, tp: int = 1) -> Mesh:
     return Mesh(arr, ('data', 'model'))
 
 
+def parse_shard(spec: str) -> int:
+    """``serve.shard`` grammar (doc/serving.md "Sharded serving"):
+    ``''`` / ``'tp:1'`` = single device, ``'tp:N'`` = tensor-parallel
+    decode over the first N devices.  Returns the model-axis width."""
+    text = str(spec or '').strip().lower()
+    if text in ('', 'tp:1'):
+        return 1
+    if text.startswith('tp:'):
+        try:
+            n = int(text[3:])
+        except ValueError:
+            n = 0
+        if n >= 1:
+            return n
+    raise ValueError(f"serve.shard must be '' or 'tp:N', got {spec!r}")
+
+
+def decode_mesh(tp: int, devices: Optional[List] = None) -> Mesh:
+    """The 1xN ``('data', 'model')`` serving mesh over the first ``tp``
+    devices — what ``serve.shard=tp:N`` builds (the decode engine's
+    data axis is its slot batch, never device-sharded)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if tp > len(devs):
+        raise ValueError(f'serve.shard=tp:{tp} needs {tp} devices, '
+                         f'host has {len(devs)}')
+    return build_mesh(devs[:tp], tp=tp)
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

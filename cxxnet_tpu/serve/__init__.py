@@ -29,6 +29,13 @@ sit behind heavy live traffic.
 * :mod:`~cxxnet_tpu.serve.scenario` — graftstorm: seeded, replayable
   adversarial traffic scenarios (``serve.scenario=``) with an exactly
   reconciling :class:`~cxxnet_tpu.serve.scenario.ScenarioLedger`,
+* graftshard — mesh-sharded decode serving: ``serve.shard=tp:N``
+  head-shards the decode params + paged KV pool across N devices with
+  every stream a bitwise twin of single-device ``generate``;
+  ``serve.prefill_workers=N`` disaggregates prompt prefill onto
+  dedicated threads; :class:`~cxxnet_tpu.serve.engine.\
+ReplicatedPredictEngine` puts N data-parallel predict replicas behind
+  one batcher (``serve.replicas=N``),
 * :class:`~cxxnet_tpu.serve.autoscale.Autoscaler` — SLO-verdict-driven
   scaling over declared-safe surfaces (``serve.autoscale=``), bounded,
   hysteresis-damped, reversible; explicit typed degradation at the
@@ -51,7 +58,7 @@ from .autoscale import AutoscalePolicy, Autoscaler
 from .batcher import DynamicBatcher, ServeRequest
 from .decode import (DecodeEngine, DecodeService, lm_loader,
                      load_lm_params, save_lm_params)
-from .engine import PredictEngine
+from .engine import PredictEngine, ReplicatedPredictEngine
 from .kvcache import TieredKVCache
 from .kvstore import KVStore
 from .registry import (MemoryBudgeter, ModelRegistry, MultiModelRegistry,
@@ -59,7 +66,8 @@ from .registry import (MemoryBudgeter, ModelRegistry, MultiModelRegistry,
 from .scenario import (ScenarioLedger, ScenarioRequest, ScenarioSpec,
                        drive_scenario)
 
-__all__ = ['PredictEngine', 'DynamicBatcher', 'ServeRequest',
+__all__ = ['PredictEngine', 'ReplicatedPredictEngine', 'DynamicBatcher',
+           'ServeRequest',
            'ModelRegistry', 'MultiModelRegistry', 'MemoryBudgeter',
            'load_model_params', 'DecodeEngine', 'DecodeService',
            'save_lm_params', 'load_lm_params', 'lm_loader',

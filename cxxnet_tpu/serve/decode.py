@@ -80,9 +80,11 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import transformer as T
 from ..nnet import quantize
+from ..parallel import mesh as mesh_mod
 from ..obs import format_report, record_event, span
 from ..ops import pallas_kernels as PK
 from ..runtime import faults as _faults
@@ -178,7 +180,8 @@ class DecodeEngine:
                  prefix_share: int = 0, spec_k: int = 0, draft=None,
                  kv_host_mb: int = 0, kv_disk_mb: int = 0,
                  kv_dir: Optional[str] = None,
-                 kv_share_dir: Optional[str] = None):
+                 kv_share_dir: Optional[str] = None,
+                 shard: str = '', prefill_workers: int = 0):
         if not cfg.causal:
             raise ValueError('DecodeEngine requires a causal config')
         if slots < 1 or pages < 2 or page_size < 1:
@@ -211,6 +214,36 @@ class DecodeEngine:
             cfg = dataclasses.replace(cfg, dtype=jnp.bfloat16)
         # serve.flash_decode tri-state over the global pallas_mode() gate
         self.use_flash = PK.decode_use_flash(flash_decode)
+        # --- tensor-parallel decode (serve.shard, doc/serving.md
+        # "Sharded serving"): a 1xN ('data', 'model') mesh; every matmul
+        # weight column-shards its LAST axis over 'model' and the K/V
+        # page pool shards its heads axis, with explicit all-gather
+        # boundaries (transformer._rep) keeping the residual stream
+        # replicated — column-sliced matmuls preserve each output
+        # element's contraction order, so every stream stays a BITWISE
+        # twin of single-device generate at any shard width.
+        self._tp = mesh_mod.parse_shard(shard)
+        self._mesh = None
+        if self._tp > 1:
+            if cfg.num_heads % self._tp:
+                raise ValueError(
+                    f'serve.shard=tp:{self._tp} must divide num_heads='
+                    f'{cfg.num_heads} (the KV pool shards per head)')
+            if cfg.num_experts:
+                raise ValueError('serve.shard supports dense FFN only '
+                                 '(num_experts > 0 is unsupported)')
+            if slots < 2:
+                # XLA lowers the degenerate single-row attention dot
+                # (b*q == 1) through a different contraction blocking at
+                # one head per device — measured 1-ulp drift at tp:4.
+                # A sharded engine exists to widen batching anyway.
+                raise ValueError('serve.shard=tp:N needs slots >= 2 '
+                                 '(the bitwise-twin contract excludes '
+                                 'single-row steps)')
+            self._mesh = mesh_mod.decode_mesh(self._tp)
+            # pallas kernels do not run SPMD over sharded operands
+            # without shard_map — the gather leg is the sharded path
+            self.use_flash = False
         self.cfg = cfg
         self.name = name
         self.slots = int(slots)
@@ -226,8 +259,15 @@ class DecodeEngine:
         hd = cfg.d_model // cfg.num_heads
         pool_shape = (cfg.num_stages, self.n_pages, self.page_size,
                       cfg.num_heads, hd)
-        self._kpool = jax.device_put(np.zeros(pool_shape, cfg.dtype))
-        self._vpool = jax.device_put(np.zeros(pool_shape, cfg.dtype))
+        # sharded engines split the pool per head: each head's K/V pages
+        # live on the head's device, so aggregate page capacity scales
+        # with the mesh while the per-device slice stays one chip's share
+        pool_sh = (None if self._mesh is None else NamedSharding(
+            self._mesh, P(None, None, None, 'model', None)))
+        self._kpool = jax.device_put(np.zeros(pool_shape, cfg.dtype),
+                                     pool_sh)
+        self._vpool = jax.device_put(np.zeros(pool_shape, cfg.dtype),
+                                     pool_sh)
         self._cond = threading.Condition()
         # physical page 0 is scratch: idle slots write there, nobody reads
         self._free_pages: List[int] = list(
@@ -334,8 +374,18 @@ class DecodeEngine:
             dhd = dcfg.d_model // dcfg.num_heads
             dshape = (dcfg.num_stages, self.slots, self.cache_len,
                       dcfg.num_heads, dhd)
-            self._kdc = jax.device_put(np.zeros(dshape, dcfg.dtype))
-            self._vdc = jax.device_put(np.zeros(dshape, dcfg.dtype))
+            # the draft rides the mesh REPLICATED (it is small; its head
+            # count need not divide tp) — duplicated compute, zero
+            # collectives, bitwise-identical proposals on every device
+            drep = (None if self._mesh is None
+                    else NamedSharding(self._mesh, P()))
+            self._kdc = jax.device_put(np.zeros(dshape, dcfg.dtype),
+                                       drep)
+            self._vdc = jax.device_put(np.zeros(dshape, dcfg.dtype),
+                                       drep)
+        # guarded-by: _pf_lock (prefill/tail program caches — touched by
+        # prefill worker threads concurrently, never under _cond)
+        self._pf_lock = threading.Lock()
         self._prefill_fns: collections.OrderedDict = collections.OrderedDict()
         self._tail_fns: collections.OrderedDict = collections.OrderedDict()
         self._spec_fns: dict = {}
@@ -358,6 +408,20 @@ class DecodeEngine:
         self._loop = threading.Thread(target=self._run, daemon=True,
                                       name=f'cxxnet-decode-{name}')
         self._loop.start()
+        # -- disaggregated prefill: dedicated worker threads own the
+        # prompt-prefill leg so a long cold prompt never serializes
+        # behind another inside the batcher hand-off; finished KV
+        # reaches the loop through the same _joinq token-boundary
+        # integration as inline admission (streams stay bitwise twins
+        # — only WHO ran the prefill program changes, never its math)
+        # guarded-by: _cond (queue + worker wakeups)
+        self._prefillq: collections.deque = collections.deque()
+        self._prefill_threads: list = []
+        for i in range(max(0, int(prefill_workers))):
+            t = threading.Thread(target=self._prefill_worker, daemon=True,
+                                 name=f'cxxnet-prefill-{name}-{i}')
+            t.start()
+            self._prefill_threads.append(t)
 
     # -- compiled programs -------------------------------------------------
     @staticmethod
@@ -389,6 +453,8 @@ class DecodeEngine:
         Tlen = self.cache_len
         hd = cfg.d_model // cfg.num_heads
 
+        mesh = self._mesh
+
         if self.use_flash:
             def step(params, kpool, vpool, table, pos, w, tok, r, temp):
                 # flash leg: K/V rows scatter into their physical pages
@@ -406,58 +472,78 @@ class DecodeEngine:
         def step(params, kpool, vpool, table, pos, w, tok, r, temp):
             # gather each slot's pages into the dense cache layout the
             # shared decode_step math expects (gather is an exact copy:
-            # the paged-vs-dense twin test pins this bitwise)
-            st = kpool.shape[0]
-            kc = kpool[:, table].reshape(st, S, Tlen, cfg.num_heads, hd)
-            vc = vpool[:, table].reshape(st, S, Tlen, cfg.num_heads, hd)
-            logits, _, _, knew, vnew = T.decode_step(
-                params, cfg, tok, kc, vc, pos, w)
-            # scatter only the newly written rows back into the pool
-            page = table[jnp.arange(S), pos // ps]
-            off = pos % ps
-            si = jnp.arange(st)[:, None]
-            kpool = kpool.at[si, page[None, :], off[None, :]].set(knew)
-            vpool = vpool.at[si, page[None, :], off[None, :]].set(vnew)
-            nxt = self._pick_slots(logits, r, temp)
-            return kpool, vpool, nxt
+            # the paged-vs-dense twin test pins this bitwise).  The
+            # shard scope arms transformer._rep's all-gather boundaries
+            # for the trace (identity when mesh is None).
+            with T.shard_scope(mesh):
+                st = kpool.shape[0]
+                kc = kpool[:, table].reshape(st, S, Tlen,
+                                             cfg.num_heads, hd)
+                vc = vpool[:, table].reshape(st, S, Tlen,
+                                             cfg.num_heads, hd)
+                logits, _, _, knew, vnew = T.decode_step(
+                    params, cfg, tok, kc, vc, pos, w)
+                # scatter only the newly written rows back into the pool
+                page = table[jnp.arange(S), pos // ps]
+                off = pos % ps
+                si = jnp.arange(st)[:, None]
+                kpool = kpool.at[si, page[None, :],
+                                 off[None, :]].set(knew)
+                vpool = vpool.at[si, page[None, :],
+                                 off[None, :]].set(vnew)
+                nxt = self._pick_slots(logits, r, temp)
+                return kpool, vpool, nxt
 
         return self._prog_step.jit(step, donate_argnums=(1, 2),
                                    key='gather', fixed=True)
 
     def _prefill_fn(self, s0b: int, draft: bool = False):
         key = ('draft', s0b) if draft else s0b
-        fn = self._prefill_fns.get(key)
-        if fn is None:
-            self.stats.inc('prefill_programs')   # retrace visibility
-            cfg = self._draft_cfg if draft else self.cfg
-            fn = self._prog_prefill.jit(
-                lambda params, prompt, w:
-                T.prefill_kv(params, prompt, w, cfg),
-                key=f'{"draft_" if draft else ""}s{s0b}', fixed=True)
+        with self._pf_lock:
+            fn = self._prefill_fns.get(key)
+            if fn is not None:
+                self._prefill_fns.move_to_end(key)
+                return fn
+        self.stats.inc('prefill_programs')   # retrace visibility
+        cfg = self._draft_cfg if draft else self.cfg
+        mesh = None if draft else self._mesh
+
+        def prefill(params, prompt, w):
+            with T.shard_scope(mesh):
+                return T.prefill_kv(params, prompt, w, cfg)
+
+        fn = self._prog_prefill.jit(
+            prefill, key=f'{"draft_" if draft else ""}s{s0b}',
+            fixed=True)
+        with self._pf_lock:
             self._prefill_fns[key] = fn
             # same LRU bound (and env knob) as generate's program cache
             while len(self._prefill_fns) > T._gen_cache_max():
                 self._prefill_fns.popitem(last=False)
-        else:
-            self._prefill_fns.move_to_end(key)
         return fn
 
     def _tail_fn(self, t0: int, tt: int):
         """Jitted prefix-shared tail prefill, keyed by (prefix, tail)
         lengths (``w`` stays a traced value, like the full prefill)."""
-        fn = self._tail_fns.get((t0, tt))
-        if fn is None:
-            self.stats.inc('prefill_programs')
-            cfg = self.cfg
-            fn = self._prog_tail.jit(
-                lambda params, pk, pv, tail, w:
-                T.prefill_tail_kv(params, pk, pv, tail, w, cfg),
-                key=f't{t0}+{tt}', fixed=True)
+        with self._pf_lock:
+            fn = self._tail_fns.get((t0, tt))
+            if fn is not None:
+                self._tail_fns.move_to_end((t0, tt))
+                return fn
+        self.stats.inc('prefill_programs')
+        cfg = self.cfg
+        mesh = self._mesh
+
+        def tail_prefill(params, pk, pv, tail, w):
+            with T.shard_scope(mesh):
+                return T.prefill_tail_kv(params, pk, pv, tail, w, cfg)
+
+        fn = self._prog_tail.jit(tail_prefill, key=f't{t0}+{tt}',
+                                 fixed=True)
+        with self._pf_lock:
             self._tail_fns[(t0, tt)] = fn
             while len(self._tail_fns) > T._gen_cache_max():
                 self._tail_fns.popitem(last=False)
-        else:
-            self._tail_fns.move_to_end((t0, tt))
         return fn
 
     def _dwrite_fn(self, s0b: int):
@@ -492,9 +578,13 @@ class DecodeEngine:
             S, ps, Tlen = self.slots, self.page_size, self.cache_len
             hd = cfg.d_model // cfg.num_heads
             use_flash = self.use_flash
+            mesh = self._mesh
 
             def spec(params, dparams, kpool, vpool, kdc, vdc, table,
                      pos, w, tok):
+                # draft proposals run OUTSIDE the shard scope: the
+                # draft is replicated on the mesh, so its steps are
+                # duplicated (bitwise-identical) compute per device
                 window = [tok]
                 dtok = tok
                 for k in range(K - 1):
@@ -507,19 +597,22 @@ class DecodeEngine:
                     logits, kpool, vpool = T.verify_step_paged(
                         params, cfg, toks, kpool, vpool, table, pos, w)
                 else:
-                    st = kpool.shape[0]
-                    kc = kpool[:, table].reshape(st, S, Tlen,
-                                                 cfg.num_heads, hd)
-                    vc = vpool[:, table].reshape(st, S, Tlen,
-                                                 cfg.num_heads, hd)
-                    logits, _, _, knew, vnew = T.verify_step(
-                        params, cfg, toks, kc, vc, pos, w)
-                    tq = pos[:, None] + jnp.arange(K)[None, :]
-                    page = table[jnp.arange(S)[:, None], tq // ps]
-                    off = tq % ps
-                    si = jnp.arange(st)[:, None, None]
-                    kpool = kpool.at[si, page[None], off[None]].set(knew)
-                    vpool = vpool.at[si, page[None], off[None]].set(vnew)
+                    with T.shard_scope(mesh):
+                        st = kpool.shape[0]
+                        kc = kpool[:, table].reshape(st, S, Tlen,
+                                                     cfg.num_heads, hd)
+                        vc = vpool[:, table].reshape(st, S, Tlen,
+                                                     cfg.num_heads, hd)
+                        logits, _, _, knew, vnew = T.verify_step(
+                            params, cfg, toks, kc, vc, pos, w)
+                        tq = pos[:, None] + jnp.arange(K)[None, :]
+                        page = table[jnp.arange(S)[:, None], tq // ps]
+                        off = tq % ps
+                        si = jnp.arange(st)[:, None, None]
+                        kpool = kpool.at[si, page[None],
+                                         off[None]].set(knew)
+                        vpool = vpool.at[si, page[None],
+                                         off[None]].set(vnew)
                 tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return kpool, vpool, kdc, vdc, toks, tgt
 
@@ -561,6 +654,16 @@ class DecodeEngine:
         with self._cond:
             return self._params
 
+    def oracle_params(self):
+        """The serving tree AS AN OFFLINE ORACLE should see it: for a
+        sharded engine, a host copy — ``transformer.generate`` over
+        mesh-committed leaves would itself compile SPMD and is NOT the
+        single-device reference the twin contract pins against."""
+        p = self.params
+        if self._mesh is None:
+            return p
+        return jax.tree.map(np.asarray, p)
+
     def _check_tree(self, params) -> None:
         if jax.tree.structure(params) != self._ref_treedef:
             raise ValueError('swap_params: param tree structure differs '
@@ -598,9 +701,32 @@ class DecodeEngine:
             if getattr(self, '_ref_treedef', None) is not None:
                 self._check_tree(host_params)
             host_params = self._quantize(host_params)
+        if self._mesh is not None:
+            return self._shard_tree(host_params)
         return jax.tree.map(
             lambda h: h if isinstance(h, jax.Array)
             else jax.device_put(np.asarray(h)), host_params)
+
+    def _shard_tree(self, tree):
+        """Tensor-parallel placement of a (possibly quantized) param
+        tree: matmul weights column-shard their LAST axis over 'model'
+        (wq/wk/wv/wo/embed/head/w1/w2 — the layout transformer._rep's
+        all-gather boundaries assume), QuantLeaf scales co-shard with
+        their q (``quantize.shard_put``), and everything else — norms,
+        biases, non-dividing leaves — replicates onto the mesh so no
+        leaf stays committed to a lone device."""
+        mesh, tpn = self._mesh, self._tp
+
+        def one(name, leaf):
+            nd = getattr(leaf, 'ndim', 0)
+            if (name in quantize.LM_MATMUL_KEYS and 2 <= nd <= 3
+                    and leaf.shape[-1] % tpn == 0):
+                spec = (None,) * (nd - 1) + ('model',)
+            else:
+                spec = (None,) * nd
+            return quantize.shard_put(leaf, mesh, P(*spec))
+
+        return quantize._map_named(one, tree)
 
     def warm_params(self, params) -> None:
         placed = self.place_params(params)
@@ -650,6 +776,13 @@ class DecodeEngine:
         elif td != getattr(self, '_draft_placed_treedef', None):
             raise ValueError('swap_draft_params: tree structure differs '
                              'from the draft model')
+        if self._mesh is not None:
+            # replicated on the mesh (see the draft-cache placement)
+            rep = NamedSharding(self._mesh, P())
+            return jax.tree.map(
+                lambda h: jax.device_put(np.asarray(h) if not
+                                         isinstance(h, jax.Array) else h,
+                                         rep), host_params)
         return jax.tree.map(
             lambda h: h if isinstance(h, jax.Array)
             else jax.device_put(np.asarray(h)), host_params)
@@ -692,9 +825,11 @@ class DecodeEngine:
         pages, host_k_rows, host_v_rows).  Hits must cover every bucket-
         pad slot (``n_hit * ps >= w``) so the tail prefill only ever
         sees real queries, and always leave >= 1 tail token to
-        regenerate the last-position logits."""
+        regenerate the last-position logits (>= 2 when sharded: XLA
+        lowers a fully degenerate one-row-per-device dot differently,
+        so the twin contract excludes single-query tails)."""
         ps = self.page_size
-        max_hit = (s0b - 1) // ps
+        max_hit = (s0b - 1 - (self._mesh is not None)) // ps
         pages, hks, hvs = [], [], []
         for key in self._prefix_keys(padded, w, max_hit):
             ent = self._prefix.get(key)
@@ -807,7 +942,7 @@ class DecodeEngine:
         stay bitwise twins.  Returns the new ``n_hit``; memory-moves
         only, safe under the lock."""
         ps = self.page_size
-        max_hit = (s0b - 1) // ps
+        max_hit = (s0b - 1 - (self._mesh is not None)) // ps
         if n_hit >= max_hit:
             return n_hit
         keys = self._prefix_keys(padded, w, max_hit)
@@ -908,6 +1043,32 @@ class DecodeEngine:
             total += sum(l.nbytes for l in jax.tree.leaves(draft))
         return int(total)
 
+    def resident_bytes_per_device(self) -> list:
+        """Per-device split of :meth:`resident_bytes` for sharded
+        engines: one entry per mesh device, summed from each array's
+        ``addressable_shards`` (replicated leaves — norms, biases, the
+        draft — count their FULL bytes on EVERY device, matching what
+        the allocator actually holds there).  Unsharded engines return
+        the scalar as a one-entry vector so callers never branch.  The
+        sum over devices therefore EXCEEDS ``resident_bytes()`` exactly
+        by the replication overhead — the budgeter prices the max-
+        loaded device, not the sum."""
+        if self._mesh is None:
+            return [self.resident_bytes()]
+        with self._cond:
+            arrs = list(jax.tree.leaves(self._params))
+            arrs += [self._kpool, self._vpool]
+            if self._draft_cfg is not None:
+                arrs += [self._kdc, self._vdc]
+            if self._draft_params is not None:
+                arrs += list(jax.tree.leaves(self._draft_params))
+        per = {d.id: 0 for d in self._mesh.devices.flat}
+        for arr in arrs:
+            for sh in arr.addressable_shards:
+                if sh.device.id in per:
+                    per[sh.device.id] += sh.data.nbytes
+        return [per[d.id] for d in self._mesh.devices.flat]
+
     def kv_occupancy(self) -> Optional[Tuple[int, int]]:
         """``(host_bytes, disk_bytes)`` held by the tiered cache, or
         None when no tiers are attached — the fleet-report surface.
@@ -923,7 +1084,8 @@ class DecodeEngine:
     def busy(self) -> bool:
         with self._cond:
             return (any(s is not None for s in self._slots)
-                    or bool(self._joinq) or self._admitting > 0)
+                    or bool(self._joinq) or self._admitting > 0
+                    or bool(self._prefillq))
 
     def set_live_limits(self, max_slots: Optional[int] = None,
                         max_pages: Optional[int] = None):
@@ -972,22 +1134,54 @@ class DecodeEngine:
         """Batcher hand-off: admit each coalesced request into a slot
         (blocking for capacity up to its deadline).  The engine owns
         completion — per-request errors land on the request, never the
-        worker."""
+        worker.  With ``prefill_workers`` the hand-off is a queue push:
+        dedicated prefill threads run admission concurrently, so one
+        long cold prompt never heads-of-line-blocks the prompts behind
+        it in the same coalescing window."""
         for req in batch:
-            try:
-                self._admit(req)
-            except BaseException as e:  # typed per-request outcome
-                if isinstance(e, DeadlineExceededError):
-                    self.stats.inc('expired')
-                elif isinstance(e, RequestAbandonedError):
-                    self.stats.inc('abandoned')
-                elif isinstance(e, (DecodeSlotsExhaustedError,
-                                    DecodePagesExhaustedError)):
-                    self.stats.inc('shed_inadmissible')
-                else:
-                    self.stats.inc('engine_errors')
-                req.error = e
-                req.event.set()
+            queued = False
+            if self._prefill_threads:
+                with self._cond:
+                    if not self._closed:
+                        self._prefillq.append(req)
+                        self._cond.notify_all()
+                        queued = True
+            if not queued:
+                self._admit_one(req)
+
+    def _admit_one(self, req) -> None:
+        """Admit ONE request, converting failures into typed
+        per-request outcomes (never a raised exception — the caller
+        may be a batcher worker or a prefill thread)."""
+        try:
+            self._admit(req)
+        except BaseException as e:  # typed per-request outcome
+            if isinstance(e, DeadlineExceededError):
+                self.stats.inc('expired')
+            elif isinstance(e, RequestAbandonedError):
+                self.stats.inc('abandoned')
+            elif isinstance(e, (DecodeSlotsExhaustedError,
+                                DecodePagesExhaustedError)):
+                self.stats.inc('shed_inadmissible')
+            else:
+                self.stats.inc('engine_errors')
+            req.error = e
+            req.event.set()
+
+    def _prefill_worker(self) -> None:
+        """Dedicated prefill thread: pop queued requests and run the
+        full admission path (reserve -> prefill -> joinq).  After
+        close(), the queue drains through ``_admit_one`` so every
+        still-queued request fails typed (ServeError) instead of
+        hanging its waiter."""
+        while True:
+            with self._cond:
+                while not self._prefillq and not self._closed:
+                    self._cond.wait(0.05)
+                if not self._prefillq:
+                    return          # closed and drained
+                req = self._prefillq.popleft()
+            self._admit_one(req)
 
     def submit_direct(self, prompt, max_new: int = None,
                       temperature: float = 0.0, rng=None,
@@ -1534,10 +1728,20 @@ class DecodeEngine:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+        # dead programs must never be AOT-probed again: a later ledger
+        # sweep re-lowering a stale (possibly SPMD) skeleton after this
+        # engine's mesh is gone can crash the XLA client outright
+        for prog in (self._prog_step, self._prog_prefill,
+                     self._prog_tail, self._prog_spec):
+            prog.retire()
         if threading.current_thread() is self._loop:
             return False
+        ok = True
+        for t in self._prefill_threads:
+            t.join(timeout)
+            ok = not t.is_alive() and ok
         self._loop.join(timeout)
-        ok = not self._loop.is_alive()
+        ok = not self._loop.is_alive() and ok
         if self._kv is not None:
             ok = self._kv.close(timeout) and ok
         return ok
@@ -1561,6 +1765,10 @@ class DecodeEngine:
             self.stats.gauge('prefix_index_pages', len(self._prefix))
             self.stats.gauge('live_slot_cap', self._live_slot_cap)
             self.stats.gauge('live_page_cap', self._live_page_cap)
+            if self._prefill_threads:
+                self.stats.gauge('prefill_workers',
+                                 len(self._prefill_threads))
+                self.stats.gauge('prefill_queue', len(self._prefillq))
             if self._kv is not None:
                 self.kv_stats.gauge('pending_uploads',
                                     len(self._pending_uploads))
@@ -1574,6 +1782,10 @@ class DecodeEngine:
         if proposed:
             self.stats.gauge('spec_accept_rate',
                              self.stats.get('spec_accepted') / proposed)
+        if self._tp > 1:
+            self.stats.gauge('shard.tp', self._tp)
+            for i, b in enumerate(self.resident_bytes_per_device()):
+                self.stats.gauge(f'shard.resident_bytes[d{i}]', int(b))
         drift = self.budget_drift()
         if drift is not None:
             self.stats.gauge('budget_drift', round(drift, 4))
@@ -1664,7 +1876,8 @@ class DecodeService:
                  flash_decode=None, prefix_share: int = 0,
                  spec_k: int = 0, draft=None, kv_host_mb: int = 0,
                  kv_disk_mb: int = 0, kv_dir: Optional[str] = None,
-                 kv_share_dir: Optional[str] = None):
+                 kv_share_dir: Optional[str] = None, shard: str = '',
+                 prefill_workers: int = 0):
         from .batcher import DynamicBatcher
         stats = StatSet()
         self.engine = DecodeEngine(
@@ -1674,7 +1887,8 @@ class DecodeService:
             flash_decode=flash_decode, prefix_share=prefix_share,
             spec_k=spec_k, draft=draft, kv_host_mb=kv_host_mb,
             kv_disk_mb=kv_disk_mb, kv_dir=kv_dir,
-            kv_share_dir=kv_share_dir)
+            kv_share_dir=kv_share_dir, shard=shard,
+            prefill_workers=prefill_workers)
         # with prefix sharing on, admission prices each request at its
         # ACTUAL prefill cost (a hit is just its tail), so a coalescing
         # window full of hits admits everything while a burst of cold

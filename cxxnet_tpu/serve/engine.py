@@ -24,7 +24,9 @@ in three load-bearing ways:
 
 from __future__ import annotations
 
+import collections
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -36,9 +38,11 @@ from ..layers import ForwardContext
 from ..nnet import quantize
 from ..nnet.trainer import NetTrainer
 from ..parallel.mesh import batch_sharding
+from ..runtime.faults import (DeadlineExceededError, RequestAbandonedError,
+                              ServeError)
 from ..utils.bucketing import DEFAULT_BUCKETS, chunk_plan, pad_rows
 
-__all__ = ['PredictEngine']
+__all__ = ['PredictEngine', 'ReplicatedPredictEngine']
 
 
 def _as_4d(arr: np.ndarray) -> np.ndarray:
@@ -65,10 +69,16 @@ class PredictEngine:
 
     def __init__(self, trainer: NetTrainer,
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
-                 dtype: str = 'f32'):
+                 dtype: str = 'f32', device=None,
+                 program_name: str = 'serve.predict'):
         if trainer.net is None or trainer.params is None:
             raise ValueError('PredictEngine needs an initialized trainer '
                              '(init_model()/load_model() first)')
+        # pinned-device replica mode (ReplicatedPredictEngine): params
+        # and batches live whole on ONE device instead of sharding over
+        # the trainer mesh — the forward math is the identical program,
+        # only the placement differs
+        self._device = device
         # quantized-inference storage tier (serve.dtype, doc/serving.md
         # "Quantized inference"): bf16 halves / int8 roughly quarters the
         # RESIDENT param bytes; the compiled forward expands weights to
@@ -80,7 +90,7 @@ class PredictEngine:
                                                          for b in buckets)))
         if not self.buckets or self.buckets[0] <= 0:
             raise ValueError(f'bad bucket ladder {buckets!r}')
-        ddim = int(trainer._mesh.shape['data'])
+        ddim = 1 if device is not None else int(trainer._mesh.shape['data'])
         bad = [b for b in self.buckets if b % ddim]
         if bad:
             raise ValueError(
@@ -88,9 +98,11 @@ class PredictEngine:
                 f'devices); pick multiples so padded batches shard evenly')
         # compiler-truth ledger row per bucket (obs/programs.py): the
         # declared bound IS the bucket-ladder contract, so a caller
-        # bypassing the pad path trips the recompile sentinel
+        # bypassing the pad path trips the recompile sentinel.  Replicas
+        # name their own row (serve.predict.rN) — each compiles its own
+        # ladder, and folding them into one row would trip the bound
         from ..obs.programs import get_ledger
-        self._program = get_ledger().program('serve.predict',
+        self._program = get_ledger().program(program_name,
                                              bound=len(self.buckets))
         self.swap_count = 0
         self.version: object = 0
@@ -106,12 +118,19 @@ class PredictEngine:
         self._ref_treedef = jax.tree.structure(trainer.params)
         self._ref_shapes = [(l.shape, l.dtype)
                             for l in jax.tree.leaves(trainer.params)]
+        if device is None:
+            def _put0(h):
+                return h if isinstance(h, jax.Array) \
+                    else jax.device_put(np.asarray(h))
+        else:
+            def _put0(h):
+                return jax.device_put(np.asarray(h), device)
         if self.serve_dtype == 'f32':
-            self._params = trainer.params
+            self._params = (trainer.params if device is None
+                            else jax.tree.map(_put0, trainer.params))
         else:
             self._params = jax.tree.map(
-                lambda h: h if isinstance(h, jax.Array)
-                else jax.device_put(np.asarray(h)),
+                _put0,
                 quantize.quantize_tree(trainer.params, self.serve_dtype))
         self._params_treedef = jax.tree.structure(self._params)
         self._lock = threading.Lock()
@@ -200,9 +219,10 @@ class PredictEngine:
                 self._check_tree(host_params)
                 host_params = quantize.quantize_tree(host_params,
                                                      self.serve_dtype)
+            dev = self._device
             return jax.tree.map(
-                lambda h: h if isinstance(h, jax.Array)
-                else jax.device_put(np.asarray(h)), host_params)
+                lambda h: h if isinstance(h, jax.Array) and dev is None
+                else jax.device_put(np.asarray(h), dev), host_params)
         self._check_tree(host_params)
         if self._is_placed(host_params):
             return host_params   # already ours: skip the device round
@@ -281,7 +301,8 @@ class PredictEngine:
             # the wire dtype or a uint8 client would double the cache
             data = data.astype(np.float32)
         return jax.device_put(np.ascontiguousarray(data),
-                              batch_sharding(self.trainer._mesh))
+                              self._device if self._device is not None
+                              else batch_sharding(self.trainer._mesh))
 
     def warm(self) -> int:
         """Compile every bucket up front (cold-start cost paid at startup,
@@ -325,3 +346,264 @@ class PredictEngine:
         """Class id (argmax; raw value for single-score nets) per row —
         ``NetTrainer.predict`` semantics on the serving path."""
         return NetTrainer._pred_transform(self.predict_scores(data))
+
+
+class _FleetPlaced(list):
+    """Marker type: per-replica placed param trees (one per device) —
+    distinguishes a fleet placement from an arbitrary host tree in the
+    registry's place->warm->swap sequence."""
+
+
+class ReplicatedPredictEngine:
+    """Data-parallel ``PredictEngine`` replicas behind ONE batcher
+    (``serve.replicas=N``, doc/serving.md "Sharded serving").
+
+    Each replica pins the full param tree and its batches to one device
+    (``PredictEngine(device=...)``); coalesced batches round-robin
+    across replicas, so N windows execute concurrently instead of
+    serializing through the batcher worker.  The forward is the SAME
+    compiled program per replica — scores are independent of which
+    replica answered.
+
+    Hot swap is fleet-atomic: :meth:`swap_params` gates new dispatch,
+    drains every replica's queue and in-flight batch, then swaps all
+    replicas before traffic resumes — no window where two versions
+    answer concurrently.
+
+    Exposes the engine-owned-completion batcher protocol
+    (``execute_requests`` + ``buckets``), the budgeter surface
+    (``resident_bytes`` / ``busy``), and the per-device split
+    (``resident_bytes_per_device``) the fleet budgeter prices.
+    """
+
+    def __init__(self, trainer: NetTrainer,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 dtype: str = 'f32', replicas: int = 2, devices=None,
+                 stats=None):
+        n = int(replicas)
+        if n < 1:
+            raise ValueError('serve.replicas must be >= 1')
+        devs = list(devices) if devices is not None else jax.devices()
+        if n > len(devs):
+            raise ValueError(f'serve.replicas={n} exceeds the '
+                             f'{len(devs)} available devices')
+        self.engines = [
+            PredictEngine(trainer, buckets, dtype, device=devs[i],
+                          program_name=f'serve.predict.r{i}')
+            for i in range(n)]
+        self.buckets = self.engines[0].buckets
+        self.stats = stats
+        self._cond = threading.Condition()
+        # guarded-by: _cond (per-replica batch queues + dispatch state)
+        self._qs: List[collections.deque] = [collections.deque()
+                                             for _ in range(n)]
+        self._rr = 0
+        self._inflight = [0] * n
+        self._draining = False
+        self._closed = False
+        self._threads = []
+        for i in range(n):
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 daemon=True, name=f'cxxnet-replica-{i}')
+            t.start()
+            self._threads.append(t)
+
+    # -- batcher protocol (engine-owned completion) ------------------------
+    def execute_requests(self, batch) -> None:
+        """One coalesced window -> the next replica's queue (strict
+        round-robin; the batcher worker returns immediately).  A
+        draining swap gates NEW windows here — already-queued windows
+        keep flowing so the drain terminates under live traffic."""
+        with self._cond:
+            while self._draining and not self._closed:
+                self._cond.wait(0.05)
+            if self._closed:
+                raise ServeError('replicated engine is closed')
+            self._qs[self._rr].append(list(batch))
+            self._rr = (self._rr + 1) % len(self.engines)
+            self._cond.notify_all()
+
+    def _worker(self, i: int) -> None:
+        while True:
+            with self._cond:
+                while not self._qs[i] and not self._closed:
+                    self._cond.wait(0.05)
+                if self._closed:
+                    # fail queued windows typed instead of stranding
+                    # their waiters (mirrors the decode engine's close)
+                    while self._qs[i]:
+                        for r in self._qs[i].popleft():
+                            r.error = ServeError(
+                                'replicated engine is closed')
+                            r.event.set()
+                    return
+                batch = self._qs[i].popleft()
+                self._inflight[i] += 1
+            try:
+                self._run_batch(i, batch)
+            finally:
+                with self._cond:
+                    self._inflight[i] -= 1
+                    self._cond.notify_all()
+
+    def _run_batch(self, i: int, batch) -> None:
+        # same shed-then-forward discipline as the batcher's sync leg:
+        # a request that expired (or walked away) while queued must not
+        # ride the forward; single-owner counting lands HERE because
+        # completion is engine-owned
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if getattr(r, 'abandoned', False):
+                r.error = RequestAbandonedError(now - r.t_submit)
+                if self.stats is not None:
+                    self.stats.inc('abandoned')
+                r.event.set()
+            elif now >= r.deadline_abs:
+                r.error = DeadlineExceededError(
+                    r.deadline, now - r.t_submit, r.n)
+                if self.stats is not None:
+                    self.stats.inc('expired')
+                r.event.set()
+            else:
+                live.append(r)
+        if not live:
+            return
+        try:
+            data = (live[0].data if len(live) == 1 else
+                    np.concatenate([r.data for r in live], axis=0))
+            scores = self.engines[i].predict_scores(data)
+        except BaseException as e:   # surface faults per-request
+            for r in live:
+                if self.stats is not None:
+                    self.stats.inc('engine_errors')
+                r.error = e
+                r.event.set()
+            return
+        done = time.monotonic()
+        off = 0
+        for r in live:
+            r.result = scores[off:off + r.n]
+            off += r.n
+            if self.stats is not None:
+                self.stats.inc('requests')
+                self.stats.inc(f'replica_rows[r{i}]', r.n)
+                self.stats.observe('latency_ms', (done - r.t_submit) * 1e3)
+            r.event.set()
+
+    # -- fleet-atomic hot swap ---------------------------------------------
+    def place_params(self, host_params) -> '_FleetPlaced':
+        """Registry protocol: one placed tree PER replica (each pins
+        its own device) — the typed list keeps ``swap_params`` from
+        mistaking a fleet placement for a host tree."""
+        return _FleetPlaced(e.place_params(host_params)
+                            for e in self.engines)
+
+    def _as_fleet(self, params) -> '_FleetPlaced':
+        if isinstance(params, _FleetPlaced):
+            if len(params) != len(self.engines):
+                raise ValueError('fleet placement arity != replicas')
+            return params
+        return self.place_params(params)
+
+    def warm_params(self, params) -> None:
+        """Warm every replica's forward with the candidate tree BEFORE
+        the swap (registry warm->swap sequence, per device)."""
+        for e, p in zip(self.engines, self._as_fleet(params)):
+            e.warm_params(p)
+
+    def swap_params(self, params, version: object = None) -> None:
+        """Drain ALL replicas (queued + in-flight), swap every one,
+        then reopen dispatch — requests never observe a mixed-version
+        fleet."""
+        placed = self._as_fleet(params)   # device copies BEFORE the gate
+        with self._cond:
+            self._draining = True
+            while any(self._qs) or any(self._inflight):
+                self._cond.wait(0.05)
+        try:
+            for eng, p in zip(self.engines, placed):
+                eng.swap_params(p, version)
+        finally:
+            with self._cond:
+                self._draining = False
+                self._cond.notify_all()
+
+    # -- engine surface -----------------------------------------------------
+    @property
+    def swap_count(self) -> int:
+        return self.engines[0].swap_count
+
+    @property
+    def version(self):
+        return self.engines[0].version
+
+    @version.setter
+    def version(self, v) -> None:
+        for e in self.engines:
+            e.version = v
+
+    @property
+    def compile_count(self) -> int:
+        return sum(e.compile_count for e in self.engines)
+
+    def warm(self) -> int:
+        for e in self.engines:
+            e.warm()
+        return self.compile_count
+
+    def resident_bytes(self) -> int:
+        """Fleet total (every replica holds a full copy)."""
+        return sum(e.resident_bytes() for e in self.engines)
+
+    def resident_bytes_per_device(self) -> List[int]:
+        """One entry per replica device — what the budgeter prices
+        (max-loaded device), matching the sharded decode surface."""
+        return [e.resident_bytes() for e in self.engines]
+
+    def busy(self) -> bool:
+        with self._cond:
+            return any(self._inflight) or any(bool(q) for q in self._qs)
+
+    def capacity_view(self) -> dict:
+        with self._cond:
+            queued = sum(len(q) for q in self._qs)
+        return {'buckets': list(self.buckets),
+                'replicas': len(self.engines),
+                'compile_count': int(self.compile_count),
+                'resident_bytes': int(self.resident_bytes()),
+                'queued_windows': queued,
+                'busy': bool(self.busy())}
+
+    def predict_scores(self, data: np.ndarray) -> np.ndarray:
+        """Batcher-less sync path: round-robin one replica (waits out a
+        draining swap first, same no-mixed-version rule)."""
+        with self._cond:
+            while self._draining:
+                self._cond.wait(0.05)
+            i = self._rr
+            self._rr = (self._rr + 1) % len(self.engines)
+            self._inflight[i] += 1
+        try:
+            return self.engines[i].predict_scores(data)
+        finally:
+            with self._cond:
+                self._inflight[i] -= 1
+                self._cond.notify_all()
+
+    def predict(self, data: np.ndarray) -> np.ndarray:
+        return NetTrainer._pred_transform(self.predict_scores(data))
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        # retire the replicas' ledger rows: their device pins die with
+        # this fleet, and a later sweep must not AOT-probe them
+        for e in self.engines:
+            e._program.retire()
+        ok = True
+        for t in self._threads:
+            t.join(timeout)
+            ok = not t.is_alive() and ok
+        return ok

@@ -320,6 +320,9 @@ class MemoryBudgeter:
     """Device-memory ledger for a fleet of serving models.
 
     Tracks per-model resident bytes against a budget (0 = unbounded).
+    Models report either a scalar (single-device) or a per-device
+    vector (sharded tp:N / replicated fleets); the budget is read as
+    per-device HBM and ``over_budget()`` prices the MAX-loaded device.
     It does not free anything itself — :class:`MultiModelRegistry` asks
     it who is over budget and evicts; the split keeps the accounting
     unit-testable without engines."""
@@ -339,27 +342,57 @@ class MemoryBudgeter:
             prev, self.budget = self.budget, int(budget_bytes)
         return prev
 
-    def account(self, model_id: str, nbytes: int) -> None:
+    def account(self, model_id: str, nbytes) -> None:
+        """Ledger one model: a scalar (single-device engine — its whole
+        footprint sits on the default device) or a per-device vector
+        (sharded/replicated engines, ``resident_bytes_per_device()``).
+        Vectors index devices positionally; a scalar is device 0."""
         with self._lock:
-            self._resident[model_id] = int(nbytes)
+            if isinstance(nbytes, (list, tuple)):
+                self._resident[model_id] = tuple(int(b) for b in nbytes)
+            else:
+                self._resident[model_id] = int(nbytes)
 
     def release(self, model_id: str) -> int:
         with self._lock:
-            return self._resident.pop(model_id, 0)
+            ent = self._resident.pop(model_id, 0)
+            return sum(ent) if isinstance(ent, tuple) else ent
 
     def usage(self) -> int:
+        """Fleet-total resident bytes (every device summed)."""
         with self._lock:
-            return sum(self._resident.values())
+            return sum(sum(e) if isinstance(e, tuple) else e
+                       for e in self._resident.values())
+
+    def usage_per_device(self) -> List[int]:
+        """Per-device fleet load: vector entries add positionally,
+        scalars land on device 0.  The widest vector sets the length."""
+        with self._lock:
+            n = max((len(e) for e in self._resident.values()
+                     if isinstance(e, tuple)), default=1)
+            out = [0] * n
+            for e in self._resident.values():
+                if isinstance(e, tuple):
+                    for i, b in enumerate(e):
+                        out[i] += b
+                else:
+                    out[0] += e
+            return out
 
     def resident(self) -> Dict[str, int]:
         with self._lock:
-            return dict(self._resident)
+            return {k: (sum(e) if isinstance(e, tuple) else e)
+                    for k, e in self._resident.items()}
 
     def over_budget(self) -> int:
-        """Bytes past the budget (0 when inside it or unbounded)."""
+        """Bytes past the budget on the MAX-loaded device (0 inside it
+        or unbounded).  The budget is per-device HBM: a tp:4 engine
+        spreading 1GB over 4 chips prices ~256MB + replication, not
+        1GB — and for scalar-only fleets (everything on device 0) the
+        max device IS the old fleet sum, so nothing shifts."""
         if self.budget <= 0:
             return 0
-        return max(0, self.usage() - self.budget)
+        return max(0, max(self.usage_per_device()) - self.budget)
 
 
 class _ManagedModel:
@@ -559,8 +592,11 @@ class MultiModelRegistry:
 
     def _load(self, entry: _ManagedModel) -> None:  # requires-lock: _lock
         entry.engine = entry.factory()
-        self.budgeter.account(entry.model_id,
-                              int(entry.engine.resident_bytes()))
+        per_dev = getattr(entry.engine, 'resident_bytes_per_device', None)
+        self.budgeter.account(
+            entry.model_id,
+            per_dev() if per_dev is not None
+            else int(entry.engine.resident_bytes()))
         if entry.model_dir is not None:
             entry.registry = ModelRegistry(
                 entry.engine, entry.model_dir, current=entry.current,
@@ -709,6 +745,10 @@ class MultiModelRegistry:
         from ..utils.metric import StatSet
         stats = StatSet() if stats is None else stats
         stats.gauge('resident_bytes', self.budgeter.usage())
+        per_dev = self.budgeter.usage_per_device()
+        if len(per_dev) > 1:  # sharded fleet — per-device load vector
+            for i, b in enumerate(per_dev):
+                stats.gauge(f'resident_bytes[d{i}]', int(b))
         stats.gauge('budget_bytes', self.budgeter.budget)
         stats.gauge('models_loaded', len(self.loaded()))
         stats.gauge('models_total', len(self.models()))

@@ -200,7 +200,8 @@ class Net:
     def serve_start(self, buckets='1,8,32', max_queue: int = 64,
                     max_wait: float = 0.002, deadline: float = 1.0,
                     warm: bool = True, models=None,
-                    mem_budget: int = 0, dtype: str = 'f32') -> None:
+                    mem_budget: int = 0, dtype: str = 'f32',
+                    replicas: int = 0) -> None:
         """Stand up the serving stack over this net's loaded params: a
         bucketed ``PredictEngine`` plus a ``DynamicBatcher``.  Call once;
         ``serve_stop()`` tears down (and must precede a restart).
@@ -213,19 +214,30 @@ class Net:
         ``dtype`` selects the quantized-inference storage tier
         (``f32``/``bf16``/``int8`` — doc/serving.md "Quantized
         inference"); it applies to this engine AND every fleet sibling,
-        so the ``mem_budget`` ledger fits ~4x more int8 models."""
-        from .serve import DynamicBatcher, PredictEngine
+        so the ``mem_budget`` ledger fits ~4x more int8 models.
+        ``replicas>=2`` serves N per-device data-parallel engine
+        replicas behind the one batcher (``serve.replicas``,
+        doc/serving.md "Sharded serving")."""
+        from .serve import (DynamicBatcher, PredictEngine,
+                            ReplicatedPredictEngine)
         from .utils.bucketing import parse_buckets
         if self._batcher is not None:
             raise RuntimeError('serving already started; serve_stop() first')
         tr = self._require()
         bks = parse_buckets(buckets) if isinstance(buckets, str) \
             else tuple(buckets)
-        self._engine = PredictEngine(tr, bks, dtype=dtype)
+        if replicas >= 2:
+            from .utils.metric import StatSet
+            self._engine = ReplicatedPredictEngine(
+                tr, bks, dtype=dtype, replicas=replicas, stats=StatSet())
+        else:
+            self._engine = PredictEngine(tr, bks, dtype=dtype)
         if warm:
             self._engine.warm()
         self._batcher = DynamicBatcher(self._engine, max_queue=max_queue,
-                                       max_wait=max_wait, deadline=deadline)
+                                       max_wait=max_wait, deadline=deadline,
+                                       stats=getattr(self._engine, 'stats',
+                                                     None))
         self._fleet = None
         if models:
             from .serve import MultiModelRegistry
@@ -317,6 +329,8 @@ class Net:
         if self._batcher is not None:
             self._batcher.close(timeout)
             self._batcher = None
+        if self._engine is not None and hasattr(self._engine, 'close'):
+            self._engine.close(timeout)   # replica worker threads
         if self._fleet is not None:
             self._fleet.close(timeout)
             self._fleet = None
@@ -566,7 +580,9 @@ class LMServe:
     target's; doc/serving.md "Speculative decoding"), and the graftcache
     KV tiers ``kv_host_mb`` / ``kv_disk_mb`` / ``kv_dir`` /
     ``kv_share_dir`` (doc/serving.md "Tiered KV cache"; tiers need
-    ``prefix_share`` on)."""
+    ``prefix_share`` on), plus graftshard's ``shard=tp:N`` tensor-
+    parallel decode and ``prefill_workers=N`` disaggregated prefill
+    (doc/serving.md "Sharded serving")."""
 
     def __init__(self, svc):
         self.svc = svc
@@ -594,7 +610,8 @@ class LMServe:
                  'stages': 'num_stages', 'experts': 'num_experts',
                  'seq': 'seq_len'}
         ints = ('slots', 'pages', 'page_size', 'max_prompt', 'max_queue',
-                'prefix_share', 'spec_k', 'kv_host_mb', 'kv_disk_mb')
+                'prefix_share', 'spec_k', 'kv_host_mb', 'kv_disk_mb',
+                'prefill_workers')
         for key, val in parse_kv_list(cfg or ''):
             if key in names:
                 cfg_kw[names[key]] = int(val)
@@ -616,6 +633,8 @@ class LMServe:
                 svc_kw['flash_decode'] = val
             elif key in ('kv_dir', 'kv_share_dir'):
                 svc_kw[key] = val
+            elif key == 'shard':
+                svc_kw['shard'] = val
             elif key.startswith('draft.'):
                 has_draft = True
                 sub = key[len('draft.'):]

@@ -123,6 +123,14 @@ def pytest_configure(config):
         '(tier-1: runs under -m "not slow"; select with -m dist)')
     config.addinivalue_line(
         'markers',
+        'shard: graftshard suite — mesh-sharded decode serving '
+        '(serve.shard=tp:N head-sharded params + KV pool, bitwise '
+        'stream twins at every shard count), disaggregated prefill '
+        'workers, data-parallel PredictEngine replicas, per-device '
+        'budgeter/gauge reconciliation; CPU-only (8 virtual devices; '
+        'tier-1: runs under -m "not slow"; select with -m shard)')
+    config.addinivalue_line(
+        'markers',
         'kv_tier: graftcache suite — tiered KV prefix cache (HBM page '
         'pool -> bounded host RAM -> crc32-digested disk records), '
         'demote/promote bitwise stream twins, LRU + byte-budget '
@@ -138,7 +146,8 @@ def pytest_configure(config):
 # line on lifecycle
 _PIPELINE_THREAD_PREFIXES = ('cxxnet-tb-', 'cxxnet-pool-', 'cxxnet-decode-',
                              'cxxnet-elastic-', 'cxxnet-obs-',
-                             'cxxnet-scale-', 'cxxnet-kv-')
+                             'cxxnet-scale-', 'cxxnet-kv-',
+                             'cxxnet-prefill-', 'cxxnet-replica-')
 
 
 def _pipeline_threads():

@@ -104,6 +104,24 @@ def test_distinct_signatures_row_separately(ledger):
     assert ledger.summary()['compiles_total'] == 2
 
 
+def test_retired_program_skips_lazy_aot_probe(ledger):
+    """An engine retiring its programs on close must stop the lazy AOT
+    sweep from re-lowering its (possibly SPMD) skeletons: retired
+    entries keep their rows but read zero compiler truth, and a full
+    ``entries()`` sweep afterwards adds no compile cost."""
+    import jax.numpy as jnp
+    prog = ledger.program('t.retired')
+    fn = prog.jit(lambda a: a + 1)
+    fn(jnp.ones((8,)))                   # records, analysis still lazy
+    prog.retire()
+    rows = ledger.entries()              # sweep: must NOT probe t.retired
+    (e,) = [r for r in rows if r.name == 't.retired']
+    assert e.compiles == 1               # the row survives retirement
+    assert e.flops == 0 and e.compile_ms == 0
+    assert ledger.summary()['compile_ms_total'] == 0
+    prog.retire()                        # idempotent
+
+
 def test_reclaimed_name_gets_suffix(ledger):
     a = ledger.program('serve.predict')
     b = ledger.program('serve.predict')

@@ -207,6 +207,78 @@ def expand_kv_tiers(p: dict, name: str) -> Tuple[List[str], List[dict]]:
     return errs, synth
 
 
+SHARD_METRIC = 'decode_shard_scaling'
+
+#: the graftshard capacity thesis: at 4 devices (fixed per-device page
+#: budget, slots scaling with the mesh) aggregate decode tokens/sec
+#: must beat the single-device leg by at least this factor
+SHARD_MIN_SCALING = 1.5
+
+
+def expand_sharded(p: dict, name: str) -> Tuple[List[str], List[dict]]:
+    """Validate one ``decode_shard_scaling`` payload and expand its
+    per-width legs + disaggregation A/B into synthetic payloads."""
+    errs: List[str] = []
+    synth: List[dict] = []
+    plat = p.get('platform')
+    legs = p.get('legs')
+    if not isinstance(legs, list) or len(legs) < 2:
+        return [f'{name}: shard receipt carries '
+                f'{len(legs) if isinstance(legs, list) else 0} '
+                'width legs (need >= 2)'], []
+    for leg in legs:
+        tp = leg.get('tp', '?')
+        if leg.get('twin_checked') != leg.get('streams'):
+            errs.append(
+                f'{name}: tp:{tp} leg twin-checked '
+                f'{leg.get("twin_checked")} of {leg.get("streams")} '
+                'streams — every stream must be twin-asserted in-bench')
+        per = leg.get('resident_bytes_per_device')
+        if not (isinstance(per, list) and len(per) == tp
+                and all(isinstance(b, int) and b > 0 for b in per)):
+            errs.append(f'{name}: tp:{tp} leg resident_bytes_per_device'
+                        f'={per!r} does not ledger {tp} devices')
+        synth.append({'metric': f'shard_tp{tp}_tokens_per_sec',
+                      'value': leg.get('tokens_per_sec'),
+                      'unit': 'tokens/sec', 'platform': plat})
+    if p.get('twin_violations') != 0:
+        errs.append(f'{name}: twin_violations='
+                    f'{p.get("twin_violations")} (must be 0)')
+    value = p.get('value')
+    if legs[-1].get('tp') == 4 and not (
+            isinstance(value, (int, float))
+            and value >= SHARD_MIN_SCALING):
+        errs.append(f'{name}: decode_shard_scaling {value} is below '
+                    f'the {SHARD_MIN_SCALING}x claim the receipt '
+                    'exists for')
+    disagg = p.get('disagg')
+    if not isinstance(disagg, dict):
+        errs.append(f'{name}: shard receipt has no disaggregation A/B')
+    else:
+        for leg_name in ('off', 'on'):
+            leg = disagg.get(leg_name)
+            if not isinstance(leg, dict):
+                errs.append(f'{name}: disagg A/B has no {leg_name!r} '
+                            'leg')
+                continue
+            if leg.get('twin_checked') != leg.get('streams'):
+                errs.append(
+                    f'{name}: disagg {leg_name} leg twin-checked '
+                    f'{leg.get("twin_checked")} of '
+                    f'{leg.get("streams")} streams')
+            synth.append({
+                'metric': f'shard_disagg_{leg_name}_short_ttft_p99_ms',
+                'value': leg.get('short_ttft_p99_ms'), 'unit': 'ms',
+                'platform': plat})
+        imp = disagg.get('short_ttft_improvement')
+        if not (isinstance(imp, (int, float)) and imp > 1.0):
+            errs.append(f'{name}: disaggregation did not improve '
+                        f'short-stream TTFT p99 (improvement={imp}) — '
+                        'admission past the head-of-line blocker is the '
+                        'claim the knob exists for')
+    return errs, synth
+
+
 def check_file(path: str) -> Tuple[List[str], List[dict]]:
     """(errors, payloads) for one receipt file."""
     name = os.path.basename(path)
@@ -231,6 +303,10 @@ def check_file(path: str) -> Tuple[List[str], List[dict]]:
         elif p.get('metric') == KV_METRIC:
             k_errs, synth = expand_kv_tiers(p, name)
             errs.extend(k_errs)
+            extra.extend(synth)
+        elif p.get('metric') == SHARD_METRIC:
+            s_errs, synth = expand_sharded(p, name)
+            errs.extend(s_errs)
             extra.extend(synth)
     return errs, loads + extra
 
