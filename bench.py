@@ -1700,6 +1700,319 @@ eval_train = 0
     return 0 if err <= 0.02 else 1
 
 
+def bench_autotune() -> int:
+    """grafttune A/B (doc/autotune.md): run the two-stage search on TWO
+    bench modes — the supervised train scan and serve decode — then
+    re-measure the tuned config against the hand-tuned default with
+    fresh state, so the headline speedup is an independent measurement,
+    not the search's own probe replayed.  The receipt stamps the full
+    search story (declared budget vs wall, stage-1 ledger pruning
+    counts, every probe) plus an in-receipt recompile-storm-guard
+    drill: an online TuneController driven through a verdict sequence
+    that would thrash a bucket ladder, against a ledger program with a
+    tight ``obs.recompile`` bound — green means zero
+    ``RecompileStormError`` records and total compiles under both the
+    program's bound and the space's declared compile budget."""
+    import jax
+
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.models import transformer as TT
+    from cxxnet_tpu.nnet import execution
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.obs.programs import get_ledger
+    from cxxnet_tpu.runtime import faults
+    from cxxnet_tpu.serve.decode import DecodeService
+    from cxxnet_tpu.tune import (LedgerGate, TuneController, TuneSearch,
+                                 TuneSpace)
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    plat = jax.devices()[0].platform
+    led = get_ledger()
+
+    # ---- leg 1: train scan (steps_per_dispatch) --------------------------
+    batch_size = _bench_batch(32)
+    n_probe = int(os.environ.get('CXXNET_TUNE_PROBE_STEPS', '32'))
+    conf = _SCAN_MLP + f'batch_size = {batch_size}\n' + _extra_conf()
+    rng = np.random.RandomState(0)
+    centers = rng.randn(16, 256).astype(np.float32) * 2
+    batches = []
+    for _ in range(n_probe):
+        y = rng.randint(0, 16, batch_size)
+        x = centers[y] + 0.3 * rng.randn(batch_size, 256).astype(np.float32)
+        batches.append(DataBatch(x.reshape(batch_size, 1, 1, 256),
+                                 y[:, None].astype(np.float32)))
+
+    search_trainer = NetTrainer(parse_config_string(conf))
+    search_trainer.init_model()
+    # warm-up at the baseline K fills the ledger — stage 1 prices every
+    # candidate from THIS program's compiler truth
+    execution.measured_probe(search_trainer, 1, batches, repeats=1)
+    led.ensure_analyzed_batch()
+    base_bytes = max(
+        (e.peak_bytes or (e.argument_bytes + e.output_bytes
+                          + e.temp_bytes))
+        for e in led.entries())
+    # the declared ceiling comes FROM the measured baseline footprint:
+    # ~5x headroom-adjusted means the k=8 rung (pricing 8x) cannot fit
+    # and must be pruned by the ledger, never measured
+    scan_mem_mb = base_bytes * 5.0 / (1 << 20)
+    scan_spec = (f'knobs=steps_per_dispatch:1..8;mode=train;budget=60;'
+                 f'seed=0;probe_steps={n_probe};probe_repeats=3;'
+                 f'mem_mb={scan_mem_mb:.3f}')
+    scan_space = TuneSpace.parse(scan_spec)
+    scan_base = {'steps_per_dispatch': 1}
+    scan_gate = LedgerGate(
+        base_bytes=float(base_bytes),
+        ceiling_bytes=scan_space.mem_mb * (1 << 20)
+        * (1.0 - scan_space.headroom),
+        baseline=scan_base, mem_knobs=scan_space.mem_knobs())
+    scan_res = TuneSearch(
+        scan_space,
+        lambda c: execution.measured_probe(
+            search_trainer, c['steps_per_dispatch'], batches,
+            repeats=scan_space.probe_repeats),
+        gate=scan_gate, baseline=scan_base).run('train')
+    k_tuned = scan_res.best['steps_per_dispatch']
+
+    # independent A/B: fresh trainers, the tuned K vs the default K —
+    # and the bitwise-twin contract: both legs dispatch the same batches
+    # the same number of times, so final params must be IDENTICAL (a
+    # tuned config may move knobs, never the math)
+    def scan_leg(k):
+        tr = NetTrainer(parse_config_string(conf))
+        tr.init_model()
+        rate = execution.measured_probe(tr, k, batches, repeats=4)
+        return rate, tr
+
+    rate_default, t_def = scan_leg(1)
+    rate_tuned, t_tuned = scan_leg(k_tuned)
+    scan_best = dict(scan_res.best)
+    scan_fallback = False
+    if k_tuned == 1:
+        # the search kept the hand-set default: identical configs are
+        # 1.0x by definition — the re-measure only adds noise
+        rate_tuned = rate_default
+    elif rate_tuned < rate_default:
+        # validation gate: a tuned config the independent re-measure
+        # cannot confirm is never shipped — fall back to the default
+        # (the same >=baseline contract the search itself keeps)
+        scan_best = dict(scan_base)
+        rate_tuned = rate_default
+        scan_fallback = True
+    scan_bitwise = all(
+        np.array_equal(np.asarray(t_def.params[lk][fk]),
+                       np.asarray(t_tuned.params[lk][fk]))
+        for lk, fields in t_def.params.items() for fk in fields)
+    if not scan_bitwise:
+        raise AssertionError(
+            'tuned scan leg diverged bitwise from the per-step leg — '
+            'the autotuner may move knobs, never the math')
+    scan_speedup = rate_tuned / rate_default
+
+    # ---- leg 2: serve decode (slots x pages) -----------------------------
+    cfg = TT.TransformerConfig(vocab_size=64, d_model=32, num_heads=2,
+                               d_ff=64, num_stages=1, seq_len=128,
+                               attn='local')
+    params = TT.init_params(np.random.RandomState(0), cfg)
+    max_prompt, max_new = 12, 16
+    n_req = int(os.environ.get('CXXNET_TUNE_REQUESTS', '16'))
+    dec_base = {'slots': 2, 'pages': 16}
+
+    def build_svc(cand):
+        return DecodeService(
+            params, cfg, slots=cand['slots'], pages=cand['pages'],
+            page_size=8, max_prompt=max_prompt, max_new_bound=max_new,
+            eos_id=None, max_queue=64, max_wait=0.002, deadline=60.0)
+
+    def dec_prompts(seed):
+        prng = np.random.RandomState(seed)
+        return [prng.randint(0, cfg.vocab_size,
+                             (1, int(prng.randint(1, max_prompt))))
+                .astype(np.int32) for _ in range(n_req)]
+
+    def dec_rate(svc, reps):
+        prompts = dec_prompts(0)
+
+        def one_pass():
+            t0 = time.perf_counter()
+            reqs = [svc.submit_async(p, max_new, 0.0, None)
+                    for p in prompts]
+            toks = sum(len(svc.batcher.wait(r)) for r in reqs)
+            return toks / max(1e-9, time.perf_counter() - t0)
+
+        one_pass()                       # compile off the clock
+        return max(one_pass() for _ in range(reps))
+
+    # baseline engine warm-up: its resident footprint is the stage-1
+    # base price for every candidate's slots/pages scaling
+    svc0 = build_svc(dec_base)
+    try:
+        dec_base_bytes = float(svc0.engine.resident_bytes())
+    finally:
+        svc0.close(30.0)
+    dec_mem_mb = dec_base_bytes * 5.0 / (1 << 20)
+    dec_spec = (f'knobs=slots:1..8,pages:8..32;mode=decode;budget=120;'
+                f'seed=0;probe_steps={n_req};probe_repeats=2;'
+                f'max_probes=6;mem_mb={dec_mem_mb:.3f}')
+    dec_space = TuneSpace.parse(dec_spec)
+    dec_gate = LedgerGate(
+        base_bytes=dec_base_bytes,
+        ceiling_bytes=dec_space.mem_mb * (1 << 20)
+        * (1.0 - dec_space.headroom),
+        baseline=dec_base, mem_knobs=dec_space.mem_knobs(),
+        feasible=lambda c: ('fewer KV pages than decode slots'
+                            if c['pages'] < c['slots'] else None))
+
+    def dec_probe(cand):
+        svc = build_svc(cand)
+        try:
+            return dec_rate(svc, dec_space.probe_repeats)
+        finally:
+            svc.close(30.0)
+
+    dec_res = TuneSearch(dec_space, dec_probe, gate=dec_gate,
+                         baseline=dec_base).run('decode')
+
+    # independent A/B re-measure + the stream-twin contract on the
+    # tuned engine: every served stream equals its offline generate
+    def dec_leg(cand, twin):
+        svc = build_svc(cand)
+        try:
+            rate = dec_rate(svc, 4)
+            twin_ok = True
+            if twin:
+                for p in dec_prompts(0)[:2]:
+                    got = svc.batcher.wait(
+                        svc.submit_async(p, max_new, 0.0, None))
+                    off = np.asarray(TT.generate(
+                        svc.engine.oracle_params(), p, max_new,
+                        svc.engine.cfg, temperature=0.0,
+                        rng=None, eos_id=None))[0]
+                    twin_ok = twin_ok and \
+                        (np.asarray(got) == off[:len(got)]).all()
+            return rate, twin_ok
+        finally:
+            svc.close(30.0)
+
+    dec_rate_default, _ = dec_leg(dec_base, twin=False)
+    dec_rate_tuned, dec_twin = dec_leg(dec_res.best, twin=True)
+    dec_best = dict(dec_res.best)
+    dec_fallback = False
+    if dec_res.best == dec_base:
+        dec_rate_tuned = dec_rate_default
+    elif dec_rate_tuned < dec_rate_default:
+        dec_best = dict(dec_base)
+        dec_rate_tuned = dec_rate_default
+        dec_fallback = True
+    if not dec_twin:
+        raise AssertionError(
+            'tuned decode engine broke the stream-twin contract')
+    dec_speedup = dec_rate_tuned / dec_rate_default
+
+    # ---- in-receipt recompile-storm guard drill --------------------------
+    drill_space = TuneSpace.parse(
+        'knobs=slots:1..8;mode=decode;budget=5;compile_budget=4')
+    drill_log = faults.FailureLog()
+    storm_before = len(faults.global_failure_log().records(
+        'RecompileStormError'))
+    prog = led.program('tune.storm_drill', bound=2)
+    drill_fn = prog.jit(lambda x: x * 2.0,
+                        key_fn=lambda a, _k: f's{a[0].shape[0]}')
+
+    ctl = TuneController(
+        drill_space, verdicts=lambda: {'v': {'state': 'BREACHED'}},
+        gauges=lambda: {'hbm.headroom_frac[d0]': 0.01},
+        failure_log=drill_log, hysteresis=1, cooldown=0.0)
+    # every re-plan really recompiles: each slot count is a new shape
+    # through a bound ledger program — exactly the bucket-ladder thrash
+    # the guard exists for
+    ctl.bind('slots', lambda v: drill_fn(np.zeros((max(1, v),),
+                                                  np.float32)),
+             8, program=prog)
+    for _ in range(8):                   # a thrashing verdict stream
+        ctl.evaluate()
+    storm_errors = (len(faults.global_failure_log().records(
+        'RecompileStormError')) - storm_before) \
+        + len(drill_log.records('RecompileStormError'))
+    vetoes = int(ctl.stats.get('recompile_vetoes'))
+    drill_ok = (storm_errors == 0 and vetoes >= 1
+                and ctl.compiles() <= drill_space.compile_budget
+                and prog.compiles <= prog.bound)
+    if not drill_ok:
+        raise AssertionError(
+            f'storm-guard drill failed: storm_errors={storm_errors} '
+            f'vetoes={vetoes} compiles={ctl.compiles()} '
+            f'program={prog.compiles}/{prog.bound}')
+
+    def search_block(res, space):
+        return {'spec': space.describe(), 'budget_s': space.budget,
+                'wall_s': round(res.wall_s, 3),
+                'budget_honored': res.budget_honored,
+                'stage1_candidates': res.stage1_candidates,
+                'stage1_pruned': res.stage1_pruned,
+                'measured': res.measured, 'failed': res.failed}
+
+    payload = {
+        'metric': 'autotune_speedup',
+        # the headline is the WORSE of the two modes: the claim is
+        # "tuned beats the hand-set default everywhere", not on average
+        'value': round(min(scan_speedup, dec_speedup), 4),
+        'unit': 'x',
+        'platform': plat,
+        'vs_baseline': None,
+        'modes': {
+            'scan': {
+                'speedup': round(scan_speedup, 4),
+                'default': scan_base, 'tuned': scan_best,
+                'fallback_to_default': scan_fallback,
+                'default_steps_per_sec': round(rate_default, 2),
+                'tuned_steps_per_sec': round(rate_tuned, 2),
+                'bitwise_equal': bool(scan_bitwise),
+                'search': search_block(scan_res, scan_space),
+            },
+            'decode': {
+                'speedup': round(dec_speedup, 4),
+                'default': dec_base, 'tuned': dec_best,
+                'fallback_to_default': dec_fallback,
+                'default_tokens_per_sec': round(dec_rate_default, 2),
+                'tuned_tokens_per_sec': round(dec_rate_tuned, 2),
+                'stream_twins': bool(dec_twin),
+                'search': search_block(dec_res, dec_space),
+            },
+        },
+        'search': {
+            'budget_s': scan_space.budget + dec_space.budget,
+            'wall_s': round(scan_res.wall_s + dec_res.wall_s, 3),
+            'budget_honored': bool(scan_res.budget_honored
+                                   and dec_res.budget_honored),
+            'stage1_candidates': (scan_res.stage1_candidates
+                                  + dec_res.stage1_candidates),
+            'stage1_pruned': (scan_res.stage1_pruned
+                              + dec_res.stage1_pruned),
+            'measured': scan_res.measured + dec_res.measured,
+        },
+        'storm_guard': {
+            'replans': ctl.status_view()['replans'],
+            'vetoes': vetoes,
+            'compiles': ctl.compiles(),
+            'compile_budget': drill_space.compile_budget,
+            'program_compiles': prog.compiles,
+            'program_bound': prog.bound,
+            'storm_errors': storm_errors,
+        },
+        'batch': batch_size,
+        'requests': n_req,
+        'programs': _program_summary(),
+        'receipt_file': 'BENCH_TUNE_r01.json',
+        'timing': 'speedups are independent re-measures (fresh state, '
+                  'best-of-3) of tuned vs default, not the search\'s '
+                  'own probes; scan legs bitwise-assert final params',
+    }
+    _write_receipt_file(payload)
+    _emit(payload)
+    return 0 if min(scan_speedup, dec_speedup) >= 1.0 else 1
+
+
 _MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
           'inception_bn': ('inception_bn_images_per_sec_per_chip',
                            bench_inception_bn),
@@ -1718,7 +2031,8 @@ _MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
           'mnist_tta': ('mnist_time_to_2pct_error', bench_mnist_tta),
           'transformer': ('transformer_tokens_per_sec_per_chip',
                           bench_transformer),
-          'decode': ('decode_tokens_per_sec_per_chip', bench_decode)}
+          'decode': ('decode_tokens_per_sec_per_chip', bench_decode),
+          'autotune': ('autotune_speedup', bench_autotune)}
 
 
 #: ledger metrics whose ``cpu-fallback`` receipts a real-TPU run can
@@ -1740,6 +2054,10 @@ _HEALABLE = {
     # host — the tp:N wall-clock ratio is a capacity/batching proxy;
     # real per-chip scaling needs real chips
     'decode_shard_scaling': ('bench_serve.py', 'sharded'),
+    # BENCH_TUNE_r01: on cpu the scan win is dispatch-overhead-only and
+    # the decode batching curve is host-bound — the tuned-choice story
+    # deserves a real chip's cost surface
+    'autotune_speedup': ('bench.py', 'autotune'),
 }
 
 

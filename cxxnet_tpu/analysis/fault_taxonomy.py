@@ -32,7 +32,8 @@ RULES = ('fault-taxonomy',)
 #: the coordinator/client/supervisor stack would fall outside the
 #: RECOVERABLE set and turn a drillable host loss into a dead run.
 TARGET_DIRS = ('cxxnet_tpu/runtime/', 'cxxnet_tpu/serve/',
-               'cxxnet_tpu/online/', 'cxxnet_tpu/parallel/')
+               'cxxnet_tpu/online/', 'cxxnet_tpu/parallel/',
+               'cxxnet_tpu/tune/')
 
 FAULTS_MODULE = 'cxxnet_tpu/runtime/faults.py'
 

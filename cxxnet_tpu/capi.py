@@ -227,6 +227,14 @@ def net_obs_programs(net: Net) -> str:
     return net.obs_programs()
 
 
+def net_autotune(net: Net, spec: str, probe_fn, task: str = 'train') -> str:
+    """Run the grafttune search over ``spec`` with the embedding's
+    measured probe (``probe_fn(candidate_dict) -> score``, higher
+    better) and return the JSON receipt; ``best`` holds the tuned knobs
+    (doc/autotune.md)."""
+    return net.autotune(spec, probe_fn, task=task)
+
+
 # ---- train-while-serve surface (CXNNetOnline*) ----------------------------
 
 def net_online_start(net: Net, it: DataIter, cfg: str) -> None:

@@ -65,6 +65,13 @@ class LearnTask:
         self.scan_strict = 0           # 1 = a demotion raises
                                        # ScanStrictError instead of
                                        # silently falling back per-step
+        # grafttune: task=autotune searches this declared space
+        # (doc/autotune.md); parsed at init so a bad spec fails fast
+        self.autotune = ''
+        self._tune_space = None
+        self._data_itcfg = None        # captured data-section config so
+        self._data_defcfg = []         # the tuner can rebuild the train
+                                       # iterator at a candidate nworker
         self.extract_node_name = ''
         self.name_pred = 'pred.txt'
         self.output_format = 1
@@ -238,6 +245,7 @@ class LearnTask:
             'online.freshness_strict': ('online_freshness_strict', int),
             'online.reload': ('online_reload', float),
             'online.qps': ('online_qps', float),
+            'autotune': ('autotune', str),
         }
         if name in simple:
             attr, typ = simple[name]
@@ -445,6 +453,9 @@ class LearnTask:
                                                    'serve'):
                     assert self.itr_train is None, 'can only have one data'
                     self.itr_train = create_iterator(itcfg)
+                    # grafttune nworker probes rebuild this iterator at
+                    # candidate worker counts (doc/autotune.md)
+                    self._data_itcfg = list(itcfg)
                 if flag == 2 and self.task not in ('pred', 'pred_raw',
                                                    'serve'):
                     self.itr_evals.append(create_iterator(itcfg))
@@ -460,6 +471,7 @@ class LearnTask:
                 defcfg.append((name, val))
             else:
                 itcfg.append((name, val))
+        self._data_defcfg = list(defcfg)
         for it in ([self.itr_train] if self.itr_train else []) + \
                 ([self.itr_pred] if self.itr_pred else []) + self.itr_evals:
             for name, val in defcfg:
@@ -467,6 +479,18 @@ class LearnTask:
             it.init()
 
     def init(self) -> None:
+        if self.task == 'autotune':
+            # parse the space NOW so a malformed spec fails at init like
+            # a bad slo.*/scenario spec, not mid-search
+            from .tune import TuneSpace
+            self._tune_space = TuneSpace.parse(self.autotune)
+            if self._tune_space.mode == 'decode':
+                # decode candidates build their own engines from the
+                # serve.lm spec — no netconfig model, like serve decode
+                self._create_iterators()
+                return
+            # mode=train falls through: the probe path needs the real
+            # NetTrainer + train iterator
         if self.task == 'serve' and self.serve_mode == 'decode':
             # the decode stack serves a transformer LM tree (serve.lm /
             # serve.lm_model_in), not a netconfig model: no NetTrainer
@@ -497,7 +521,7 @@ class LearnTask:
             return
         self.continue_training = 0
         if self.name_model_in == 'NULL':
-            assert self.task in ('train', 'online'), \
+            assert self.task in ('train', 'online', 'autotune'), \
                 'must specify model_in if not training'
             self.net_trainer = self._create_net()
             self.net_trainer.init_model()
@@ -1400,6 +1424,194 @@ class LearnTask:
             fleet.start()
         return fleet
 
+    # --- grafttune (doc/autotune.md) --------------------------------------
+    def _tune_gate(self, space, baseline, feasible=None):
+        """Stage-1 admission from compiler truth: one batched AOT sweep
+        fills the ledger, the largest live footprint among analyzed
+        programs becomes the base price, and the declared ``mem_mb``
+        ceiling (scaled by the required headroom) bounds every
+        candidate.  ``mem_mb=0`` disables byte pruning — on a platform
+        with no HBM story (CPU) there is nothing truthful to prune
+        against."""
+        from .obs.programs import get_ledger
+        from .tune import LedgerGate
+        led = get_ledger()
+        led.ensure_analyzed_batch()
+        base = 0
+        for e in led.entries():
+            peak = e.peak_bytes or (e.argument_bytes + e.output_bytes
+                                    + e.temp_bytes)
+            base = max(base, peak)
+        ceiling = 0.0
+        if space.mem_mb > 0:
+            ceiling = space.mem_mb * (1 << 20) * (1.0 - space.headroom)
+        return LedgerGate(base_bytes=float(base), ceiling_bytes=ceiling,
+                          baseline=baseline,
+                          mem_knobs=space.mem_knobs(),
+                          feasible=feasible)
+
+    def _tune_baseline(self, space) -> dict:
+        """The hand-set config values, clamped into the declared ranges
+        — the candidate every measured probe competes against."""
+        current = {'steps_per_dispatch': self.steps_per_dispatch,
+                   'slots': self.serve_slots, 'pages': self.serve_pages,
+                   'page_size': self.serve_page_size,
+                   'spec_k': self.serve_spec_k,
+                   'max_queue': self.serve_max_queue,
+                   'nworker': 1}
+        if self._data_itcfg:
+            for name, val in self._data_itcfg:
+                if name == 'nworker':
+                    current['nworker'] = int(val)
+        out = {}
+        for r in space.knobs:
+            out[r.name] = max(r.lo, min(r.hi, int(current[r.name])))
+        return out
+
+    def _rebuild_train_iterator(self, nworker: int):
+        itcfg = [(n, v) for n, v in (self._data_itcfg or [])
+                 if n != 'nworker'] + [('nworker', str(int(nworker)))]
+        it = create_iterator(itcfg)
+        for name, val in self._data_defcfg:
+            it.set_param(name, val)
+        it.init()
+        return it
+
+    def _autotune_train(self, space):
+        """mode=train probes: steps/sec of the REAL plan/stepper path
+        (``execution.measured_probe``) at each candidate K, over batches
+        drawn once from the train iterator — a candidate ``nworker``
+        rebuilds the iterator and redraws, so the pool depth it pays for
+        is the pool depth it measures."""
+        import itertools as _it
+
+        from .nnet import execution
+        from .runtime import faults as _faults
+        from .tune import TuneSearch
+        if self.itr_train is None:
+            raise _faults.TuneSpecError(
+                'autotune mode=train needs a data section to probe with')
+        batches = list(_it.islice(iter(self.itr_train), space.probe_steps))
+        if not batches:
+            raise _faults.TuneSpecError(
+                'autotune: the train iterator yielded no batches')
+        baseline = self._tune_baseline(space)
+        base_k = baseline.get('steps_per_dispatch', self.steps_per_dispatch)
+        # warm-up at the baseline K fills the ledger: stage 1 prices
+        # candidates from THIS program's compiler truth
+        execution.measured_probe(self.net_trainer, base_k, batches,
+                                 repeats=1)
+        gate = self._tune_gate(space, baseline)
+
+        def probe(cand):
+            pb = batches
+            if 'nworker' in cand and cand['nworker'] != baseline['nworker']:
+                itr = self._rebuild_train_iterator(cand['nworker'])
+                pb = list(_it.islice(iter(itr), space.probe_steps))
+            k = cand.get('steps_per_dispatch', base_k)
+            return execution.measured_probe(
+                self.net_trainer, k, pb, repeats=space.probe_repeats)
+
+        return TuneSearch(space, probe, gate=gate,
+                          baseline=baseline).run('train')
+
+    def _autotune_decode(self, space):
+        """mode=decode probes: tokens/sec of a real DecodeService built
+        at each candidate's slots/pages/page_size/spec_k over seeded
+        prompts; candidates wanting speculation without a configured
+        draft are pruned in stage 1 (feasibility, not bytes)."""
+        import numpy as np
+
+        from .serve.decode import DecodeService
+        from .tune import TuneSearch
+        params, cfg = self._lm_spec()
+        draft = None
+        if self.serve_draft:
+            draft = self._parse_lm_spec(self.serve_draft,
+                                        default_vocab=cfg.vocab_size)
+        baseline = self._tune_baseline(space)
+
+        def build(cand):
+            return DecodeService(
+                params, cfg,
+                slots=cand.get('slots', self.serve_slots),
+                pages=cand.get('pages', self.serve_pages),
+                page_size=cand.get('page_size', self.serve_page_size),
+                max_prompt=self.serve_max_prompt,
+                max_new_bound=self.serve_max_new,
+                eos_id=None if self.serve_eos < 0 else self.serve_eos,
+                max_queue=cand.get('max_queue', self.serve_max_queue),
+                max_wait=self.serve_max_wait,
+                deadline=max(self.serve_deadline, 60.0),
+                dtype=self.serve_dtype, flash_decode=self.serve_flash,
+                prefix_share=self.serve_prefix_share,
+                spec_k=cand.get('spec_k', self.serve_spec_k),
+                draft=draft)
+
+        def probe(cand):
+            svc = build(cand)
+            try:
+                rng = np.random.RandomState(space.seed)
+                n_req = max(1, space.probe_steps)
+                prompts = [rng.randint(
+                    0, cfg.vocab_size,
+                    (1, int(rng.randint(1, max(2, self.serve_max_prompt)))))
+                    .astype(np.int32) for _ in range(n_req)]
+
+                def one_pass():
+                    t0 = time.perf_counter()
+                    reqs = [svc.submit_async(p, self.serve_max_new, 0.0,
+                                             None) for p in prompts]
+                    toks = sum(len(svc.batcher.wait(r)) for r in reqs)
+                    return toks / max(1e-9, time.perf_counter() - t0)
+
+                one_pass()              # warm-up: compile off the clock
+                return max(one_pass()
+                           for _ in range(max(1, space.probe_repeats)))
+            finally:
+                svc.close(30.0)
+
+        def feasible(cand):
+            if cand.get('spec_k', 0) > 0 and draft is None:
+                return 'spec_k needs a serve.draft model'
+            if 'pages' in cand and 'slots' in cand \
+                    and cand['pages'] < cand['slots']:
+                return 'fewer KV pages than decode slots'
+            return None
+
+        # baseline engine warm-up fills the ledger for stage-1 pricing
+        svc0 = build(baseline)
+        try:
+            svc0.engine.resident_bytes()
+        finally:
+            svc0.close(30.0)
+        gate = self._tune_gate(space, baseline, feasible=feasible)
+        return TuneSearch(space, probe, gate=gate,
+                          baseline=baseline).run('decode')
+
+    def task_autotune(self) -> None:
+        """``task=autotune``: run the two-stage grafttune search over
+        the declared ``autotune=`` space and write the reproducible
+        artifact pair — byte-deterministic ``tuned_<mode>.conf`` plus a
+        JSON receipt stamping every probe — into ``model_dir``."""
+        space = self._tune_space
+        os.makedirs(self.name_model_dir, exist_ok=True)
+        if space.mode == 'decode':
+            result = self._autotune_decode(space)
+        else:
+            result = self._autotune_train(space)
+        conf = result.write_conf(os.path.join(
+            self.name_model_dir, f'tuned_{space.mode}.conf'))
+        result.write_receipt(os.path.join(
+            self.name_model_dir, f'tuned_{space.mode}.json'))
+        if not self.silent:
+            print(f'autotune: best {result.best} '
+                  f'speedup {result.speedup:.3f}x over {result.baseline} '
+                  f'({result.stage1_pruned} pruned by ledger, '
+                  f'{result.measured} measured, {result.failed} failed, '
+                  f'wall {result.wall_s:.1f}s of {space.budget:g}s) '
+                  f'-> {conf}', flush=True)
+
     def task_extract(self) -> None:
         assert self.itr_pred is not None, 'must specify a pred iterator'
         node = self.extract_node_name or 'top[-1]'
@@ -1477,6 +1689,8 @@ class LearnTask:
                     self.task_serve()
             elif self.task == 'online':
                 self.task_online()
+            elif self.task == 'autotune':
+                self.task_autotune()
         finally:
             self._obs_stop()
         if plan is not None and not self.silent:

@@ -257,3 +257,41 @@ class WindowedStepper:
         for callers whose step budget is already met (the supervisor's
         ``n_steps`` break)."""
         self.window = []
+
+
+def measured_probe(trainer, requested_k: int, batches,
+                   repeats: int = 2) -> float:
+    """One grafttune stage-2 measurement: steps/sec of THIS trainer
+    driving the REAL plan/stepper path at ``requested_k``.
+
+    Resolves a silent plan (a probe must not spam fallback notes or
+    register itself as the run's /statusz plan choice... it does —
+    latest-resolve-wins means the tuner's final resolve at the chosen K
+    leaves the right plan registered), runs one untimed warm-up pass
+    over ``batches`` (compiles the scan program outside the clock), then
+    times ``repeats`` full passes and returns the BEST pass's
+    updates/sec — min-wall over repeats, the same noise policy as
+    bench.py.  The trainer's params advance (probes are measurement,
+    not state management); callers that need pristine params snapshot
+    and restore around the sweep."""
+    import time as _time
+    plan = ExecutionPlan.resolve(requested_k, strict=False, silent=True)
+
+    def one_pass() -> int:
+        stepper = plan.round_stepper(trainer, lookahead=0)
+        done = 0
+        for b in batches:
+            done += stepper.feed(b)
+        return done + stepper.finish()
+
+    one_pass()                          # warm-up: compile outside the clock
+    best = float('inf')
+    updates = 0
+    for _ in range(max(1, int(repeats))):
+        t0 = _time.perf_counter()
+        updates = one_pass()
+        best = min(best, _time.perf_counter() - t0)
+    if best <= 0 or updates <= 0:
+        raise faults.TuneProbeError(
+            f'k={requested_k}', RuntimeError('probe produced no updates'))
+    return updates / best

@@ -322,6 +322,16 @@ class LedgerProgram:
         family's entries (see :meth:`ProgramLedger.retire_program`)."""
         self.ledger.retire_program(self.name)
 
+    def compile_headroom(self) -> Optional[int]:
+        """Compiles this family can still absorb before the recompile
+        sentinel calls a storm (``bound - compiles``); None = unbounded.
+        The online tuner's re-plan guard reads this BEFORE compiling a
+        candidate (doc/autotune.md "Recompile budget")."""
+        if self.bound is None:
+            return None
+        with self.ledger._lock:
+            return self.bound - self.compiles
+
     def entries(self, analyze: bool = True) -> List[ProgramEntry]:
         return self.ledger.entries_for(self.name, analyze=analyze)
 
@@ -499,43 +509,129 @@ class ProgramLedger:
         if entry is None or entry._analyzed:
             return entry
         with self._analyze_lock:
-            if entry._analyzed:
-                return entry
-            wrapper, skel = entry._wrapper, entry._skel
-            if wrapper is None:
-                entry._analyzed = True
-                return entry
-            try:
-                ms, compiled = wrapper._analyze(skel)
-            # lint: allow(fault-taxonomy): the analysis probe degrades to a zero-filled row; the program itself already compiled and runs
-            except Exception:
-                entry._analyzed = True
-                return entry
-            cost = self._cost_dict(compiled)
-            mem = self._memory(compiled)
-            arg = out = temp = peak = 0
-            if mem is not None:
-                arg = int(getattr(mem, 'argument_size_in_bytes', 0) or 0)
-                out = int(getattr(mem, 'output_size_in_bytes', 0) or 0)
-                temp = int(getattr(mem, 'temp_size_in_bytes', 0) or 0)
-                peak = int(getattr(mem, 'peak_size_in_bytes', 0) or 0)
-                if peak == 0:
-                    # XLA:CPU reports no live-range peak; argument+
-                    # output+temp is the honest upper bound of what the
-                    # program holds at once
-                    peak = arg + out + temp
-            with self._lock:
-                entry.compile_ms = float(ms)
-                entry.flops = float(cost.get('flops', 0.0) or 0.0)
-                entry.bytes_accessed = float(
-                    cost.get('bytes accessed', 0.0) or 0.0)
-                entry.argument_bytes = arg
-                entry.output_bytes = out
-                entry.temp_bytes = temp
-                entry.peak_bytes = peak
-                entry._analyzed = True
-                self.compile_ms_total += float(ms)
+            self._probe_and_fill(entry)
         return entry
+
+    def _probe_and_fill(self, entry: ProgramEntry) -> None:  # requires-lock: _analyze_lock
+        """One entry's AOT probe + compiler-truth fill — the body both
+        :meth:`ensure_analyzed` and the batched sweep share.  A failed
+        (or wrapper-less) probe marks the entry analyzed with zeros."""
+        if entry._analyzed:
+            return
+        wrapper, skel = entry._wrapper, entry._skel
+        if wrapper is None:
+            entry._analyzed = True
+            return
+        try:
+            ms, compiled = wrapper._analyze(skel)
+        # lint: allow(fault-taxonomy): the analysis probe degrades to a zero-filled row; the program itself already compiled and runs
+        except Exception:
+            entry._analyzed = True
+            return
+        self._fill(entry, ms, compiled)
+
+    def _fill(self, entry: ProgramEntry, ms: float, compiled) -> None:
+        cost = self._cost_dict(compiled)
+        mem = self._memory(compiled)
+        arg = out = temp = peak = 0
+        if mem is not None:
+            arg = int(getattr(mem, 'argument_size_in_bytes', 0) or 0)
+            out = int(getattr(mem, 'output_size_in_bytes', 0) or 0)
+            temp = int(getattr(mem, 'temp_size_in_bytes', 0) or 0)
+            peak = int(getattr(mem, 'peak_size_in_bytes', 0) or 0)
+            if peak == 0:
+                # XLA:CPU reports no live-range peak; argument+
+                # output+temp is the honest upper bound of what the
+                # program holds at once
+                peak = arg + out + temp
+        with self._lock:
+            entry.compile_ms = float(ms)
+            entry.flops = float(cost.get('flops', 0.0) or 0.0)
+            entry.bytes_accessed = float(
+                cost.get('bytes accessed', 0.0) or 0.0)
+            entry.argument_bytes = arg
+            entry.output_bytes = out
+            entry.temp_bytes = temp
+            entry.peak_bytes = peak
+            entry._analyzed = True
+            self.compile_ms_total += float(ms)
+
+    def ensure_analyzed_batch(self, names=None, workers: int = 4) -> int:
+        """Batched AOT analysis: fill every unanalyzed entry (of the
+        program families in ``names``, or all of them) by fanning the
+        lowerings out over a short-lived worker pool instead of
+        serializing N probes on the caller thread — the autotuner's
+        stage-1 sweep and the ``/programs`` first-read both need the
+        whole ledger's compiler truth at once (doc/autotune.md).
+
+        Holds ``_analyze_lock`` for the sweep, so concurrent single
+        :meth:`ensure_analyzed` calls serialize against it exactly as
+        before; each probe thread re-traces with the hook suppressed
+        (``_PROBE_TLS`` is thread-local), so counts and the recompile
+        sentinel never see the batch.  Returns how many entries this
+        call analyzed (failed probes count — they are marked analyzed
+        with zeros, same as the single-entry path)."""
+        wanted = None if names is None else set(names)
+        with self._lock:
+            todo = sorted(
+                (e for (n, _k), e in self._entries.items()
+                 if not e._analyzed and (wanted is None or n in wanted)),
+                key=lambda e: e.seq)
+        if not todo:
+            return 0
+        with self._analyze_lock:
+            todo = [e for e in todo if not e._analyzed]
+            if not todo:
+                return 0
+            probed = []
+            results = {}                 # seq -> (ms, compiled)
+            res_lock = threading.Lock()
+
+            def probe(entry):
+                wrapper, skel = entry._wrapper, entry._skel
+                if wrapper is None:
+                    return
+                try:
+                    ms, compiled = wrapper._analyze(skel)
+                # lint: allow(fault-taxonomy): a failed batch probe degrades that one row to zeros, like the single-entry path
+                except Exception:
+                    return
+                with res_lock:
+                    results[entry.seq] = (ms, compiled)
+
+            n_workers = max(1, min(int(workers), len(todo)))
+            if n_workers == 1:
+                for e in todo:
+                    probe(e)
+            else:
+                queue = list(todo)
+                q_lock = threading.Lock()
+
+                def drain():
+                    while True:
+                        with q_lock:
+                            if not queue:
+                                return
+                            e = queue.pop(0)
+                        probe(e)
+
+                threads = [threading.Thread(
+                    target=drain, name=f'cxxnet-obs-aot-{i}', daemon=True)
+                    for i in range(n_workers)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            for e in todo:
+                got = results.get(e.seq)
+                if got is None:
+                    # wrapper-less or failed probe: analyzed-with-zeros,
+                    # exactly like the single-entry path
+                    e._analyzed = True
+                else:
+                    self._fill(e, got[0], got[1])
+                probed.append(e)
+        return len(probed)
 
     # -- views -------------------------------------------------------------
     def entries_for(self, name: str,
@@ -544,20 +640,18 @@ class ProgramLedger:
         lazy AOT probe — the read-only spelling for render threads
         (/statusz providers, gauge refreshes) that must never block on
         an XLA compile; unanalyzed entries then report zero flops."""
-        with self._lock:
-            found = sorted((e for (n, _k), e in self._entries.items()
-                            if n == name), key=lambda e: e.seq)
         if analyze:
-            for e in found:
-                self.ensure_analyzed(e)
-        return found
+            self.ensure_analyzed_batch(names=(name,))
+        with self._lock:
+            return sorted((e for (n, _k), e in self._entries.items()
+                           if n == name), key=lambda e: e.seq)
 
     def entries(self) -> List[ProgramEntry]:
+        # the /programs first read: one batched sweep, not N serialized
+        # lowerings on the render thread
+        self.ensure_analyzed_batch()
         with self._lock:
-            found = sorted(self._entries.values(), key=lambda e: e.seq)
-        for e in found:
-            self.ensure_analyzed(e)
-        return found
+            return sorted(self._entries.values(), key=lambda e: e.seq)
 
     def view(self) -> dict:
         """The ``/programs`` body: every entry plus the totals."""

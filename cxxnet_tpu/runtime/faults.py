@@ -398,6 +398,49 @@ class AutoscaleDegradedError(AutoscaleError):
             'degrading explicitly (admission clamped, sheds typed)')
 
 
+class TuneError(RuntimeError):
+    """Base of the autotuner taxonomy (doc/autotune.md).  Deliberately
+    NOT a :class:`TrainingFault` or :class:`ServeError`: tuning runs
+    offline (``task=autotune``) or as a bounded online controller — its
+    failures are search/plan conditions an operator reads from the
+    receipt, never process faults a checkpoint restore could repair."""
+
+
+class TuneSpecError(TuneError):
+    """A malformed ``autotune=`` spec: unknown knob, bounds outside the
+    knob's declared safety range, lo > hi, or an option value that does
+    not parse.  Raised at config parse, like a bad ``slo.*`` spec."""
+
+
+class TuneProbeError(TuneError):
+    """A stage-2 measured probe failed (the candidate's engine or step
+    loop raised).  The search records the candidate as failed and moves
+    on — a broken candidate must cost one probe, not the search."""
+
+    def __init__(self, candidate: str, cause: BaseException):
+        self.candidate = str(candidate)
+        super().__init__(
+            f'measured probe failed for candidate {candidate!r}: '
+            f'{type(cause).__name__}: {cause}')
+
+
+class TuneRecompileVetoError(TuneError):
+    """The online re-plan guard rejected a candidate BEFORE it compiled:
+    applying it would push a ledger program family past its declared
+    compile budget (``obs.recompile`` sentinel bound).  Recorded into
+    the failure log by :class:`~cxxnet_tpu.tune.TuneController` so a
+    veto is observable; the sentinel itself never fires."""
+
+    def __init__(self, knob: str, program: str, headroom: int):
+        self.knob = knob
+        self.program = program
+        self.headroom = int(headroom)
+        super().__init__(
+            f're-plan of {knob!r} vetoed: program {program!r} has '
+            f'{headroom} compile(s) of budget left — applying would '
+            'risk a recompile storm')
+
+
 class FaultInjected(OSError):
     """Deterministic injected fault.  Subclasses ``OSError`` so the
     storage retry policies treat it exactly like a real transient I/O

@@ -44,10 +44,25 @@ from ..runtime import faults
 from ..utils.config import parse_kv_list
 from ..utils.metric import StatSet
 
-__all__ = ['AutoscalePolicy', 'Autoscaler', 'OK', 'AT_RISK', 'BREACHED']
+__all__ = ['AutoscalePolicy', 'Autoscaler', 'Knob', 'worst_verdict',
+           'OK', 'AT_RISK', 'BREACHED']
 
 OK, AT_RISK, BREACHED = 'OK', 'AT_RISK', 'BREACHED'
 _SEVERITY = {OK: 0, AT_RISK: 1, BREACHED: 2}
+
+
+def worst_verdict(view: dict) -> str:
+    """Worst SLO state across a ``slos_view()`` body (no specs / no
+    data = OK) — the one verdict-reading rule every controller that
+    rides the scaling machinery shares (the autoscaler here, the online
+    :class:`~cxxnet_tpu.tune.TuneController`)."""
+    worst = OK
+    for entry in (view or {}).values():
+        state = entry.get('state', OK) if isinstance(entry, dict) \
+            else str(entry)
+        if _SEVERITY.get(state, 0) > _SEVERITY[worst]:
+            worst = state
+    return worst
 
 
 @dataclass(frozen=True)
@@ -118,10 +133,12 @@ class AutoscalePolicy:
                 f'interval={self.interval:g}')
 
 
-class _Knob:
+class Knob:
     """One bounded, reversible control surface: a current value moved
     multiplicatively between [lo, hi], restored toward its baseline on
-    sustained OK.  The setter is the ONLY side effect."""
+    sustained OK.  The setter is the ONLY side effect.  Public since the
+    autotuner's online leg (cxxnet_tpu/tune/controller.py) re-plans
+    through the same bounded-knob machinery."""
 
     def __init__(self, name: str, lo: int, hi: int, value: int,
                  setter: Callable[[int], object]):
@@ -147,6 +164,9 @@ class _Knob:
         return max(self.baseline, int(self.value / step))
 
 
+_Knob = Knob     # pre-PR-19 private spelling, kept importable
+
+
 class Autoscaler:
     """Closes the verdict loop over bound serving components.
 
@@ -168,7 +188,7 @@ class Autoscaler:
         self._log = failure_log
         self.stats = StatSet()
         self._lock = threading.Lock()
-        self._knobs: Dict[str, _Knob] = {}       # guarded-by: _lock
+        self._knobs: Dict[str, Knob] = {}       # guarded-by: _lock
         self._engine = None                      # guarded-by: _lock
         self._fleet = None                       # guarded-by: _lock
         self._online = None                      # guarded-by: _lock
@@ -197,11 +217,11 @@ class Autoscaler:
         phys_slots, phys_pages = engine.slots, engine.n_pages - 1
         with self._lock:
             self._engine = engine
-            self._knobs['slots'] = _Knob(
+            self._knobs['slots'] = Knob(
                 'slots', max(1, pol.min_slots),
                 min(phys_slots, pol.max_slots or phys_slots), slot_cap,
                 lambda v: engine.set_live_limits(max_slots=v))
-            self._knobs['pages'] = _Knob(
+            self._knobs['pages'] = Knob(
                 'pages', max(1, pol.min_pages),
                 min(phys_pages, pol.max_pages or phys_pages), page_cap,
                 lambda v: engine.set_live_limits(max_pages=v))
@@ -211,7 +231,7 @@ class Autoscaler:
         the ``queue`` knob — also the degradation rung's clamp."""
         pol = self.policy
         with self._lock:
-            self._knobs['queue'] = _Knob(
+            self._knobs['queue'] = Knob(
                 'queue', max(1, pol.min_queue),
                 max(pol.max_queue or batcher.max_queue,
                     batcher.max_queue),
@@ -239,14 +259,7 @@ class Autoscaler:
         if src is None:
             hub = self._hub if self._hub is not None else get_hub()
             src = hub.slos_view
-        worst = OK
-        view = src() or {}
-        for entry in view.values():
-            state = entry.get('state', OK) if isinstance(entry, dict) \
-                else str(entry)
-            if _SEVERITY.get(state, 0) > _SEVERITY[worst]:
-                worst = state
-        return worst
+        return worst_verdict(src() or {})
 
     def gauge_view(self) -> dict:
         src = self._gauges

@@ -1,0 +1,22 @@
+"""grafttune — ledger-driven autotuner: compiler truth picks the config.
+
+Three layers (doc/autotune.md):
+
+* :mod:`~cxxnet_tpu.tune.space` — the ``autotune=`` grammar: declared
+  knobs, hard bounds, seeds, budgets.
+* :mod:`~cxxnet_tpu.tune.search` — the two-stage engine: stage 1
+  prunes candidates from AOT ProgramLedger numbers without executing
+  anything, stage 2 measures the survivors through the real execution
+  paths under a wall-clock budget, and the result writes a
+  byte-deterministic ``tuned_<task>.conf`` plus a JSON receipt.
+* :mod:`~cxxnet_tpu.tune.controller` — the online leg: re-plans
+  declared-safe knobs on SLO drift, every move gated by the
+  ``obs.recompile`` sentinel's remaining compile budget.
+"""
+
+from .controller import TuneController
+from .search import LedgerGate, TuneResult, TuneSearch
+from .space import KNOBS, KnobDecl, KnobRange, TuneSpace
+
+__all__ = ['TuneSpace', 'TuneSearch', 'TuneResult', 'LedgerGate',
+           'TuneController', 'KNOBS', 'KnobDecl', 'KnobRange']

@@ -1,0 +1,286 @@
+"""grafttune online leg: re-plan within declared-safe bounds on SLO drift.
+
+A :class:`TuneController` rides the PR 16 autoscaler machinery — the
+same bounded :class:`~cxxnet_tpu.serve.autoscale.Knob` surfaces, the
+same hysteresis-streak + per-knob-cooldown control law, the same
+injectable verdict/gauge feeds — but its moves come from the tuner's
+declared space, not a fixed policy:
+
+* memory pressure (min ``hbm.headroom_frac`` gauge under the space's
+  ``headroom``, or a BREACHED verdict) shrinks the ``mem`` knobs
+  (predict bucket ladders, pages, slots) toward their baselines;
+* ``decode.spec_accept_rate`` high while MFU is low grows ``spec_k`` —
+  acceptance says speculation is free, MFU says the chip is idle.
+
+The recompile-storm guard is the load-bearing difference from plain
+autoscaling: any knob bound with a ledger ``program`` is assumed to
+recompile on change, and the move is checked against
+``program.compile_headroom()`` (the ``obs.recompile`` sentinel's bound
+minus compiles so far) BEFORE the setter runs.  A move that would eat
+the last compile — or exceed the space's own ``compile_budget`` — is
+vetoed and recorded as a
+:class:`~cxxnet_tpu.runtime.faults.TuneRecompileVetoError`; the storm
+sentinel itself never fires because the controller never lets it get
+that far.
+"""
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..runtime import faults
+from ..serve.autoscale import BREACHED, Knob, OK, worst_verdict
+from ..utils.metric import StatSet
+from .space import KNOBS, TuneSpace
+
+__all__ = ['TuneController']
+
+
+class _BoundKnob:
+    """A Knob plus its recompile contract."""
+
+    def __init__(self, knob: Knob, program=None, recompiles: bool = False):
+        self.knob = knob
+        self.program = program          # LedgerProgram or None
+        self.recompiles = bool(recompiles or program is not None)
+
+
+class TuneController:
+    """Online re-planner over declared-safe tuned knobs.
+
+    ``verdicts``/``gauges`` are zero-arg callables (tests inject
+    deterministic feeds; production wires ``hub.slos_view`` /
+    ``hub.gauge_snapshot``).  :meth:`evaluate` is the whole control
+    law — one call per tick, manual unless ``interval`` > 0 (then a
+    ``cxxnet-tune-<name>`` daemon ticks it)."""
+
+    def __init__(self, space: TuneSpace, hub=None,
+                 verdicts: Optional[Callable[[], dict]] = None,
+                 gauges: Optional[Callable[[], dict]] = None,
+                 failure_log=None, name: str = 'tune',
+                 hysteresis: int = 2, cooldown: float = 0.25,
+                 interval: float = 0.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.space = space
+        self.name = name
+        self._hub = hub
+        self._verdicts = verdicts
+        self._gauges = gauges
+        self._log = failure_log
+        self.hysteresis = max(1, int(hysteresis))
+        self.cooldown = float(cooldown)
+        self.clock = clock
+        self.stats = StatSet()
+        self._lock = threading.Lock()
+        self._knobs: Dict[str, _BoundKnob] = {}  # guarded-by: _lock
+        self._streak = 0                         # guarded-by: _lock
+        self._streak_dir = 0                     # guarded-by: _lock
+        self._compiles = 0                       # guarded-by: _lock
+        self._history: collections.deque = (
+            collections.deque(maxlen=256))       # guarded-by: _lock
+        self._closed = False                     # guarded-by: _lock
+        self._ticker: Optional[threading.Thread] = None
+        if interval > 0:
+            self.interval = float(interval)
+            self._ticker = threading.Thread(
+                target=self._tick_loop, daemon=True,
+                name=f'cxxnet-tune-{name}')
+            self._ticker.start()
+        else:
+            self.interval = 0.0
+
+    # -- binding -----------------------------------------------------------
+    def bind(self, name: str, setter: Callable[[int], object],
+             value: int, lo: Optional[int] = None,
+             hi: Optional[int] = None, program=None,
+             recompiles: bool = False) -> None:
+        """Bind one knob the controller may move.  Bounds default to the
+        space's declared range for ``name``; binding a knob the space
+        never declared is a :class:`TuneSpecError` — the online leg can
+        only ever move inside declared-safe bounds."""
+        rng = self.space.knob_range(name)
+        if rng is None:
+            raise faults.TuneSpecError(
+                f'knob {name!r} is not declared in this TuneSpace — '
+                f'online re-planning only moves declared-safe knobs')
+        lo = rng.lo if lo is None else max(rng.lo, int(lo))
+        hi = rng.hi if hi is None else min(rng.hi, int(hi))
+        knob = Knob(name, lo, hi, int(value), setter)
+        with self._lock:
+            self._knobs[name] = _BoundKnob(knob, program, recompiles)
+
+    # -- feeds -------------------------------------------------------------
+    def _read_verdict(self) -> str:
+        src = self._verdicts
+        if src is None and self._hub is not None:
+            src = getattr(self._hub, 'slos_view', None)
+        if src is None:
+            return OK
+        return worst_verdict(src() or {})
+
+    def _read_gauges(self) -> dict:
+        src = self._gauges
+        if src is None and self._hub is not None:
+            src = getattr(self._hub, 'gauge_snapshot', None)
+        if src is None:
+            return {}
+        return src() or {}
+
+    @staticmethod
+    def _min_headroom(gauges: dict) -> Optional[float]:
+        vals = [float(v) for k, v in gauges.items()
+                if k.startswith('hbm.headroom_frac')]
+        return min(vals) if vals else None
+
+    @staticmethod
+    def _gauge(gauges: dict, suffix: str) -> Optional[float]:
+        for k, v in gauges.items():
+            if k == suffix or k.endswith('.' + suffix):
+                return float(v)
+        return None
+
+    # -- the control law ---------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        now = self.clock() if now is None else now
+        verdict = self._read_verdict()
+        gauges = self._read_gauges()
+        headroom = self._min_headroom(gauges)
+        accept = self._gauge(gauges, 'spec_accept_rate')
+        mfu_val = self._gauge(gauges, 'mfu')
+        pressure = (verdict == BREACHED
+                    or (headroom is not None
+                        and headroom < self.space.headroom))
+        grow_spec = (accept is not None and accept >= 0.6
+                     and (mfu_val is None or mfu_val < 0.5))
+        with self._lock:
+            if self._closed:
+                return {'applied': [], 'verdict': verdict}
+            direction = -1 if pressure else (1 if grow_spec else 0)
+            if direction != self._streak_dir:
+                self._streak_dir = direction
+                self._streak = 0
+            self._streak += 1
+            applied = []
+            if direction != 0 and self._streak >= self.hysteresis:
+                if direction < 0:
+                    applied = self._shrink_mem(now)
+                else:
+                    applied = self._grow_spec(now)
+            self._history.append({
+                't': now, 'verdict': verdict, 'headroom': headroom,
+                'direction': direction, 'applied': list(applied)})
+            self.stats.gauge('compiles', self._compiles)
+            return {'applied': applied, 'verdict': verdict,
+                    'headroom': headroom, 'direction': direction}
+
+    def _shrink_mem(self, now):  # requires-lock: _lock
+        out = []
+        for name in self.space.mem_knobs():
+            bk = self._knobs.get(name)
+            if bk is None:
+                continue
+            # under memory pressure the tuner halves toward the declared
+            # FLOOR — unlike Autoscaler recovery, the baseline is not a
+            # resting point here, it is what caused the pressure
+            target = max(bk.knob.lo, bk.knob.value // 2)
+            if self._move(bk, target, now):
+                out.append((name, target))
+        return out
+
+    def _grow_spec(self, now):  # requires-lock: _lock
+        out = []
+        for name, bk in sorted(self._knobs.items()):
+            if not KNOBS[name].spec:
+                continue
+            target = bk.knob.target(+1, 2.0)
+            if self._move(bk, target, now):
+                out.append((name, target))
+        return out
+
+    def _move(self, bk: _BoundKnob, target: int, now) -> bool:  # requires-lock: _lock
+        knob = bk.knob
+        if target == knob.value:
+            return False
+        if now - knob.last_action < self.cooldown:
+            return False
+        if bk.recompiles:
+            # THE recompile-storm guard: reject BEFORE the setter (and
+            # hence before any compile) if either the program's own
+            # sentinel bound or the space's declared compile budget
+            # would be exhausted by this move.
+            head = None
+            if bk.program is not None:
+                head = bk.program.compile_headroom()
+            over_program = head is not None and head < 1
+            over_space = self._compiles + 1 > self.space.compile_budget
+            if over_program or over_space:
+                self.stats.inc('recompile_vetoes')
+                err = faults.TuneRecompileVetoError(
+                    knob.name,
+                    getattr(bk.program, 'name', '<unbound>'),
+                    head if head is not None
+                    else self.space.compile_budget - self._compiles)
+                log = self._log if self._log is not None \
+                    else faults.global_failure_log()
+                log.record(type(err).__name__, str(err))
+                return False
+            self._compiles += 1
+        knob.setter(target)
+        knob.value = target
+        knob.last_action = now
+        self.stats.inc(f'replan_{knob.name}')
+        return True
+
+    # -- introspection / lifecycle -----------------------------------------
+    def knob_values(self) -> Dict[str, int]:
+        with self._lock:
+            return {n: bk.knob.value for n, bk in self._knobs.items()}
+
+    def history(self):
+        with self._lock:
+            return list(self._history)
+
+    def compiles(self) -> int:
+        with self._lock:
+            return self._compiles
+
+    def status_view(self) -> dict:
+        with self._lock:
+            return {
+                'name': self.name,
+                'spec': self.space.describe(),
+                'knobs': {n: {'value': bk.knob.value,
+                              'lo': bk.knob.lo, 'hi': bk.knob.hi,
+                              'baseline': bk.knob.baseline,
+                              'recompiles': bk.recompiles}
+                          for n, bk in sorted(self._knobs.items())},
+                'compiles': self._compiles,
+                'compile_budget': self.space.compile_budget,
+                'vetoes': int(self.stats.get('recompile_vetoes')),
+                'replans': len([h for h in self._history if h['applied']]),
+            }
+
+    def register_into(self, hub, name: Optional[str] = None):
+        name = name or f'tune_{self.name}'
+        hub.register_stats(name, self.stats)
+        hub.register_status(name, self.status_view)
+        return self
+
+    def _tick_loop(self):
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+            time.sleep(self.interval)
+            with self._lock:
+                if self._closed:
+                    return
+            self.evaluate()
+
+    def close(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            self._closed = True
+        t = self._ticker
+        if t is not None:
+            t.join(timeout)

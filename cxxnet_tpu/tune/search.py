@@ -1,0 +1,263 @@
+"""grafttune two-stage search: compiler truth prunes, measurement picks.
+
+Stage 1 (:class:`LedgerGate`) prices every candidate from AOT
+ProgramLedger numbers — predicted live bytes vs the declared ceiling —
+WITHOUT executing anything; candidates that cannot fit are pruned and
+stamped into the receipt with the ledger numbers that killed them.
+Stage 2 (:class:`TuneSearch`) runs short seeded measured probes through
+the caller-supplied ``probe_fn`` (the real ExecutionPlan / DecodeEngine
+path) under a wall-clock budget.  The default candidate is ALWAYS
+measured first, so the search can never return something worse than the
+hand-tuned config it started from.
+
+The tuned artifact is two files: ``tuned_<task>.conf`` —
+byte-deterministic for a fixed (spec, seed, ledger state), just sorted
+knob lines — and a JSON receipt stamping every probe's ledger numbers,
+timings, and the pruned-vs-measured counts (timings make the receipt
+deliberately non-deterministic; the conf is the reproducible artifact).
+"""
+
+import dataclasses
+import itertools
+import json
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime import faults
+from .space import KNOBS, TuneSpace
+
+__all__ = ['LedgerGate', 'TuneSearch', 'TuneResult']
+
+Candidate = Dict[str, int]
+
+
+class LedgerGate:
+    """Stage-1 admission: closed-form byte pricing from compiler truth.
+
+    ``base_bytes`` is the ledger-measured live footprint of the BASELINE
+    candidate (peak or argument bytes from analyzed entries — the caller
+    picks which programs matter).  A candidate's predicted bytes scale
+    the base linearly in each ``mem`` knob's ratio to its baseline
+    value; the candidate passes if the prediction stays under
+    ``ceiling_bytes`` and any extra ``feasible`` predicate agrees.
+    """
+
+    def __init__(self, base_bytes: float, ceiling_bytes: float,
+                 baseline: Candidate,
+                 mem_knobs: Tuple[str, ...] = (),
+                 budgeter=None,
+                 feasible: Optional[Callable[[Candidate],
+                                             Optional[str]]] = None):
+        self.base_bytes = float(base_bytes)
+        self.ceiling_bytes = float(ceiling_bytes)
+        self.baseline = dict(baseline)
+        self.mem_knobs = tuple(mem_knobs)
+        self.budgeter = budgeter
+        self.feasible = feasible
+
+    def predicted_bytes(self, cand: Candidate) -> float:
+        scale = 1.0
+        for name in self.mem_knobs:
+            base = max(1, int(self.baseline.get(name, 1)))
+            scale *= max(1, int(cand.get(name, base))) / base
+        return self.base_bytes * scale
+
+    def admit(self, cand: Candidate) -> Tuple[bool, Dict[str, object]]:
+        pred = self.predicted_bytes(cand)
+        info: Dict[str, object] = {
+            'predicted_bytes': int(pred),
+            'base_bytes': int(self.base_bytes),
+            'ceiling_bytes': int(self.ceiling_bytes),
+        }
+        if self.ceiling_bytes > 0 and pred > self.ceiling_bytes:
+            info['pruned'] = 'ledger_bytes_over_ceiling'
+            return False, info
+        if self.budgeter is not None:
+            extra = pred - self.base_bytes
+            if extra > 0 and self.budgeter.over_budget(int(extra)):
+                info['pruned'] = 'memory_budgeter'
+                return False, info
+        if self.feasible is not None:
+            why = self.feasible(cand)
+            if why:
+                info['pruned'] = str(why)
+                return False, info
+        return True, info
+
+
+@dataclasses.dataclass
+class TuneResult:
+    """Everything the search learned, plus the artifact writers."""
+    space: TuneSpace
+    task: str
+    best: Candidate
+    best_value: float
+    baseline: Candidate
+    baseline_value: float
+    probes: List[Dict[str, object]]
+    stage1_candidates: int
+    stage1_pruned: int
+    measured: int
+    failed: int
+    wall_s: float
+    budget_honored: bool
+
+    @property
+    def speedup(self) -> float:
+        if self.baseline_value <= 0:
+            return 1.0
+        return self.best_value / self.baseline_value
+
+    def conf_text(self) -> str:
+        """Byte-deterministic tuned config: header pins the spec + seed
+        the bytes were derived from, then one sorted line per knob.
+        NO timestamps, NO timings — those live in the receipt only."""
+        lines = [f'# tuned_{self.task}.conf — written by grafttune',
+                 f'# autotune={self.space.describe()}',
+                 f'# seed={self.space.seed}']
+        for name in sorted(self.best):
+            lines.append(f'{name}={int(self.best[name])}')
+        return '\n'.join(lines) + '\n'
+
+    def write_conf(self, path: str) -> str:
+        with open(path, 'w') as f:
+            f.write(self.conf_text())
+        return path
+
+    def receipt(self) -> Dict[str, object]:
+        return {
+            'artifact': f'tuned_{self.task}.conf',
+            'spec': self.space.describe(),
+            'seed': self.space.seed,
+            'task': self.task,
+            'best': {k: int(v) for k, v in sorted(self.best.items())},
+            'best_value': self.best_value,
+            'baseline': {k: int(v)
+                         for k, v in sorted(self.baseline.items())},
+            'baseline_value': self.baseline_value,
+            'speedup': self.speedup,
+            'counts': {
+                'stage1_candidates': self.stage1_candidates,
+                'stage1_pruned': self.stage1_pruned,
+                'measured': self.measured,
+                'failed': self.failed,
+            },
+            'wall_s': self.wall_s,
+            'budget_s': self.space.budget,
+            'budget_honored': self.budget_honored,
+            'probes': self.probes,
+        }
+
+    def write_receipt(self, path: str) -> str:
+        with open(path, 'w') as f:
+            json.dump(self.receipt(), f, indent=1, sort_keys=True)
+            f.write('\n')
+        return path
+
+
+class TuneSearch:
+    """The two-stage engine.  Deterministic for a fixed (space, gate,
+    probe results): candidate enumeration is a sorted cross-product of
+    each knob's geometric ladder, probe ORDER is a seeded shuffle
+    (baseline first, always), and ties break toward the earlier
+    enumeration index."""
+
+    def __init__(self, space: TuneSpace,
+                 probe_fn: Callable[[Candidate], float],
+                 gate: Optional[LedgerGate] = None,
+                 baseline: Optional[Candidate] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 failure_log=None):
+        self.space = space
+        self.probe_fn = probe_fn
+        self.gate = gate
+        self.clock = clock
+        self._log = failure_log
+        names = [r.name for r in space.knobs]
+        self.baseline: Candidate = dict(baseline or {})
+        for r in space.knobs:
+            if r.name not in self.baseline:
+                d = KNOBS[r.name].default
+                self.baseline[r.name] = max(r.lo, min(r.hi, d))
+        ladders = [space.ladder(n) for n in names]
+        self.candidates: List[Candidate] = [
+            dict(zip(names, combo))
+            for combo in itertools.product(*ladders)]
+
+    def run(self, task: str = 'train') -> TuneResult:
+        space = self.space
+        t0 = self.clock()
+        probes: List[Dict[str, object]] = []
+
+        # -- stage 1: ledger pruning, no execution -------------------------
+        admitted: List[Candidate] = []
+        pruned = 0
+        for cand in self.candidates:
+            if self.gate is not None:
+                ok, info = self.gate.admit(cand)
+            else:
+                ok, info = True, {}
+            if ok:
+                admitted.append(cand)
+            else:
+                pruned += 1
+                probes.append({'candidate': dict(cand), 'stage': 1,
+                               'ledger': info, 'pruned': True})
+
+        # -- stage 2: seeded measured probes under the wall budget ---------
+        order = [c for c in admitted if c != self.baseline]
+        rng = np.random.RandomState(space.seed)
+        rng.shuffle(order)
+        # baseline ALWAYS measured, always first — the search result is
+        # then >= the hand-tuned default by construction
+        order.insert(0, dict(self.baseline))
+
+        measured: List[Tuple[int, Candidate, float]] = []
+        failed = 0
+        for idx, cand in enumerate(order):
+            elapsed = self.clock() - t0
+            if idx > 0 and (elapsed >= space.budget
+                            or len(measured) >= space.max_probes):
+                break
+            p_t0 = self.clock()
+            try:
+                value = float(self.probe_fn(dict(cand)))
+            # lint: allow(fault-taxonomy): one broken candidate must not kill the sweep; it is recorded and skipped
+            except Exception as e:
+                failed += 1
+                err = faults.TuneProbeError(repr(sorted(cand.items())), e)
+                if self._log is not None:
+                    self._log.record(type(err).__name__, str(err))
+                probes.append({'candidate': dict(cand), 'stage': 2,
+                               'failed': f'{type(e).__name__}: {e}'})
+                continue
+            wall_ms = (self.clock() - p_t0) * 1e3
+            entry: Dict[str, object] = {
+                'candidate': dict(cand), 'stage': 2,
+                'value': value, 'wall_ms': wall_ms}
+            if self.gate is not None:
+                entry['ledger'] = self.gate.admit(cand)[1]
+            probes.append(entry)
+            measured.append((idx, cand, value))
+
+        if not measured:
+            raise faults.TuneProbeError(
+                'baseline', RuntimeError('no candidate survived stage 2'))
+        base_value = measured[0][2]
+        # argmax over value; ties break toward the earliest probe (the
+        # baseline wins an exact tie — never churn the config for zero)
+        best_idx, best, best_value = max(
+            measured, key=lambda t: (t[2], -t[0]))
+        wall_s = self.clock() - t0
+        return TuneResult(
+            space=space, task=task,
+            best=dict(best), best_value=best_value,
+            baseline=dict(self.baseline), baseline_value=base_value,
+            probes=probes,
+            stage1_candidates=len(self.candidates),
+            stage1_pruned=pruned,
+            measured=len(measured), failed=failed,
+            wall_s=wall_s,
+            budget_honored=wall_s <= space.budget)

@@ -483,6 +483,23 @@ class Net:
         return json.dumps(get_ledger().view(), sort_keys=True,
                           default=str)
 
+    def autotune(self, spec: str, probe_fn, baseline=None,
+                 task: str = 'train') -> str:
+        """Run the grafttune two-stage search (doc/autotune.md) over an
+        ``autotune=`` spec string with a caller-supplied measured probe
+        — ``probe_fn(candidate_dict) -> score`` (higher is better) —
+        and return the receipt as one JSON object.  The embedding owns
+        probe execution (it knows what a representative workload is);
+        stage-1 ledger pruning and the budgeted stage-2 sweep are the
+        library's.  The tuned knobs are ``receipt['best']``."""
+        import json
+
+        from .tune import TuneSearch, TuneSpace
+        space = TuneSpace.parse(spec)
+        result = TuneSearch(space, probe_fn,
+                            baseline=baseline).run(task)
+        return json.dumps(result.receipt(), sort_keys=True, default=str)
+
     # --- weight access (visitor equivalent) -------------------------------
     def _resolve(self, layer_name: str):
         tr = self._require()

@@ -137,6 +137,14 @@ def pytest_configure(config):
         'enforcement, cross-replica share-dir adopt, corrupt-record '
         'quarantine drills; CPU-only '
         '(tier-1: runs under -m "not slow"; select with -m kv_tier)')
+    config.addinivalue_line(
+        'markers',
+        'tune: grafttune autotuner suite — autotune= grammar '
+        'round-trips, ledger-gated stage-1 pruning, seeded measured '
+        'probes with byte-deterministic tuned_<task>.conf artifacts, '
+        'tuned-vs-hand-written bitwise twins, online TuneController '
+        're-plan bounds + recompile-storm guard drill; CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m tune)')
 
 
 # every pipeline thread the framework starts carries a cxxnet- name
@@ -147,7 +155,8 @@ def pytest_configure(config):
 _PIPELINE_THREAD_PREFIXES = ('cxxnet-tb-', 'cxxnet-pool-', 'cxxnet-decode-',
                              'cxxnet-elastic-', 'cxxnet-obs-',
                              'cxxnet-scale-', 'cxxnet-kv-',
-                             'cxxnet-prefill-', 'cxxnet-replica-')
+                             'cxxnet-prefill-', 'cxxnet-replica-',
+                             'cxxnet-tune-')
 
 
 def _pipeline_threads():

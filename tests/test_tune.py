@@ -1,0 +1,469 @@
+"""grafttune: ledger-driven autotuner suite (``-m tune``).
+
+The properties this suite pins down (doc/autotune.md):
+
+* the ``autotune=`` grammar parse/describe round-trips exactly, every
+  malformed spelling is a typed ``TuneSpecError`` at parse time, and a
+  spec can never escape the :data:`~cxxnet_tpu.tune.KNOBS` declared-safe
+  envelope;
+* stage 1 prunes from ledger numbers alone — pruned candidates never
+  execute, and the receipt stamps the bytes that killed them;
+* the search is deterministic: same (spec, seed, probe results) yields a
+  byte-identical ``tuned_<task>.conf``, the default candidate is always
+  measured first, and an exact tie goes to the baseline;
+* a run driven by the tuned artifact is a bitwise twin of the same
+  config written by hand (through the real ExecutionPlan path);
+* the online :class:`~cxxnet_tpu.tune.TuneController` only re-plans
+  inside declared bounds, and its recompile-storm guard vetoes a move
+  BEFORE compiling — the ledger's storm sentinel never fires;
+* doc/autotune.md's grammar + knob tables cannot drift from the code.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.obs import programs
+from cxxnet_tpu.runtime import faults
+from cxxnet_tpu.serve.autoscale import BREACHED, OK, worst_verdict
+from cxxnet_tpu.tune import (KNOBS, LedgerGate, TuneController, TuneSearch,
+                             TuneSpace)
+
+from test_device_normalize import assert_params_equal, snap_params
+from test_execution_plan import _run_windowed, _trainer
+from test_io_perf import _mlp_batches
+
+pytestmark = pytest.mark.tune
+
+SPEC = ('knobs=steps_per_dispatch:1..8,nworker:1..4;budget=30;seed=7;'
+        'probe_steps=4;probe_repeats=1')
+
+
+# --- the autotune= grammar -------------------------------------------------
+
+def test_parse_describe_roundtrip():
+    space = TuneSpace.parse(SPEC)
+    assert space.mode == 'train' and space.budget == 30.0
+    assert space.seed == 7 and space.probe_steps == 4
+    again = TuneSpace.parse(space.describe())
+    assert again == space
+    assert again.describe() == space.describe()
+
+
+def test_parse_defaults_and_full_range_knob():
+    space = TuneSpace.parse('knobs=slots')
+    assert space.knob_range('slots').lo == KNOBS['slots'].lo
+    assert space.knob_range('slots').hi == KNOBS['slots'].hi
+    assert space.budget == 60.0 and space.headroom == 0.1
+    assert space.compile_budget == 8 and space.mem_mb == 0.0
+
+
+def test_mem_knobs_follow_registry():
+    space = TuneSpace.parse('knobs=steps_per_dispatch:1..4,nworker:1..4')
+    assert space.mem_knobs() == ('steps_per_dispatch',)
+
+
+@pytest.mark.parametrize('bad', [
+    'knobs=warp_speed:1..8',                  # unknown knob
+    'knobs=steps_per_dispatch:1..999',        # escapes declared envelope
+    'knobs=spec_k:-1..4',                     # below declared floor
+    'knobs=slots:8..2',                       # empty range
+    'knobs=slots:a..b',                       # non-integer range
+    'knobs=slots,slots',                      # knob listed twice
+    'knobs=',                                 # nothing to tune
+    'budget=30',                              # no knobs= at all
+    'knobs=slots;budget=30;budget=60',        # duplicate key
+    'knobs=slots;vibe=high',                  # unknown key
+    'knobs=slots;mode=predict',               # unknown mode
+    'knobs=slots;budget=0',                   # budget must be > 0
+    'knobs=slots;headroom=1.5',               # headroom in [0, 1)
+    'knobs=slots;probe_steps=0',              # probes must be >= 1
+    'knobs=slots;budget=abc',                 # unparseable value
+    'knobs=slots;;budget',                    # malformed segment
+])
+def test_malformed_specs_are_typed_errors(bad):
+    with pytest.raises(faults.TuneSpecError):
+        TuneSpace.parse(bad)
+
+
+def test_ladder_is_endpoints_plus_powers_of_two():
+    space = TuneSpace.parse('knobs=steps_per_dispatch:1..8,slots:3..12')
+    assert space.ladder('steps_per_dispatch') == (1, 2, 4, 8)
+    assert space.ladder('slots') == (3, 4, 8, 12)
+    with pytest.raises(faults.TuneSpecError):
+        space.ladder('pages')
+
+
+# --- stage 1: the ledger gate ----------------------------------------------
+
+def test_gate_prices_mem_knobs_linearly_and_prunes():
+    gate = LedgerGate(base_bytes=100.0, ceiling_bytes=350.0,
+                      baseline={'slots': 2, 'nworker': 1},
+                      mem_knobs=('slots',))
+    assert gate.predicted_bytes({'slots': 4}) == 200.0
+    ok, info = gate.admit({'slots': 4, 'nworker': 8})   # nworker is free
+    assert ok and 'pruned' not in info
+    ok, info = gate.admit({'slots': 8})
+    assert not ok and info['pruned'] == 'ledger_bytes_over_ceiling'
+    assert info['predicted_bytes'] == 400
+    assert info['ceiling_bytes'] == 350
+
+
+def test_gate_consults_budgeter_and_feasibility():
+    class Budgeter:
+        def over_budget(self, extra):
+            return extra > 50
+
+    gate = LedgerGate(base_bytes=100.0, ceiling_bytes=0.0,
+                      baseline={'slots': 1}, mem_knobs=('slots',),
+                      budgeter=Budgeter(),
+                      feasible=lambda c: 'odd_slots' if c['slots'] == 3
+                      else None)
+    assert gate.admit({'slots': 1})[0]                  # no extra bytes
+    ok, info = gate.admit({'slots': 2})                 # +100 > 50
+    assert not ok and info['pruned'] == 'memory_budgeter'
+    gate.budgeter = None
+    ok, info = gate.admit({'slots': 3})
+    assert not ok and info['pruned'] == 'odd_slots'
+
+
+# --- stage 2: the measured search ------------------------------------------
+
+def _fake_probe(table):
+    def probe(cand):
+        return table[cand['steps_per_dispatch']]
+    return probe
+
+
+def test_search_prunes_then_measures_and_picks_best():
+    space = TuneSpace.parse('knobs=steps_per_dispatch:1..8;budget=30;'
+                            'seed=3')
+    gate = LedgerGate(base_bytes=100.0, ceiling_bytes=500.0,
+                      baseline={'steps_per_dispatch': 1},
+                      mem_knobs=('steps_per_dispatch',))
+    res = TuneSearch(space, _fake_probe({1: 10.0, 2: 20.0, 4: 40.0}),
+                     gate=gate).run('train')
+    assert res.stage1_candidates == 4                   # 1, 2, 4, 8
+    assert res.stage1_pruned == 1                       # 8 prices at 800
+    assert res.measured == 3 and res.failed == 0
+    assert res.best == {'steps_per_dispatch': 4}
+    assert res.baseline == {'steps_per_dispatch': 1}
+    assert res.speedup == pytest.approx(4.0)
+    assert res.budget_honored
+    pruned = [p for p in res.probes if p.get('pruned')]
+    assert len(pruned) == 1 and pruned[0]['stage'] == 1
+    assert pruned[0]['ledger']['pruned'] == 'ledger_bytes_over_ceiling'
+    assert 'value' not in pruned[0]                     # never executed
+
+
+def test_search_measures_baseline_first_and_ties_go_to_it():
+    space = TuneSpace.parse('knobs=steps_per_dispatch:1..4;budget=30')
+    seen = []
+
+    def probe(cand):
+        seen.append(cand['steps_per_dispatch'])
+        return 5.0                                      # dead heat
+
+    res = TuneSearch(space, probe).run('train')
+    assert seen[0] == 1                                 # default first
+    assert res.best == res.baseline                     # never churn on 0
+    assert res.speedup == 1.0
+
+
+def test_search_records_probe_failures_and_keeps_going():
+    space = TuneSpace.parse('knobs=steps_per_dispatch:1..4;budget=30')
+    log = faults.FailureLog()
+
+    def probe(cand):
+        if cand['steps_per_dispatch'] == 2:
+            raise RuntimeError('device fell over')
+        return float(cand['steps_per_dispatch'])
+
+    res = TuneSearch(space, probe, failure_log=log).run('train')
+    assert res.failed == 1 and res.measured == 2
+    assert res.best == {'steps_per_dispatch': 4}
+    recs = log.records('TuneProbeError')
+    assert len(recs) == 1 and 'device fell over' in recs[0].detail
+    failed = [p for p in res.probes if 'failed' in p]
+    assert failed[0]['candidate'] == {'steps_per_dispatch': 2}
+
+
+def test_search_honors_wall_budget_and_max_probes():
+    space = TuneSpace.parse('knobs=steps_per_dispatch:1..8;budget=10')
+    t = [0.0]
+
+    def clock():
+        t[0] += 6.0                                     # 2 reads per probe
+        return t[0]
+
+    res = TuneSearch(space, _fake_probe({1: 1, 2: 2, 4: 4, 8: 8}),
+                     clock=clock).run('train')
+    assert res.measured == 1                            # baseline only
+    assert res.best == res.baseline
+    capped = TuneSearch(
+        TuneSpace.parse('knobs=steps_per_dispatch:1..8;budget=30;'
+                        'max_probes=2'),
+        _fake_probe({1: 1, 2: 2, 4: 4, 8: 8})).run('train')
+    assert capped.measured == 2
+
+
+# --- the artifact: byte-deterministic conf + receipt -----------------------
+
+def _search_twice(spec):
+    table = {1: 11.0, 2: 17.0, 4: 13.0, 8: 5.0}
+    return [TuneSearch(TuneSpace.parse(spec),
+                       _fake_probe(table)).run('train')
+            for _ in range(2)]
+
+
+def test_same_seed_spec_yields_byte_identical_conf(tmp_path):
+    spec = 'knobs=steps_per_dispatch:1..8;budget=30;seed=11'
+    a, b = _search_twice(spec)
+    assert a.conf_text() == b.conf_text()
+    assert a.best == {'steps_per_dispatch': 2}
+    p1, p2 = tmp_path / 'a.conf', tmp_path / 'b.conf'
+    a.write_conf(str(p1))
+    b.write_conf(str(p2))
+    assert p1.read_bytes() == p2.read_bytes()
+    text = p1.read_text()
+    assert f'# autotune={a.space.describe()}' in text
+    assert '# seed=11' in text
+    assert 'steps_per_dispatch=2\n' in text
+
+
+def test_receipt_stamps_counts_probes_and_budget(tmp_path):
+    spec = 'knobs=steps_per_dispatch:1..8;budget=30;seed=11'
+    res = _search_twice(spec)[0]
+    path = tmp_path / 'tuned_train.json'
+    res.write_receipt(str(path))
+    rec = json.loads(path.read_text())
+    assert rec['artifact'] == 'tuned_train.conf'
+    assert rec['spec'] == res.space.describe()
+    assert rec['counts'] == {'stage1_candidates': 4, 'stage1_pruned': 0,
+                             'measured': 4, 'failed': 0}
+    assert rec['budget_honored'] is True
+    assert rec['best'] == {'steps_per_dispatch': 2}
+    assert len(rec['probes']) == 4
+    assert all(p['stage'] == 2 for p in rec['probes'])
+
+
+# --- the tuned config is a bitwise twin of the hand-written one ------------
+
+def test_tuned_artifact_drives_bitwise_twin_of_hand_config():
+    """Search with the REAL measured probe (ExecutionPlan round_stepper
+    over a dropout MLP), then drive one training run from the artifact's
+    knob line and one from the same value written by hand — bitwise."""
+    from cxxnet_tpu.nnet.execution import measured_probe
+
+    space = TuneSpace.parse('knobs=steps_per_dispatch:1..2;budget=60;'
+                            'probe_steps=4;probe_repeats=1')
+    batches = _mlp_batches(n=4)
+
+    def probe(cand):
+        return measured_probe(_trainer(), cand['steps_per_dispatch'],
+                              batches, repeats=1)
+
+    res = TuneSearch(space, probe).run('train')
+    knob_lines = [ln for ln in res.conf_text().splitlines()
+                  if ln and not ln.startswith('#')]
+    art = dict(ln.split('=', 1) for ln in knob_lines)
+    k_art = int(art['steps_per_dispatch'])
+    assert k_art in (1, 2)
+
+    tuned, hand = _trainer(), _trainer()
+    _run_windowed(tuned, _mlp_batches(n=6), k_art)
+    _run_windowed(hand, _mlp_batches(n=6), k_art)
+    assert_params_equal(snap_params(tuned), snap_params(hand),
+                        rtol=0, atol=0)
+
+
+# --- the online leg: TuneController ----------------------------------------
+
+def _breach():
+    return {'p50': {'state': BREACHED}}
+
+
+def _ctl(spec, **kw):
+    kw.setdefault('hysteresis', 1)
+    kw.setdefault('cooldown', 0.0)
+    return TuneController(TuneSpace.parse(spec), **kw)
+
+
+def test_worst_verdict_shared_with_autoscaler():
+    assert worst_verdict({}) == OK
+    assert worst_verdict({'a': {'state': OK},
+                          'b': {'state': BREACHED}}) == BREACHED
+
+
+def test_bind_rejects_undeclared_knob_and_clamps_bounds():
+    ctl = _ctl('knobs=slots:2..8')
+    with pytest.raises(faults.TuneSpecError):
+        ctl.bind('pages', lambda v: v, 64)
+    ctl.bind('slots', lambda v: v, 8, lo=1, hi=64)  # clamped to 2..8
+    view = ctl.status_view()['knobs']['slots']
+    assert (view['lo'], view['hi']) == (2, 8)
+
+
+def test_pressure_halves_mem_knobs_toward_declared_floor():
+    ctl = _ctl('knobs=slots:1..8', verdicts=_breach)
+    moves = []
+    ctl.bind('slots', moves.append, 8)
+    for i in range(5):
+        ctl.evaluate(now=float(i))
+    assert moves == [4, 2, 1]                           # floor, then stop
+    assert ctl.knob_values()['slots'] == 1
+
+
+def test_hysteresis_and_cooldown_damp_replanning():
+    ctl = _ctl('knobs=slots:1..8', verdicts=_breach, hysteresis=2,
+               cooldown=10.0)
+    moves = []
+    ctl.bind('slots', moves.append, 8)
+    assert ctl.evaluate(now=0.0)['applied'] == []       # streak 1 < 2
+    assert ctl.evaluate(now=1.0)['applied'] == [('slots', 4)]
+    assert ctl.evaluate(now=2.0)['applied'] == []       # inside cooldown
+    assert ctl.evaluate(now=20.0)['applied'] == [('slots', 2)]
+    assert moves == [4, 2]
+
+
+def test_headroom_gauge_alone_triggers_shrink():
+    ctl = _ctl('knobs=pages:16..64;headroom=0.2',
+               gauges=lambda: {'hbm.headroom_frac.dev0': 0.05})
+    moves = []
+    ctl.bind('pages', moves.append, 64)
+    out = ctl.evaluate(now=0.0)
+    assert out['direction'] == -1 and out['headroom'] == 0.05
+    assert moves == [32]
+
+
+def test_high_accept_low_mfu_grows_spec_k():
+    feed = {'decode.spec_accept_rate': 0.9, 'train.mfu': 0.1}
+    ctl = _ctl('knobs=spec_k:0..8', gauges=lambda: dict(feed))
+    moves = []
+    ctl.bind('spec_k', moves.append, 1)
+    ctl.evaluate(now=0.0)
+    assert moves == [2]
+    feed['train.mfu'] = 0.9                             # chip busy: stop
+    assert ctl.evaluate(now=1.0)['applied'] == []
+
+
+def test_recompile_veto_fires_before_the_setter():
+    class Prog:
+        name = 'tune.fake'
+
+        def __init__(self, head):
+            self.head = head
+
+        def compile_headroom(self):
+            return self.head
+
+    log = faults.FailureLog()
+    ctl = _ctl('knobs=slots:1..8;compile_budget=8', verdicts=_breach,
+               failure_log=log)
+    moves = []
+    ctl.bind('slots', moves.append, 8, program=Prog(head=0))
+    out = ctl.evaluate(now=0.0)
+    assert out['applied'] == [] and moves == []         # setter never ran
+    assert ctl.compiles() == 0
+    recs = log.records('TuneRecompileVetoError')
+    assert len(recs) == 1 and 'tune.fake' in recs[0].detail
+    assert ctl.status_view()['vetoes'] == 1
+
+
+def test_space_compile_budget_caps_total_replans():
+    log = faults.FailureLog()
+    ctl = _ctl('knobs=slots:1..64;compile_budget=2', verdicts=_breach,
+               failure_log=log)
+    ctl.bind('slots', lambda v: v, 64, recompiles=True)
+    for i in range(6):
+        ctl.evaluate(now=float(i))
+    assert ctl.compiles() == 2                          # 64->32->16, veto
+    assert ctl.knob_values()['slots'] == 16
+    assert len(log.records('TuneRecompileVetoError')) >= 1
+
+
+def test_ticker_thread_carries_tune_prefix_and_closes():
+    ctl = TuneController(TuneSpace.parse('knobs=slots:1..8'),
+                         interval=0.02, name='t1')
+    try:
+        names = [t.name for t in threading.enumerate()]
+        assert any(n.startswith('cxxnet-tune-') for n in names)
+    finally:
+        ctl.close()
+    assert not any(t.name.startswith('cxxnet-tune-')
+                   for t in threading.enumerate() if t.is_alive())
+
+
+# --- the recompile-storm guard drill (satellite 3) -------------------------
+
+def test_storm_drill_thrashing_verdicts_never_trip_the_sentinel():
+    """Thrash the controller with BREACHED verdicts against a REAL
+    ledger program (bound=2) whose setter genuinely recompiles per knob
+    value.  The guard must veto before the sentinel's bound is crossed:
+    no ``RecompileStormError`` is recorded, compiles stay under both
+    budgets, and at least one veto is on the books."""
+    led = programs.get_ledger()
+    prog = led.program('tune.test_storm', bound=2)
+    fn = prog.jit(lambda x: x * 2.0,
+                  key_fn=lambda a, _k: f's{a[0].shape[0]}')
+    glog = faults.global_failure_log()
+    storms_before = len(glog.records('RecompileStormError'))
+
+    ctl = _ctl('knobs=slots:1..64;compile_budget=4', verdicts=_breach)
+    ctl.bind('slots', lambda v: fn(np.zeros(v, np.float32)), 64,
+             program=prog)
+    for i in range(8):                                  # thrash
+        ctl.evaluate(now=float(i))
+
+    assert len(glog.records('RecompileStormError')) == storms_before
+    assert prog.compiles <= prog.bound                  # sentinel intact
+    assert ctl.compiles() <= ctl.space.compile_budget
+    assert ctl.status_view()['vetoes'] >= 1
+    assert ctl.knob_values()['slots'] == 16             # 64->32->16, stop
+
+
+# --- doc drift (satellite 5) -----------------------------------------------
+
+def _repo_doc(rel):
+    import os
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(here, 'doc', rel)) as f:
+        return f.read()
+
+
+def test_autotune_tables_match_keys_and_knob_registry():
+    """doc/autotune.md's grammar + knob tables and the code cannot
+    drift: every TuneSpace key and every KNOBS row is documented, and
+    nothing documented is unregistered (the grammar table is the
+    knob table's prefix in the section — same slicing idiom as the
+    scenario/autoscale tables)."""
+    from cxxnet_tpu.analysis.config_keys import backtick_key, doc_table_rows
+    text = _repo_doc('autotune.md')
+    key_heading = '### The `autotune=` grammar'
+    knob_heading = '### Declared-safe knobs'
+    assert key_heading in text and knob_heading in text
+    knob_rows = doc_table_rows(text, after=knob_heading)
+    key_all = doc_table_rows(text, after=key_heading)
+    key_rows = key_all[:len(key_all) - len(knob_rows)]
+
+    def keys(rows, header):
+        return {backtick_key(r[0]) for r in rows
+                if backtick_key(r[0]) is not None and r[0] != header}
+
+    registered = set(TuneSpace.registered_keys())
+    documented = keys(key_rows, 'key')
+    assert documented == registered, (
+        f'doc minus code: {sorted(documented - registered)}, '
+        f'code minus doc: {sorted(registered - documented)}')
+    doc_knobs = keys(knob_rows, 'knob')
+    assert doc_knobs == set(KNOBS), (
+        f'doc minus code: {sorted(doc_knobs - set(KNOBS))}, '
+        f'code minus doc: {sorted(set(KNOBS) - doc_knobs)}')
+
+
+def test_tasks_doc_documents_the_autotune_surface():
+    text = _repo_doc('tasks.md')
+    assert '`autotune`' in text
+    assert 'task=autotune' in text

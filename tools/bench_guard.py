@@ -279,6 +279,100 @@ def expand_sharded(p: dict, name: str) -> Tuple[List[str], List[dict]]:
     return errs, synth
 
 
+TUNE_METRIC = 'autotune_speedup'
+
+#: the modes an autotune receipt must cover — the "beats the default on
+#: >= 2 bench modes" claim (doc/autotune.md)
+TUNE_MODES = ('scan', 'decode')
+
+
+def expand_autotune(p: dict, name: str) -> Tuple[List[str], List[dict]]:
+    """Validate one ``autotune_speedup`` payload and expand its per-mode
+    throughputs into synthetic payloads for regression flags."""
+    errs: List[str] = []
+    synth: List[dict] = []
+    plat = p.get('platform')
+    modes = p.get('modes')
+    if not isinstance(modes, dict):
+        return [f'{name}: autotune receipt has no per-mode legs'], []
+    speedups = []
+    for mode in TUNE_MODES:
+        leg = modes.get(mode)
+        if not isinstance(leg, dict):
+            errs.append(f'{name}: autotune receipt has no {mode!r} leg')
+            continue
+        sp = leg.get('speedup')
+        if not (isinstance(sp, (int, float)) and sp >= 1.0):
+            errs.append(f'{name}: {mode} leg speedup {sp} < 1.0 — the '
+                        'tuned config must never lose to the default')
+        else:
+            speedups.append(sp)
+        search = leg.get('search')
+        if not isinstance(search, dict):
+            errs.append(f'{name}: {mode} leg carries no search block')
+        else:
+            if not search.get('budget_honored') or not (
+                    isinstance(search.get('wall_s'), (int, float))
+                    and isinstance(search.get('budget_s'), (int, float))
+                    and search['wall_s'] <= search['budget_s']):
+                errs.append(f'{name}: {mode} search wall '
+                            f'{search.get("wall_s")}s broke its declared '
+                            f'{search.get("budget_s")}s budget')
+            if not (isinstance(search.get('measured'), int)
+                    and search['measured'] >= 1):
+                errs.append(f'{name}: {mode} search measured no '
+                            'candidates')
+        for key, unit in (('default_steps_per_sec', 'steps/sec'),
+                          ('tuned_steps_per_sec', 'steps/sec'),
+                          ('default_tokens_per_sec', 'tokens/sec'),
+                          ('tuned_tokens_per_sec', 'tokens/sec')):
+            if key in leg:
+                synth.append({'metric': f'autotune_{mode}_{key}',
+                              'value': leg.get(key), 'unit': unit,
+                              'platform': plat})
+    if modes.get('scan', {}).get('bitwise_equal') is not True:
+        errs.append(f'{name}: scan leg is not bitwise-asserted — the '
+                    'speedup could be bought with a semantics drift')
+    if modes.get('decode', {}).get('stream_twins') is not True:
+        errs.append(f'{name}: decode leg streams were not twin-checked')
+    search = p.get('search')
+    if not isinstance(search, dict):
+        errs.append(f'{name}: autotune receipt has no aggregate search '
+                    'block')
+    else:
+        if not search.get('budget_honored'):
+            errs.append(f'{name}: aggregate search broke its declared '
+                        'budget')
+        if not (isinstance(search.get('stage1_pruned'), int)
+                and search['stage1_pruned'] >= 1):
+            errs.append(f'{name}: stage 1 pruned nothing '
+                        f'({search.get("stage1_pruned")}) — the ledger '
+                        'gate never demonstrably gated')
+    guard = p.get('storm_guard')
+    if not isinstance(guard, dict):
+        errs.append(f'{name}: autotune receipt has no storm-guard drill')
+    else:
+        if guard.get('storm_errors') != 0:
+            errs.append(f'{name}: storm-guard drill recorded '
+                        f'{guard.get("storm_errors")} RecompileStormError'
+                        '(s) — the guard exists to make this 0')
+        if not (isinstance(guard.get('compiles'), int)
+                and isinstance(guard.get('compile_budget'), int)
+                and guard['compiles'] <= guard['compile_budget']):
+            errs.append(f'{name}: drill compiles '
+                        f'{guard.get("compiles")} exceed the declared '
+                        f'budget {guard.get("compile_budget")}')
+        if not guard.get('vetoes'):
+            errs.append(f'{name}: the drill never vetoed a re-plan — '
+                        'it did not exercise the guard')
+    value = p.get('value')
+    if speedups and isinstance(value, (int, float)) \
+            and abs(value - min(speedups)) > 1e-6:
+        errs.append(f'{name}: headline {value} is not the worst-mode '
+                    f'speedup ({min(speedups)})')
+    return errs, synth
+
+
 def check_file(path: str) -> Tuple[List[str], List[dict]]:
     """(errors, payloads) for one receipt file."""
     name = os.path.basename(path)
@@ -307,6 +401,10 @@ def check_file(path: str) -> Tuple[List[str], List[dict]]:
         elif p.get('metric') == SHARD_METRIC:
             s_errs, synth = expand_sharded(p, name)
             errs.extend(s_errs)
+            extra.extend(synth)
+        elif p.get('metric') == TUNE_METRIC:
+            t_errs, synth = expand_autotune(p, name)
+            errs.extend(t_errs)
             extra.extend(synth)
     return errs, loads + extra
 
