@@ -1700,6 +1700,273 @@ eval_train = 0
     return 0 if err <= 0.02 else 1
 
 
+_CNN_FUSED_CONF = """
+netconfig = start
+layer[0->1] = conv:c1
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+layer[1->1] = relu
+layer[1->2] = max_pooling
+  kernel_size = 2
+  stride = 2
+layer[2->3] = conv:c2
+  kernel_size = 3
+  pad = 1
+  nchannel = 16
+layer[3->3] = relu
+layer[3->4] = flatten
+layer[4->5] = fullc:fc1
+  nhidden = 10
+layer[5->6] = softmax
+netconfig = end
+
+input_shape = 3,12,12
+eta = 0.01
+momentum = 0.9
+metric = error
+eval_train = 0
+random_type = xavier
+"""
+
+# the fold leg's topology: conv+BN stacks, the shape serve.fold_bn
+# rewrites (doc/kernels.md "Inference conv+BN folding")
+_CNN_FOLD_CONF = """
+netconfig = start
+layer[0->1] = conv:c1
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+layer[1->2] = batch_norm:bn1
+layer[2->3] = relu
+layer[3->4] = conv:c2
+  kernel_size = 3
+  pad = 1
+  stride = 2
+  nchannel = 16
+layer[4->5] = batch_norm:bn2
+layer[5->6] = relu
+layer[6->7] = flatten
+layer[7->8] = fullc:fc1
+  nhidden = 10
+layer[8->9] = softmax
+netconfig = end
+
+input_shape = 3,12,12
+random_type = xavier
+"""
+
+
+def bench_cnn_fused() -> int:
+    """graftfuse A/B (doc/kernels.md), three legs in ONE receipt:
+
+    * **train** — fused Pallas conv+bias+relu blocks (``fuse=1``) vs the
+      unfused XLA composition (``fuse=0``), steps/sec by the K-vs-1 scan
+      quotient; final params after identical update streams are
+      twin-asserted within the fused block's pinned tolerance
+      (``ops/pallas_cnn``) IN the bench — a speedup over diverging math
+      is not a speedup;
+    * **inference** — a real ``PredictEngine`` with ``fold_bn=1``
+      (conv+BN folded at build time, nnet/fold.py) vs the unfolded
+      engine, rows/sec; scores twin-asserted within the fold pass's
+      pinned tolerance, ``fold_view`` stamped;
+    * **micro_batch sweep** — μ-cuDNN-style conv microbatching at every
+      declared split: steps/sec AND the ``train.step`` program's
+      ledger ``peak_bytes`` (compiler truth, obs/programs.py) per
+      split, with final params bitwise-asserted against the unsplit
+      trainer — the split bounds peak HBM, it never changes the math.
+
+    On a cpu host the fused leg runs the Pallas block in interpret mode
+    — the twins are real correctness proofs, the speedups are not chip
+    numbers (the receipt's ``platform`` stamp + self-heal handle that).
+    """
+    import jax
+
+    from cxxnet_tpu.nnet.fold import FOLD_ATOL, FOLD_RTOL
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.obs.programs import get_ledger
+    from cxxnet_tpu.ops.pallas_cnn import _FUSED_ATOL, _FUSED_RTOL
+    from cxxnet_tpu.serve.engine import PredictEngine
+    from cxxnet_tpu.utils.config import parse_config_string
+
+    plat = jax.devices()[0].platform
+    led = get_ledger()
+    batch = _bench_batch(8)
+    steps = _bench_steps(6)
+    rng = np.random.RandomState(0)
+    data = rng.randn(batch, 3, 12, 12).astype(np.float32)
+    label = rng.randint(0, 10, (batch, 1)).astype(np.float32)
+
+    def make(extra: str) -> NetTrainer:
+        tr = NetTrainer(parse_config_string(
+            _CNN_FUSED_CONF + f'batch_size = {batch}\n'
+            + extra + _extra_conf()))
+        tr.init_model()
+        return tr
+
+    def train_steps(tr: NetTrainer, n: int) -> None:
+        d = tr._shard_batch(data)
+        lb = tr._shard_batch(label, cast=False)
+        for _ in range(n):
+            tr.update_on_device(d, lb)
+
+    def param_maxerr(a: NetTrainer, b: NetTrainer) -> float:
+        err = 0.0
+        for lk, fields in a.params.items():
+            for f in fields:
+                err = max(err, float(np.max(np.abs(
+                    np.asarray(a.params[lk][f], np.float32)
+                    - np.asarray(b.params[lk][f], np.float32)))))
+        return err
+
+    def steps_per_sec(tr: NetTrainer) -> float:
+        dstack = tr.shard_batch_stack(np.stack([data, data]))
+        lstack = tr.shard_batch_stack(np.stack([label, label]),
+                                      cast=False)
+        m1 = tr.compile_multi_step(1)
+        mk = tr.compile_multi_step(steps)
+
+        def run(fn, n):
+            return float(np.asarray(
+                tr.update_n_on_device(fn, dstack, lstack, n)))
+
+        per_step, _ = _quotient_per_step(
+            lambda: run(m1, 1), lambda: run(mk, steps), steps)
+        return 1.0 / per_step
+
+    # ---- leg 1: fused vs unfused training --------------------------------
+    tr_on, tr_off = make('fuse = 1\n'), make('fuse = 0\n')
+    if not tr_on.net._convact_pairs:
+        raise AssertionError('fuse=1 conf paired no conv+relu blocks — '
+                             'the A/B would measure nothing')
+    train_steps(tr_on, 4)
+    train_steps(tr_off, 4)
+    train_err = param_maxerr(tr_on, tr_off)
+    train_twin = bool(np.allclose(0.0, train_err,
+                                  rtol=_FUSED_RTOL, atol=_FUSED_ATOL))
+    if not train_twin:
+        raise AssertionError(
+            f'fused training diverged from unfused: param maxerr '
+            f'{train_err} > pinned {_FUSED_ATOL}')
+    rate_on, rate_off = steps_per_sec(tr_on), steps_per_sec(tr_off)
+    train_speedup = rate_on / rate_off
+
+    # ---- leg 2: conv+BN folded vs plain inference ------------------------
+    calib = rng.randn(batch, 3, 12, 12).astype(np.float32)
+    srv = NetTrainer(parse_config_string(
+        _CNN_FOLD_CONF + f'batch_size = {batch}\n' + _extra_conf()))
+    srv.init_model()
+    eng_plain = PredictEngine(srv, (batch,))
+    eng_fold = PredictEngine(srv, (batch,), fold_bn=1, fold_batch=calib)
+    fold_view = eng_fold.fold_view()
+    if not fold_view or not fold_view.get('pairs'):
+        raise AssertionError('fold_bn=1 planned no conv+BN pairs')
+    # the twin is the fold pass's pinned contract: equality ON the
+    # calibration batch (BN here uses incoming-batch statistics even at
+    # eval — the reference quirk — so the frozen-stats fold is exact
+    # only where its statistics came from; doc/kernels.md)
+    q = calib
+    s_plain = eng_plain.predict_scores(q)
+    s_fold = eng_fold.predict_scores(q)
+    infer_err = float(np.max(np.abs(s_fold - s_plain)))
+    infer_twin = bool(np.allclose(s_fold, s_plain,
+                                  rtol=FOLD_RTOL, atol=FOLD_ATOL))
+    if not infer_twin:
+        raise AssertionError(
+            f'folded engine diverged from unfolded: score maxerr '
+            f'{infer_err}')
+
+    def rows_per_sec(eng) -> float:
+        reps = max(4, steps)
+        eng.predict_scores(q)            # compile + warm
+        walls = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                eng.predict_scores(q)
+            walls.append(time.perf_counter() - t0)
+        return batch * reps / min(walls)
+
+    rows_fold = rows_per_sec(eng_fold)
+    rows_plain = rows_per_sec(eng_plain)
+    infer_speedup = rows_fold / rows_plain
+
+    # ---- leg 3: micro_batch sweep ----------------------------------------
+    splits = [s for s in (1, 2, 4, 8) if batch % s == 0]
+    sweep, base_snap = [], None
+
+    def snap(tr: NetTrainer) -> dict:
+        # a host copy taken BEFORE the timing loop advances the trainer
+        return {lk: {f: np.asarray(v, np.float32)
+                     for f, v in fields.items()}
+                for lk, fields in tr.params.items()}
+
+    for split in splits:
+        tr = make(f'fuse = 0\nmicro_batch = {split}\n')
+        train_steps(tr, 3)
+        if split == splits[0]:
+            base_snap, mb_err = snap(tr), 0.0
+        else:
+            mb_err = max(float(np.max(np.abs(
+                np.asarray(tr.params[lk][f], np.float32)
+                - base_snap[lk][f])))
+                for lk in base_snap for f in base_snap[lk])
+            if mb_err != 0.0:
+                raise AssertionError(
+                    f'micro_batch={split} step diverged from unsplit: '
+                    f'param maxerr {mb_err}')
+        # compiler truth: THIS trainer's train.step entry (full #N name
+        # — base-name matching would conflate the sweep's instances)
+        entries = led.entries_for(tr._prog_step.name)
+        peak = max((int(e.peak_bytes) for e in entries), default=0)
+        sweep.append({'micro_batch': split,
+                      'steps_per_sec': round(steps_per_sec(tr), 2),
+                      'peak_bytes': peak,
+                      'bitwise_equal_to_unsplit': True})
+    peaks = [r['peak_bytes'] for r in sweep]
+
+    payload = {
+        'metric': 'cnn_fused_speedup',
+        # the headline is the BEST leg: the claim is "at least one
+        # fusion wins", each leg's own number rides next to its twin
+        'value': round(max(train_speedup, infer_speedup), 4),
+        'unit': 'x',
+        'platform': plat,
+        'vs_baseline': None,
+        'train': {
+            'speedup': round(train_speedup, 4),
+            'fused_steps_per_sec': round(rate_on, 2),
+            'unfused_steps_per_sec': round(rate_off, 2),
+            'fused_pairs': len(tr_on.net._convact_pairs),
+            'twin_ok': train_twin,
+            'param_max_abs_err': train_err,
+            'rtol': _FUSED_RTOL, 'atol': _FUSED_ATOL,
+        },
+        'inference': {
+            'speedup': round(infer_speedup, 4),
+            'folded_rows_per_sec': round(rows_fold, 2),
+            'plain_rows_per_sec': round(rows_plain, 2),
+            'fold_view': fold_view,
+            'twin_ok': infer_twin,
+            'score_max_abs_err': infer_err,
+            'rtol': FOLD_RTOL, 'atol': FOLD_ATOL,
+        },
+        'micro_batch': {
+            'sweep': sweep,
+            'peak_bytes_monotone': bool(
+                all(a >= b for a, b in zip(peaks, peaks[1:]))),
+        },
+        'batch': batch,
+        'programs': _program_summary(),
+        'receipt_file': 'BENCH_CNN_r01.json',
+        'timing': 'train legs scan-in-jit K-vs-1 quotient; inference '
+                  'legs best-of-4 wall; every A/B twin-asserted in-bench',
+    }
+    _write_receipt_file(payload)
+    _emit(payload)
+    return 0
+
+
 def bench_autotune() -> int:
     """grafttune A/B (doc/autotune.md): run the two-stage search on TWO
     bench modes — the supervised train scan and serve decode — then
@@ -2032,6 +2299,7 @@ _MODES = {'alexnet': ('alexnet_images_per_sec_per_chip', bench_alexnet),
           'transformer': ('transformer_tokens_per_sec_per_chip',
                           bench_transformer),
           'decode': ('decode_tokens_per_sec_per_chip', bench_decode),
+          'cnn_fused': ('cnn_fused_speedup', bench_cnn_fused),
           'autotune': ('autotune_speedup', bench_autotune)}
 
 
@@ -2058,6 +2326,10 @@ _HEALABLE = {
     # the decode batching curve is host-bound — the tuned-choice story
     # deserves a real chip's cost surface
     'autotune_speedup': ('bench.py', 'autotune'),
+    # BENCH_CNN_r01: interpret-mode Pallas proves the fused block's
+    # MATH (the twins), never its speed — the fused-vs-XLA and
+    # fold-vs-plain ratios only mean anything compiled for a real chip
+    'cnn_fused_speedup': ('bench.py', 'cnn_fused'),
 }
 
 
@@ -2070,9 +2342,10 @@ def heal_candidates(root: str):
     supersedes the stale one."""
     import glob
     state: dict = {}
+    # receipts/bench_*.json covers both families of healed receipts
+    # (bench_serve_<mode>.json and this script's own bench_<mode>.json)
     paths = (glob.glob(os.path.join(root, 'BENCH*.json'))
-             + glob.glob(os.path.join(root, 'receipts',
-                                      'bench_serve_*.json')))
+             + glob.glob(os.path.join(root, 'receipts', 'bench_*.json')))
     # newest file wins by mtime (ties broken by name): a cpu-fallback
     # trajectory entry committed AFTER an old heal receipt must read as
     # stale again, not stay masked by it
@@ -2123,7 +2396,8 @@ def self_heal_receipts(root: Optional[str] = None, runner=None) -> list:
     """The trajectory's self-healing pass (ROADMAP item 4 tail): when a
     bench run finds the real TPU up, any flash/int8 ledger entry still
     stamped ``cpu-fallback`` is re-measured automatically and the healed
-    receipt lands in ``receipts/bench_serve_<mode>.json`` — the
+    receipt lands in ``receipts/bench_serve_<mode>.json`` (bench.py's
+    own healable modes: ``receipts/bench_<mode>.json``) — the
     trajectory repairs itself the first time the tunnel cooperates,
     instead of waiting for someone to remember a manual rerun.  Returns
     the healed (metric, receipt_path) pairs; never raises — a failed
@@ -2160,7 +2434,14 @@ def self_heal_receipts(root: Optional[str] = None, runner=None) -> list:
                             f'{payload.get("platform")!r}, not a chip'})
             continue
         payload['heals'] = stale_path
-        out = os.path.join(root, 'receipts', f'bench_serve_{mode}.json')
+        # the healed receipt's name follows the script that measured it:
+        # bench_serve.py modes keep their bench_serve_<mode>.json slot,
+        # this script's own modes (autotune, cnn_fused) land in
+        # bench_<mode>.json — the same path main() points at when the
+        # tunnel is down
+        prefix = ('bench_serve' if script == 'bench_serve.py'
+                  else 'bench')
+        out = os.path.join(root, 'receipts', f'{prefix}_{mode}.json')
         os.makedirs(os.path.dirname(out), exist_ok=True)
         with open(out, 'w') as f:
             json.dump(payload, f, indent=1)

@@ -23,9 +23,14 @@ Traced scope is resolved statically per module:
   assignment, which the kernel modules' idiom uses).  A kernel body is
   the most traced scope there is: a host sync inside one doesn't just
   slow a dispatch, it breaks compilation on real hardware while
-  silently "working" under ``interpret=True`` on CPU.  Known soundness
-  limit: a kernel that reaches ``pallas_call`` through a helper's
-  *parameter* (``_lrn_call(kernel, ...)``) is not resolved statically,
+  silently "working" under ``interpret=True`` on CPU.  A kernel that
+  reaches ``pallas_call`` through a helper's *parameter*
+  (``_lrn_call(kernel, ...)`` where the helper forwards ``kernel`` into
+  the call position) IS resolved, one call level deep: the helper's
+  forwarding parameters are computed from its body, and the caller's
+  matching argument (positional or keyword, directly or through
+  ``partial``) is marked traced.  Remaining soundness limit: two or
+  more levels of parameter indirection,
 * anything lexically nested inside a traced function.
 
 Only the hot-loop modules are scanned (``TARGET_FILES``): the contract
@@ -47,7 +52,8 @@ RULES = ('tracer-hygiene',)
 TARGET_FILES = ('cxxnet_tpu/nnet/trainer.py',
                 'cxxnet_tpu/nnet/execution.py',
                 'cxxnet_tpu/serve/decode.py',
-                'cxxnet_tpu/ops/pallas_kernels.py')
+                'cxxnet_tpu/ops/pallas_kernels.py',
+                'cxxnet_tpu/ops/pallas_cnn.py')
 
 #: function-argument positions per wrapper.  lax combinators demand a
 #: `lax` qualifier (``jax.tree.map`` is NOT ``lax.map``); jit/pmap/vmap
@@ -102,6 +108,7 @@ class _Scope:
         self._local_defs: dict = {}                # (parent, name) -> def
         self._methods: dict = {}                   # (class, name) -> def
         self._assigns: dict = {}            # (parent, name) -> value expr
+        self._fwd_cache: dict = {}   # helper def -> ((pos, name), ...)
         self._index(mod.tree, None, None)
         self._mark(mod.tree)
 
@@ -159,6 +166,43 @@ class _Scope:
             return self._methods.get((cls, name[5:]))
         return None
 
+    def _forwarded_params(self, helper: ast.AST):
+        """Parameters of ``helper`` that flow into a traced HOF position
+        inside its own body — the ``_lrn_call(kernel, ...)`` indirection:
+        a helper taking ``kernel`` and forwarding it into
+        ``pl.pallas_call(kernel, ...)`` (directly or via ``partial``)
+        makes the CALLER's matching argument a traced function.  One
+        level only: a helper forwarding into another helper is the
+        documented remaining limit.  Returns ``((position, name), ...)``.
+        """
+        cached = self._fwd_cache.get(helper)
+        if cached is not None:
+            return cached
+        names: Set[str] = set()
+        for node in ast.walk(helper):
+            if not isinstance(node, ast.Call):
+                continue
+            is_hof, idxs = _hof_positions(dotted_name(node.func) or '')
+            if not is_hof:
+                continue
+            args = range(len(node.args)) if idxs is None else idxs
+            for i in args:
+                if i >= len(node.args):
+                    continue
+                a = node.args[i]
+                if isinstance(a, ast.Call):
+                    # partial(kernel, ...) in the HOF position
+                    fname = dotted_name(a.func) or ''
+                    if fname.split('.')[-1] == 'partial' and a.args:
+                        a = a.args[0]
+                if isinstance(a, ast.Name):
+                    names.add(a.id)
+        pos = helper.args.posonlyargs + helper.args.args
+        out = tuple((j, a.arg) for j, a in enumerate(pos)
+                    if a.arg in names)
+        self._fwd_cache[helper] = out
+        return out
+
     def _mark(self, tree: ast.AST) -> None:
         # decorators
         for node in ast.walk(tree):
@@ -184,6 +228,23 @@ class _Scope:
                             if i < len(child.args):
                                 t = self._resolve(child.args[i],
                                                   fn_parent, cls)
+                                if t is not None:
+                                    self.traced.add(t)
+                    else:
+                        # helper indirection: _lrn_call(kernel, ...)
+                        # where the helper forwards a parameter into a
+                        # HOF position — the caller's argument is traced
+                        helper = self._resolve(child.func, fn_parent, cls)
+                        if isinstance(helper, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef)):
+                            for j, pname in self._forwarded_params(helper):
+                                a = child.args[j] \
+                                    if j < len(child.args) else next(
+                                        (kw.value for kw in child.keywords
+                                         if kw.arg == pname), None)
+                                if a is None:
+                                    continue
+                                t = self._resolve(a, fn_parent, cls)
                                 if t is not None:
                                     self.traced.add(t)
                 walk(child, nparent, ncls)

@@ -130,6 +130,12 @@ class LayerParam:
     # auto | native (lax.conv) | im2col (patches GEMM, shallow inputs) |
     # split (per-group convs instead of feature_group_count)
     conv_lowering: str = 'auto'
+    # μ-cuDNN-style conv microbatching (beyond reference): split the
+    # conv's batch axis into this many sequential slices to bound the
+    # layer's live workspace; bitwise-equal to unsplit by construction
+    # (ops/pallas_cnn.microbatched_conv) and priced by grafttune's
+    # LedgerGate as a mem_inv knob
+    micro_batch: int = 1
 
     def set_param(self, name: str, val: str) -> None:
         if name == 'init_sigma':
@@ -175,6 +181,10 @@ class LayerParam:
             if val not in ('auto', 'native', 'im2col', 'split', 's2d'):
                 raise ValueError(f'conv_lowering: unknown mode {val}')
             self.conv_lowering = val
+        if name == 'micro_batch':
+            if int(val) < 1:
+                raise ValueError(f'micro_batch: must be >= 1, got {val}')
+            self.micro_batch = int(val)
 
     def rand_init_weight(self, rng: jax.Array, shape: Tuple[int, ...],
                          in_num: int, out_num: int,

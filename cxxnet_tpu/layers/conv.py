@@ -94,6 +94,18 @@ def conv_s2d(x, w, strides, pad):
     return out[:, :out_h, :out_w, :]
 
 
+def _conv_native_mb(x, w, strides, pad, groups):
+    """Module-level adapter handed to ``microbatched_conv`` (a stable,
+    hashable nondiff arg — closures would retrace per call)."""
+    return conv_native(x, w, strides, pad, groups)
+
+
+def _conv_im2col_mb(x, w, strides, pad, groups):
+    """im2col adapter for microbatching; the routing gate guarantees
+    ``groups == 1`` (im2col targets ungrouped convs)."""
+    return conv_im2col(x, w, strides, pad)
+
+
 def conv_split(x, w, strides, pad, groups):
     """Per-group convs + concat instead of feature_group_count: lets XLA
     pick each group's layout independently (grouped convs halve the
@@ -173,6 +185,19 @@ class ConvolutionLayer(Layer):
             return 'native'
         return mode
 
+    def _micro_split(self, mode: str, batch: int) -> int:
+        """Resolve the ``micro_batch`` knob for this dispatch: engage
+        only on the per-example-independent lowerings (native/im2col —
+        s2d/split reshape the batch themselves) when the split divides
+        the batch evenly; anything else falls through to unsplit, which
+        is bitwise-identical anyway."""
+        split = self.param.micro_batch
+        if split <= 1 or mode not in ('native', 'im2col'):
+            return 1
+        if batch % split:
+            return 1
+        return split
+
     def forward(self, params, inputs, ctx):
         p = self.param
         x = inputs[0]  # (b, y, x, c)
@@ -183,7 +208,13 @@ class ConvolutionLayer(Layer):
         strides = (p.stride, p.stride)
         pad = ((p.pad_y, p.pad_y), (p.pad_x, p.pad_x))
         mode = self._lowering()
-        if mode == 'im2col':
+        split = self._micro_split(mode, x.shape[0])
+        if split > 1:
+            from ..ops.pallas_cnn import microbatched_conv
+            fn = _conv_im2col_mb if mode == 'im2col' else _conv_native_mb
+            out = microbatched_conv(x, w, strides, pad, p.num_group,
+                                    split, fn)
+        elif mode == 'im2col':
             out = conv_im2col(x, w, strides, pad)
         elif mode == 's2d':
             out = conv_s2d(x, w, strides, pad)

@@ -75,6 +75,18 @@ class LRNLayer(Layer):
         return [(x32 * jnp.power(norm, -self.beta)).astype(x.dtype)]
 
 
+def fold_scale_shift(gamma, beta, mean, var, eps):
+    """The conv+BN fold algebra (nnet/fold.py): with frozen statistics
+    ``(mean, var)``, BN is the affine map ``y = z*scale + shift`` with
+    ``scale = gamma/sqrt(var+eps)`` and ``shift = beta - mean*scale`` —
+    which a preceding conv absorbs as ``w*scale`` (output-channel axis)
+    and ``b*scale + shift``.  All f32; the sqrt spelling matches
+    ``BatchNormLayer.forward`` exactly so the fold's frozen-stats
+    normalization is the same float program as the live one."""
+    scale = gamma / jnp.sqrt(var + eps)
+    return scale, beta - mean * scale
+
+
 @register_layer
 class BatchNormLayer(Layer):
     type_name = 'batch_norm'

@@ -65,6 +65,10 @@ class LearnTask:
         self.scan_strict = 0           # 1 = a demotion raises
                                        # ScanStrictError instead of
                                        # silently falling back per-step
+        # graftfuse: μ-cuDNN-style conv microbatching (doc/kernels.md);
+        # replayed into every conv layer as a netconfig global — this
+        # attr only anchors the autotuner's baseline candidate
+        self.micro_batch = 1
         # grafttune: task=autotune searches this declared space
         # (doc/autotune.md); parsed at init so a bad spec fails fast
         self.autotune = ''
@@ -98,6 +102,8 @@ class LearnTask:
         self.serve_models = ''         # serve.models fleet: id=dir;id=dir
         self.serve_mem_budget = 0      # serve.mem_budget bytes (0 = off)
         self.serve_dtype = 'f32'       # serve.dtype: f32 | bf16 | int8
+        self.serve_fold_bn = 0         # serve.fold_bn: 1 = fold conv+BN
+                                       # at engine build (doc/kernels.md)
         self.serve_flash = 'auto'      # serve.flash_decode: auto | 0 | 1
         self.serve_prefix_share = 0    # serve.prefix_share index pages (0=off)
         # graftcache: tiered KV prefix cache (doc/serving.md "Tiered KV
@@ -188,6 +194,8 @@ class LearnTask:
             'train.steps_per_dispatch': ('steps_per_dispatch', int),
             'scan_strict': ('scan_strict', int),
             'train.scan_strict': ('scan_strict', int),
+            'micro_batch': ('micro_batch', int),
+            'train.micro_batch': ('micro_batch', int),
             'serve.buckets': ('serve_buckets', str),
             'serve.max_queue': ('serve_max_queue', int),
             'serve.max_wait': ('serve_max_wait', float),
@@ -209,6 +217,7 @@ class LearnTask:
             'serve.models': ('serve_models', str),
             'serve.mem_budget': ('serve_mem_budget', int),
             'serve.dtype': ('serve_dtype', str),
+            'serve.fold_bn': ('serve_fold_bn', int),
             'serve.flash_decode': ('serve_flash', str),
             'serve.prefix_share': ('serve_prefix_share', int),
             'serve.kv_host_mb': ('serve_kv_host_mb', int),
@@ -935,11 +944,12 @@ class LearnTask:
             engine = ReplicatedPredictEngine(
                 self.net_trainer, parse_buckets(self.serve_buckets),
                 dtype=self.serve_dtype, replicas=self.serve_replicas,
-                stats=_SS())
+                stats=_SS(), fold_bn=self.serve_fold_bn)
         else:
             engine = PredictEngine(self.net_trainer,
                                    parse_buckets(self.serve_buckets),
-                                   dtype=self.serve_dtype)
+                                   dtype=self.serve_dtype,
+                                   fold_bn=self.serve_fold_bn)
         engine.warm()
         if not self.silent:
             nrep = getattr(engine, 'engines', None)
@@ -948,6 +958,13 @@ class LearnTask:
                   f'{engine.resident_bytes()} resident bytes'
                   + (f', {len(nrep)} replicas' if nrep else '') + ')',
                   flush=True)
+            fv = getattr(engine, 'fold_view', lambda: None)()
+            if fv:
+                pairs = ','.join(f'{c}+{b}' for c, b in fv['pairs'])
+                print(f'serve: folded {len(fv["pairs"])} conv+BN pair(s) '
+                      f'[{pairs}] — proof max_abs_err '
+                      f'{fv["max_abs_err"]:.3g} on the calibration batch',
+                      flush=True)
         batcher = DynamicBatcher(engine, max_queue=self.serve_max_queue,
                                  max_wait=self.serve_max_wait,
                                  deadline=self.serve_deadline,
@@ -1448,6 +1465,7 @@ class LearnTask:
         return LedgerGate(base_bytes=float(base), ceiling_bytes=ceiling,
                           baseline=baseline,
                           mem_knobs=space.mem_knobs(),
+                          mem_inv_knobs=space.mem_inv_knobs(),
                           feasible=feasible)
 
     def _tune_baseline(self, space) -> dict:
@@ -1458,6 +1476,7 @@ class LearnTask:
                    'page_size': self.serve_page_size,
                    'spec_k': self.serve_spec_k,
                    'max_queue': self.serve_max_queue,
+                   'micro_batch': self.micro_batch,
                    'nworker': 1}
         if self._data_itcfg:
             for name, val in self._data_itcfg:
@@ -1467,6 +1486,18 @@ class LearnTask:
         for r in space.knobs:
             out[r.name] = max(r.lo, min(r.hi, int(current[r.name])))
         return out
+
+    def _set_micro_batch(self, value: int) -> None:
+        """Apply a candidate ``micro_batch`` to every layer of the LIVE
+        trainer and rebuild its step programs: the knob is read at trace
+        time (layers/conv.py ``_micro_split``), so an already-compiled
+        program would never see the change.  Re-running the convact
+        fusion pass keeps its micro_batch>1 exclusion honest."""
+        tr = self.net_trainer
+        for layer in tr.net.layers:
+            layer.param.micro_batch = int(value)
+        tr.net._build_convact_fusion()
+        tr._compile_steps()
 
     def _rebuild_train_iterator(self, nworker: int):
         itcfg = [(n, v) for n, v in (self._data_itcfg or [])
@@ -1503,17 +1534,30 @@ class LearnTask:
                                  repeats=1)
         gate = self._tune_gate(space, baseline)
 
+        base_mb = baseline.get('micro_batch', self.micro_batch)
+        applied_mb = [base_mb]
+
         def probe(cand):
             pb = batches
             if 'nworker' in cand and cand['nworker'] != baseline['nworker']:
                 itr = self._rebuild_train_iterator(cand['nworker'])
                 pb = list(_it.islice(iter(itr), space.probe_steps))
+            mb = int(cand.get('micro_batch', base_mb))
+            if mb != applied_mb[0]:
+                self._set_micro_batch(mb)
+                applied_mb[0] = mb
             k = cand.get('steps_per_dispatch', base_k)
             return execution.measured_probe(
                 self.net_trainer, k, pb, repeats=space.probe_repeats)
 
-        return TuneSearch(space, probe, gate=gate,
-                          baseline=baseline).run('train')
+        try:
+            return TuneSearch(space, probe, gate=gate,
+                              baseline=baseline).run('train')
+        finally:
+            # probes mutate the live trainer; leave it at the hand-set
+            # split, not whatever the last candidate happened to be
+            if applied_mb[0] != base_mb:
+                self._set_micro_batch(base_mb)
 
     def _autotune_decode(self, space):
         """mode=decode probes: tokens/sec of a real DecodeService built

@@ -1,0 +1,162 @@
+"""Inference-time conv+BN folding (the graftfuse DAG rewrite).
+
+At serving time a BatchNorm behind a convolution is an affine map the
+conv can absorb: ``w' = w * scale`` (output-channel axis) and
+``b' = b * scale + shift`` with ``scale = gamma/sqrt(var+eps)``,
+``shift = beta - mean*scale`` (layers/norm.py ``fold_scale_shift``).
+One HLO op replaces three, and the PredictEngine's ProgramLedger entry
+shows the fused program's compiler-truth flops/bytes (`/programs`).
+
+**The frozen-stats caveat.**  This codebase reproduces the reference's
+BatchNorm exactly, and the reference keeps NO running averages —
+evaluation normalizes with *current-minibatch* statistics
+(doc/layer.md:258 parity quirk).  A static fold therefore cannot equal
+the live BN on arbitrary batches; it must **freeze** the statistics of
+one calibration batch at fold time.  The pass runs the unfused net once
+on the calibration batch, captures each BN's input, folds its
+batch statistics into the conv, and then **proves** the rewrite: the
+folded forward (BN retired to a pass-through via ``Net.forward``'s
+``identity_layers``) must match the unfused forward on the calibration
+batch within the pinned ``FOLD_RTOL``/``FOLD_ATOL`` — never looser at a
+call site (the PR 10 quant rule) — or ``FoldError`` is raised and the
+caller keeps the unfused graph.  On any *other* batch the folded net is
+a fixed-statistics approximation; that is a semantic choice the serving
+layer opts into explicitly (``serve.fold_bn=1``), not a silent default.
+
+The params tree keeps its treedef: the BN's (now unused) slope/bias
+stay in place, so checkpoint loading, hot-swap shape checks, and the
+quantizer all see the structure they expect.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..layers import ForwardContext
+from ..layers.conv import ConvolutionLayer
+from ..layers.norm import BatchNormLayer, fold_scale_shift
+
+#: pinned fold-vs-unfused equality tolerances on the calibration batch
+#: (f32 serving): scaling w before the conv vs scaling the conv's output
+#: reorders one multiply against the reduction, so equality is pinned
+#: here, once, and asserted by the pass itself AND the tests/bench.
+FOLD_RTOL = 1e-4
+FOLD_ATOL = 1e-5
+
+
+class FoldError(RuntimeError):
+    """The folded forward failed its pinned equality proof."""
+
+
+def plan_conv_bn_pairs(net) -> List[Tuple[int, int]]:
+    """Statically find foldable (conv, batch_norm) layer pairs.
+
+    Eligibility: a 1-in/1-out conv **with a bias** (the fold needs a
+    bias to absorb the shift without changing the params treedef) whose
+    output (node, version) is read by exactly ONE layer — a 1-in/1-out
+    BatchNorm — with neither layer's params shared (folding shared
+    weights would corrupt the other use site).
+    """
+    pairs: List[Tuple[int, int]] = []
+    reads, writes = net._node_version_maps()
+    readers: Dict[tuple, List[int]] = {}
+    for i, rs in enumerate(reads):
+        for nv in rs:
+            readers.setdefault(nv, []).append(i)
+    shared = {p for p in net.layer_primary
+              if net.layer_primary.count(p) > 1}
+    for i, layer in enumerate(net.layers):
+        if not isinstance(layer, ConvolutionLayer):
+            continue
+        info = net.cfg.layers[i]
+        if (layer.param.no_bias != 0 or len(info.nindex_in) != 1
+                or len(info.nindex_out) != 1 or i in shared
+                or net.layer_primary[i] != i):
+            continue
+        out_nv = next(iter(writes[i]))
+        rd = readers.get(out_nv, [])
+        if len(rd) != 1:
+            continue
+        b = rd[0]
+        binfo = net.cfg.layers[b]
+        if (isinstance(net.layers[b], BatchNormLayer)
+                and len(binfo.nindex_in) == 1
+                and len(binfo.nindex_out) == 1
+                and b not in shared and net.layer_primary[b] == b):
+            pairs.append((i, b))
+    return pairs
+
+
+def _top_node(net) -> int:
+    return net.cfg.layers[-1].nindex_out[-1]
+
+
+def fold_params(net, params, calib_batch, *, compute_dtype=jnp.float32,
+                extra_data=None, verify: bool = True):
+    """Fold every plannable conv+BN pair of ``net`` into new params.
+
+    Runs the unfused forward once on ``calib_batch`` (eager, eval mode)
+    to capture each BN's input, freezes its minibatch statistics into
+    the preceding conv's weights/bias, and (unless ``verify=False``)
+    proves the folded forward equal to the unfused one on the same
+    batch within the pinned tolerances.
+
+    Returns ``(folded_params, report)`` where ``report`` carries the
+    folded pair names, the retired BN layer indices (feed them to
+    ``Net.forward(identity_layers=...)``), and the measured proof error.
+    A net with no foldable pairs returns the params unchanged.
+    """
+    pairs = plan_conv_bn_pairs(net)
+    report = {'pairs': [], 'bn_layers': frozenset(),
+              'max_abs_err': 0.0, 'rtol': FOLD_RTOL, 'atol': FOLD_ATOL}
+    if not pairs:
+        return params, report
+    ctx = ForwardContext(is_train=False, rng=jax.random.PRNGKey(0),
+                         compute_dtype=compute_dtype)
+    capture = {b: None for (_, b) in pairs}
+    values, _ = net.forward(params, calib_batch, ctx,
+                            extra_data=extra_data, capture=capture)
+    folded = {k: dict(v) for k, v in params.items()}
+    for conv_i, bn_i in pairs:
+        bn = net.layers[bn_i]
+        xin = capture[bn_i][0].astype(jnp.float32)
+        axes = tuple(range(xin.ndim - 1))
+        # EXACTLY BatchNormLayer.forward's statistics spelling
+        mean = jnp.mean(xin, axis=axes)
+        var = jnp.mean((xin - mean) ** 2, axis=axes)
+        bp = params[str(bn_i)]
+        scale, shift = fold_scale_shift(
+            bp['wmat'].astype(jnp.float32), bp['bias'].astype(jnp.float32),
+            mean, var, bn.eps)
+        cp = params[str(conv_i)]
+        w, b = cp['wmat'], cp['bias']
+        folded[str(conv_i)]['wmat'] = (
+            w.astype(jnp.float32) * scale).astype(w.dtype)
+        folded[str(conv_i)]['bias'] = (
+            b.astype(jnp.float32) * scale + shift).astype(b.dtype)
+        report['pairs'].append(
+            (net.cfg.layers[conv_i].name or str(conv_i),
+             net.cfg.layers[bn_i].name or str(bn_i)))
+    bn_layers = frozenset(b for (_, b) in pairs)
+    report['bn_layers'] = bn_layers
+    if verify:
+        fvalues, _ = net.forward(folded, calib_batch, ctx,
+                                 extra_data=extra_data,
+                                 identity_layers=bn_layers)
+        top = _top_node(net)
+        ref, got = values[top], fvalues[top]
+        err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                    - ref.astype(jnp.float32))))
+        bound = FOLD_ATOL + FOLD_RTOL * float(
+            jnp.max(jnp.abs(ref.astype(jnp.float32))))
+        report['max_abs_err'] = err
+        if err > bound:
+            raise FoldError(
+                f'conv+BN fold failed its equality proof on the '
+                f'calibration batch: max|Δ|={err:.3e} > {bound:.3e} '
+                f'(pinned rtol={FOLD_RTOL}, atol={FOLD_ATOL}) for pairs '
+                f'{report["pairs"]}')
+    return folded, report

@@ -56,9 +56,9 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ['ProgramLedger', 'LedgerProgram', 'ProgramEntry', 'get_ledger',
-           'install_ledger', 'DeviceMemory', 'register_hbm',
-           'ProfilerSession', 'profile_session', 'peak_flops', 'mfu',
-           'PEAK_BF16_TFLOPS']
+           'install_ledger', 'peak_bytes_for', 'DeviceMemory',
+           'register_hbm', 'ProfilerSession', 'profile_session',
+           'peak_flops', 'mfu', 'PEAK_BF16_TFLOPS']
 
 
 # --- per-platform peak FLOPs (MFU denominators) -----------------------------
@@ -921,3 +921,19 @@ def install_ledger(ledger: Optional[ProgramLedger]
     with _MOD_LOCK:
         prev, _LEDGER = _LEDGER, ledger
     return prev
+
+
+def peak_bytes_for(name: str, ledger: Optional[ProgramLedger] = None) -> int:
+    """Compiler-truth peak HBM bytes of one program family: the max
+    ``memory_analysis`` peak over every analyzed entry whose base name
+    matches ``name`` (``#N`` re-claim suffixes included).  The one
+    number the ``micro_batch`` bench sweep and the autotuner's memory
+    gate compare across candidate splits — 0 when nothing under the
+    name has compiled yet (never a guess)."""
+    led = ledger if ledger is not None else get_ledger()
+    led.ensure_analyzed_batch()
+    peak = 0
+    for e in led.entries():
+        if ProgramLedger._base_name(e.name) == name:
+            peak = max(peak, int(e.peak_bytes))
+    return peak

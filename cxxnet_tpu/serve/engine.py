@@ -70,7 +70,8 @@ class PredictEngine:
     def __init__(self, trainer: NetTrainer,
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
                  dtype: str = 'f32', device=None,
-                 program_name: str = 'serve.predict'):
+                 program_name: str = 'serve.predict',
+                 fold_bn: int = 0, fold_batch=None):
         if trainer.net is None or trainer.params is None:
             raise ValueError('PredictEngine needs an initialized trainer '
                              '(init_model()/load_model() first)')
@@ -86,6 +87,22 @@ class PredictEngine:
         # requests, so the budgeter's ledger stays the quantized size)
         self.serve_dtype = quantize.parse_serve_dtype(dtype)
         self.trainer = trainer
+        # graftfuse conv+BN folding (serve.fold_bn, nnet/fold.py): the
+        # serving DAG retires each foldable BN to a pass-through and the
+        # preceding conv absorbs its frozen calibration-batch affine —
+        # one HLO op where three ran, and the ledger row (key suffix
+        # '+fold') shows the fused program's compiler-truth cost.  f32
+        # tier only: the pinned equality proof is an f32 statement, and
+        # a quantized tree re-entering place_params cannot be told apart
+        # from a fresh one (double-folding would corrupt the weights)
+        self._fold_batch_arg = fold_batch
+        self._fold_report = None
+        self._last_placed = None   # identity of the newest fold+place
+        self._fold_bn_layers = frozenset()
+        if fold_bn and self.serve_dtype == 'f32':
+            from ..nnet.fold import plan_conv_bn_pairs
+            self._fold_bn_layers = frozenset(
+                b for (_, b) in plan_conv_bn_pairs(trainer.net))
         self.buckets: Tuple[int, ...] = tuple(sorted(set(int(b)
                                                          for b in buckets)))
         if not self.buckets or self.buckets[0] <= 0:
@@ -126,8 +143,10 @@ class PredictEngine:
             def _put0(h):
                 return jax.device_put(np.asarray(h), device)
         if self.serve_dtype == 'f32':
-            self._params = (trainer.params if device is None
-                            else jax.tree.map(_put0, trainer.params))
+            base = (self._fold(trainer.params) if self._fold_bn_layers
+                    else trainer.params)
+            self._params = (base if device is None
+                            else jax.tree.map(_put0, base))
         else:
             self._params = jax.tree.map(
                 _put0,
@@ -145,6 +164,7 @@ class PredictEngine:
         max_round = tr.max_round
         spmd = tr._mesh.devices.size
         quantized = self.serve_dtype != 'f32'
+        fold_layers = self._fold_bn_layers
 
         def fwd(params, data):
             if quantized:
@@ -156,14 +176,53 @@ class PredictEngine:
                                  max_round=max_round,
                                  compute_dtype=compute_dtype,
                                  spmd_devices=spmd)
-            values, _ = net.forward(params, data, ctx)
+            values, _ = net.forward(params, data, ctx,
+                                    identity_layers=fold_layers)
             return values[top]
 
         # the ledger wrap compiles once per distinct signature — the
-        # bucket key names the /programs row; its compile count IS the
-        # provably-bounded cache the tests assert (compile_count below)
+        # bucket key names the /programs row ('+fold' marks the folded
+        # DAG, so /programs tells the fused program's flops/bytes apart
+        # from an unfolded engine's); its compile count IS the provably-
+        # bounded cache the tests assert (compile_count below)
+        suffix = '+fold' if fold_layers else ''
         return self._program.jit(
-            fwd, key_fn=lambda a, _k: f'b{a[1].shape[0]}')
+            fwd, key_fn=lambda a, _k: f'b{a[1].shape[0]}{suffix}')
+
+    # -- conv+BN folding (graftfuse) ---------------------------------------
+    def _calib_batch(self) -> np.ndarray:
+        """The calibration batch whose minibatch statistics the fold
+        freezes: the caller's ``fold_batch`` (pass representative data —
+        the folded net normalizes every future request with THESE
+        statistics), else a seeded synthetic batch at the largest
+        bucket, which keeps the fold deterministic and the equality
+        proof meaningful, but encodes no data statistics."""
+        if self._fold_batch_arg is not None:
+            return _as_4d(np.asarray(self._fold_batch_arg, np.float32))
+        c, y, x = self.trainer.net_cfg.input_shape
+        rng = np.random.RandomState(0)
+        return rng.randn(self.buckets[-1], c, y, x).astype(np.float32)
+
+    def _fold(self, tree):
+        """Fold every planned conv+BN pair of ``tree`` (f32 host/device)
+        around the frozen calibration statistics; the pass itself proves
+        the rewrite within pinned tolerances or raises ``FoldError`` —
+        an engine never silently serves an unproven fold."""
+        from ..nnet.fold import fold_params
+        folded, report = fold_params(
+            self.trainer.net, tree, self._calib_batch(),
+            compute_dtype=self.trainer.compute_dtype)
+        self._fold_report = report
+        return folded
+
+    def fold_view(self) -> Optional[dict]:
+        """The newest fold's receipt (pairs, proof error, tolerances) —
+        None when folding is off or nothing folded."""
+        if self._fold_report is None:
+            return None
+        r = dict(self._fold_report)
+        r['bn_layers'] = sorted(r['bn_layers'])
+        return r
 
     @property
     def compile_count(self) -> int:
@@ -224,14 +283,28 @@ class PredictEngine:
                 lambda h: h if isinstance(h, jax.Array) and dev is None
                 else jax.device_put(np.asarray(h), dev), host_params)
         self._check_tree(host_params)
-        if self._is_placed(host_params):
+        if self._fold_bn_layers:
+            # a hot-swapped tree is re-folded against the SAME frozen
+            # calibration batch.  The sharding-based shortcut below
+            # cannot tell this engine's own folded output from a FRESH
+            # host tree that happens to share shardings (folding twice
+            # would corrupt the weights; never folding a fresh tree
+            # would serve unfolded BNs through a folded DAG) — object
+            # identity with the last placement is the test
+            if host_params is self._last_placed:
+                return host_params
+            host_params = self._fold(host_params)
+        elif self._is_placed(host_params):
             return host_params   # already ours: skip the device round
-        return jax.tree.map(
+        placed = jax.tree.map(
             lambda h, cur: jax.device_put(
                 np.asarray(h, dtype=cur.dtype)
                 if not isinstance(h, jax.Array) else h,
                 cur.sharding),
             host_params, self._params)
+        if self._fold_bn_layers:
+            self._last_placed = placed
+        return placed
 
     def _is_placed(self, params) -> bool:
         """True when every leaf is already a device array carrying the
@@ -379,7 +452,7 @@ class ReplicatedPredictEngine:
     def __init__(self, trainer: NetTrainer,
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
                  dtype: str = 'f32', replicas: int = 2, devices=None,
-                 stats=None):
+                 stats=None, fold_bn: int = 0, fold_batch=None):
         n = int(replicas)
         if n < 1:
             raise ValueError('serve.replicas must be >= 1')
@@ -389,7 +462,8 @@ class ReplicatedPredictEngine:
                              f'{len(devs)} available devices')
         self.engines = [
             PredictEngine(trainer, buckets, dtype, device=devs[i],
-                          program_name=f'serve.predict.r{i}')
+                          program_name=f'serve.predict.r{i}',
+                          fold_bn=fold_bn, fold_batch=fold_batch)
             for i in range(n)]
         self.buckets = self.engines[0].buckets
         self.stats = stats

@@ -49,11 +49,13 @@ class LedgerGate:
                  mem_knobs: Tuple[str, ...] = (),
                  budgeter=None,
                  feasible: Optional[Callable[[Candidate],
-                                             Optional[str]]] = None):
+                                             Optional[str]]] = None,
+                 mem_inv_knobs: Tuple[str, ...] = ()):
         self.base_bytes = float(base_bytes)
         self.ceiling_bytes = float(ceiling_bytes)
         self.baseline = dict(baseline)
         self.mem_knobs = tuple(mem_knobs)
+        self.mem_inv_knobs = tuple(mem_inv_knobs)
         self.budgeter = budgeter
         self.feasible = feasible
 
@@ -62,6 +64,12 @@ class LedgerGate:
         for name in self.mem_knobs:
             base = max(1, int(self.baseline.get(name, 1)))
             scale *= max(1, int(cand.get(name, base))) / base
+        for name in self.mem_inv_knobs:
+            # split knobs (micro_batch): a LARGER value DIVIDES the
+            # workspace, so the ratio inverts — a candidate that merges
+            # splits back (smaller value) prices UP and can be pruned
+            base = max(1, int(self.baseline.get(name, 1)))
+            scale *= base / max(1, int(cand.get(name, base)))
         return self.base_bytes * scale
 
     def admit(self, cand: Candidate) -> Tuple[bool, Dict[str, object]]:

@@ -39,6 +39,7 @@ class KnobDecl:
     default: int
     mem: bool        # value scales live accelerator bytes ~linearly
     spec: bool = False   # speculative-decoding knob (grow on high accept)
+    mem_inv: bool = False   # value scales live bytes ~INVERSELY (splits)
 
 
 # The full declared-safe knob surface.  Adding a row here is the ONLY way
@@ -51,6 +52,9 @@ KNOBS: Dict[str, KnobDecl] = {d.name: d for d in (
     KnobDecl('page_size', 1, 128, 16, mem=True),
     KnobDecl('spec_k', 0, 8, 0, mem=False, spec=True),
     KnobDecl('max_queue', 1, 1024, 64, mem=False),
+    # μ-cuDNN-style convolution microbatching (ops/pallas_cnn.py): a
+    # LARGER split shrinks the conv workspace, so it prices inversely
+    KnobDecl('micro_batch', 1, 64, 1, mem=False, mem_inv=True),
 )}
 
 
@@ -197,6 +201,13 @@ class TuneSpace:
 
     def mem_knobs(self) -> Tuple[str, ...]:
         return tuple(r.name for r in self.knobs if KNOBS[r.name].mem)
+
+    def mem_inv_knobs(self) -> Tuple[str, ...]:
+        """Knobs whose value DIVIDES live accelerator bytes (split
+        counts like ``micro_batch``) — the stage-1 gate prices these
+        inversely, and the online controller GROWS them under memory
+        pressure instead of shrinking."""
+        return tuple(r.name for r in self.knobs if KNOBS[r.name].mem_inv)
 
     def ladder(self, name: str) -> Tuple[int, ...]:
         """Deterministic geometric probe ladder for one knob: the range

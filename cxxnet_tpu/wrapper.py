@@ -201,7 +201,8 @@ class Net:
                     max_wait: float = 0.002, deadline: float = 1.0,
                     warm: bool = True, models=None,
                     mem_budget: int = 0, dtype: str = 'f32',
-                    replicas: int = 0) -> None:
+                    replicas: int = 0, fold_bn: int = 0,
+                    fold_batch=None) -> None:
         """Stand up the serving stack over this net's loaded params: a
         bucketed ``PredictEngine`` plus a ``DynamicBatcher``.  Call once;
         ``serve_stop()`` tears down (and must precede a restart).
@@ -217,7 +218,10 @@ class Net:
         so the ``mem_budget`` ledger fits ~4x more int8 models.
         ``replicas>=2`` serves N per-device data-parallel engine
         replicas behind the one batcher (``serve.replicas``,
-        doc/serving.md "Sharded serving")."""
+        doc/serving.md "Sharded serving").  ``fold_bn=1`` folds conv+BN
+        pairs into the conv at engine build (f32 tier only; frozen
+        calibration-batch statistics — doc/kernels.md), calibrating on
+        ``fold_batch`` (NCHW) or a seeded random batch."""
         from .serve import (DynamicBatcher, PredictEngine,
                             ReplicatedPredictEngine)
         from .utils.bucketing import parse_buckets
@@ -229,9 +233,12 @@ class Net:
         if replicas >= 2:
             from .utils.metric import StatSet
             self._engine = ReplicatedPredictEngine(
-                tr, bks, dtype=dtype, replicas=replicas, stats=StatSet())
+                tr, bks, dtype=dtype, replicas=replicas, stats=StatSet(),
+                fold_bn=fold_bn, fold_batch=fold_batch)
         else:
-            self._engine = PredictEngine(tr, bks, dtype=dtype)
+            self._engine = PredictEngine(tr, bks, dtype=dtype,
+                                         fold_bn=fold_bn,
+                                         fold_batch=fold_batch)
         if warm:
             self._engine.warm()
         self._batcher = DynamicBatcher(self._engine, max_queue=max_queue,

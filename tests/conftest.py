@@ -145,6 +145,16 @@ def pytest_configure(config):
         'tuned-vs-hand-written bitwise twins, online TuneController '
         're-plan bounds + recompile-storm guard drill; CPU-only '
         '(tier-1: runs under -m "not slow"; select with -m tune)')
+    config.addinivalue_line(
+        'markers',
+        'cnn_fused: graftfuse suite — fused Pallas conv+bias+act '
+        'blocks (interpret-mode bitwise/pinned-tolerance twins vs the '
+        'XLA composition, fwd+grad, every stride/pad/group leg), '
+        'inference conv+BN folding through a real PredictEngine '
+        '(hot-swap re-fold + double-fold identity guard), μ-cuDNN '
+        'conv microbatching bitwise at every declared split with '
+        'ledger peak-bytes bounds; CPU-only '
+        '(tier-1: runs under -m "not slow"; select with -m cnn_fused)')
 
 
 # every pipeline thread the framework starts carries a cxxnet- name

@@ -1,0 +1,33 @@
+"""Clean twin of pallas_param_indirect_sync.py: the same forwarding
+helpers with kernels that keep every op traced — AND a host-side builder
+whose ``float()`` must NOT be flagged just because it calls a helper
+(only the argument matching the forwarded parameter is traced)."""
+import functools
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _call(kernel, x):
+    return pl.pallas_call(kernel, out_shape=x)(x)
+
+
+def _call_kw(x, kernel=None):
+    return pl.pallas_call(functools.partial(kernel), out_shape=x)(x)
+
+
+def _scale_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0
+
+
+def _gain_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] + jnp.float32(1.0)
+
+
+def scale(x, gain):
+    gain = float(gain)                 # host code: gain is a host scalar
+    return _call(_scale_kernel, x) * gain
+
+
+def stamp(x):
+    return _call_kw(x, kernel=_gain_kernel)

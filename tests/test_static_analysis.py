@@ -219,6 +219,27 @@ def test_tracer_pallas_kernel_clean_twin_silent():
         fixture('pallas_kernel_clean.py')) == []
 
 
+def test_tracer_param_indirect_kernel_caught():
+    """The closed soundness hole: a kernel handed to a HELPER that
+    forwards its parameter into pallas_call position 0 is traced scope —
+    positionally and by keyword (through an inline partial)."""
+    findings = tracer_hygiene.check_module(
+        fixture('pallas_param_indirect_sync.py'))
+    assert len(findings) == 2
+    assert all(f.rule == 'tracer-hygiene' for f in findings)
+    msgs = ' | '.join(f.message for f in findings)
+    assert '_sync_kernel' in msgs and 'float()' in msgs       # positional
+    assert '_clock_kernel' in msgs and 'time.monotonic()' in msgs  # kw
+
+
+def test_tracer_param_indirect_clean_twin_silent():
+    """...while calling the same helpers with clean kernels — and doing
+    host float() work around the call — stays silent: only the argument
+    matching the forwarded parameter becomes traced scope."""
+    assert tracer_hygiene.check_module(
+        fixture('pallas_param_indirect_clean.py')) == []
+
+
 # --- fault-taxonomy: fixtures ------------------------------------------------
 
 @pytest.fixture(scope='module')

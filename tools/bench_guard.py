@@ -373,6 +373,83 @@ def expand_autotune(p: dict, name: str) -> Tuple[List[str], List[dict]]:
     return errs, synth
 
 
+CNN_METRIC = 'cnn_fused_speedup'
+
+
+def expand_cnn_fused(p: dict, name: str) -> Tuple[List[str], List[dict]]:
+    """Validate one ``cnn_fused_speedup`` payload (BENCH_CNN — the
+    graftfuse A/B, doc/kernels.md): every A/B leg must carry its
+    in-bench twin assertion (a speedup over diverging math is not a
+    speedup), the micro_batch sweep must be bitwise at every split with
+    ledger peak bytes monotone non-increasing in the split, and the
+    headline must be the best leg's speedup.  Per-leg throughputs are
+    expanded into synthetic payloads for cross-round regression
+    flags."""
+    errs: List[str] = []
+    synth: List[dict] = []
+    plat = p.get('platform')
+    train = p.get('train')
+    if not isinstance(train, dict):
+        errs.append(f'{name}: cnn_fused receipt has no train leg')
+        train = {}
+    elif train.get('twin_ok') is not True:
+        errs.append(f'{name}: train leg params were not twin-asserted '
+                    '— fused training could have diverged unnoticed')
+    infer = p.get('inference')
+    if not isinstance(infer, dict):
+        errs.append(f'{name}: cnn_fused receipt has no inference leg')
+        infer = {}
+    else:
+        if infer.get('twin_ok') is not True:
+            errs.append(f'{name}: inference leg scores were not '
+                        'twin-asserted against the unfolded engine')
+        fv = infer.get('fold_view')
+        if not (isinstance(fv, dict) and fv.get('pairs')):
+            errs.append(f'{name}: inference leg folded no conv+BN '
+                        'pairs — the A/B measured nothing')
+    mb = p.get('micro_batch')
+    if not (isinstance(mb, dict)
+            and isinstance(mb.get('sweep'), list) and mb['sweep']):
+        errs.append(f'{name}: cnn_fused receipt has no micro_batch '
+                    'sweep')
+    else:
+        peaks = []
+        for row in mb['sweep']:
+            if row.get('bitwise_equal_to_unsplit') is not True:
+                errs.append(
+                    f'{name}: micro_batch={row.get("micro_batch")} row '
+                    'is not bitwise-asserted against the unsplit step')
+            if isinstance(row.get('peak_bytes'), int) \
+                    and row['peak_bytes'] > 0:
+                peaks.append(row['peak_bytes'])
+            else:
+                errs.append(
+                    f'{name}: micro_batch={row.get("micro_batch")} row '
+                    'carries no ledger peak_bytes — the split\'s memory '
+                    'claim is unsubstantiated')
+        if any(a < b for a, b in zip(peaks, peaks[1:])):
+            errs.append(f'{name}: micro_batch peak_bytes {peaks} grow '
+                        'with the split — splitting must bound peak '
+                        'HBM, not inflate it')
+    speedups = [leg.get('speedup') for leg in (train, infer)
+                if isinstance(leg.get('speedup'), (int, float))]
+    value = p.get('value')
+    if speedups and isinstance(value, (int, float)) \
+            and abs(value - max(speedups)) > 1e-6:
+        errs.append(f'{name}: headline {value} is not the best-leg '
+                    f'speedup ({max(speedups)})')
+    for leg, key, unit in (
+            (train, 'fused_steps_per_sec', 'steps/sec'),
+            (train, 'unfused_steps_per_sec', 'steps/sec'),
+            (infer, 'folded_rows_per_sec', 'rows/sec'),
+            (infer, 'plain_rows_per_sec', 'rows/sec')):
+        if key in leg:
+            synth.append({'metric': f'cnn_fused_{key}',
+                          'value': leg.get(key), 'unit': unit,
+                          'platform': plat})
+    return errs, synth
+
+
 def check_file(path: str) -> Tuple[List[str], List[dict]]:
     """(errors, payloads) for one receipt file."""
     name = os.path.basename(path)
@@ -405,6 +482,10 @@ def check_file(path: str) -> Tuple[List[str], List[dict]]:
         elif p.get('metric') == TUNE_METRIC:
             t_errs, synth = expand_autotune(p, name)
             errs.extend(t_errs)
+            extra.extend(synth)
+        elif p.get('metric') == CNN_METRIC:
+            c_errs, synth = expand_cnn_fused(p, name)
+            errs.extend(c_errs)
             extra.extend(synth)
     return errs, loads + extra
 
